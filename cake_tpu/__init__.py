@@ -1,0 +1,20 @@
+"""cake-tpu: a TPU-native distributed multimodal AI inference framework.
+
+A ground-up re-design of the capabilities of evilsocket/cake (a Rust/candle
+LAN-cluster inference server) for TPU hardware: the compute path is JAX/XLA
+(jit-compiled contiguous decoder-layer ranges, static shapes, Pallas kernels
+for the hot fused ops), the cluster plane is the same host-side architecture
+(UDP discovery, PSK auth, framed TCP activation shipping) re-implemented in
+asyncio + a C++ framing/IO core.
+
+Layer map (mirrors reference SURVEY §1):
+  ops/       - op/kernel library        (ref: cake-core/src/backends/)
+  utils/     - weights, quant, hub      (ref: cake-core/src/utils/)
+  models/    - model zoo                (ref: cake-core/src/models/)
+  cluster/   - distributed runtime      (ref: cake-core/src/cake/sharding/)
+  api/       - OpenAI-compatible server (ref: cake-core/src/cake/sharding/api/)
+  parallel/  - TPU-native mesh/sharding (beyond reference: TP/DP/SP over ICI)
+  cli.py     - command line             (ref: cake-cli/)
+"""
+
+__version__ = "0.1.0"

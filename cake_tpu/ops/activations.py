@@ -1,0 +1,81 @@
+"""Fused elementwise ops.
+
+The reference backs these with hand-written CUDA/MSL/WGSL kernels
+(ref: cake-core/src/backends/mod.rs silu_mul / stable_softplus / add3 /
+exp_mul / sub_mul / add_scaled / adaln_modulate; backends/cuda/ops.cu).
+On TPU they are jnp expressions fused by XLA into the surrounding jit —
+keeping them as named functions preserves the reference's op inventory
+and gives Pallas a single place to swap in custom kernels if profiling
+ever shows XLA fusion is insufficient.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def gelu(x):
+    """Exact GELU (erf form)."""
+    return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_tanh(x):
+    """Approximate (tanh) GELU — Gemma3 MLP (ref: config.rs use_gelu_mlp)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def silu_mul(gate, up):
+    """silu(gate) * up — the fused SwiGLU elementwise
+    (ref: backends/mod.rs silu_mul, models/common/mlp.rs)."""
+    return jax.nn.silu(gate) * up
+
+
+def gelu_mul(gate, up, approximate: bool = True):
+    """gelu(gate) * up — Gemma3-style GEGLU."""
+    return jax.nn.gelu(gate, approximate=approximate) * up
+
+
+def stable_softplus(x):
+    """log(1+exp(x)) without overflow (ref: backends/mod.rs stable_softplus)."""
+    return jax.nn.softplus(x)
+
+
+def add3(a, b, c):
+    """(ref: backends/mod.rs add3)"""
+    return a + b + c
+
+
+def exp_mul(x, y):
+    """exp(x) * y (ref: backends/mod.rs exp_mul)"""
+    return jnp.exp(x) * y
+
+
+def sub_mul(a, b, c):
+    """(a - b) * c (ref: backends/mod.rs sub_mul)"""
+    return (a - b) * c
+
+
+def add_scaled(a, b, scale):
+    """a + b * scale (ref: backends/mod.rs add_scaled)"""
+    return a + b * scale
+
+
+def adaln_modulate(x, shift, scale):
+    """Adaptive layer-norm modulation used by DiT diffusion heads:
+    x * (1 + scale) + shift (ref: backends/mod.rs adaln_modulate,
+    models/vibevoice/ddpm.rs)."""
+    return x * (1.0 + scale) + shift
+
+
+def softmax(x, axis: int = -1):
+    """Softmax with f32 accumulation (ref: backends/mod.rs softmax)."""
+    dt = x.dtype
+    return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(dt)

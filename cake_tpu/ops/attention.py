@@ -1,0 +1,81 @@
+"""Scaled-dot-product attention with GQA, causal masking and sliding windows.
+
+TPU-first design notes (vs ref: cake-core/src/models/common/attention.rs):
+  * Activations stay in [B, S, H, D] layout end-to-end; GQA is expressed as a
+    grouped einsum so no repeat_kv materialization and no transposes — the
+    reference's seq_len==1 transpose-avoidance hack is unnecessary under XLA.
+  * Masking is position-based: the KV cache carries an absolute-position array
+    (-1 = empty slot), so one code path serves prefill, chunked prefill into an
+    existing cache, decode, and sliding-window ring buffers. The reference
+    instead trims/concats the KV tensors dynamically (cache.rs:163-210), which
+    would recompile under XLA's static shapes.
+  * Softmax/accumulation in f32 (matches the reference's F32 attention path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free for all-masked rows
+
+
+def make_attention_mask(q_positions, kv_positions, window: int | None = None,
+                        causal: bool = True):
+    """Boolean attend-mask [B, Sq, Skv].
+
+    q_positions:  [B, Sq] absolute positions of the queries.
+    kv_positions: [B, Skv] absolute positions in the KV cache, -1 for empty.
+    window: sliding-window size W — key visible iff q_pos - W < k_pos.
+    """
+    q = q_positions[:, :, None]
+    k = kv_positions[:, None, :]
+    mask = k >= 0
+    if causal:
+        mask &= k <= q
+    if window is not None:
+        mask &= k > q - window
+    return mask
+
+
+def multi_head_attention(q, k, v, mask=None, scale: float | None = None):
+    """Grouped-query attention.
+
+    q: [B, Sq, Hq, D], k/v: [B, Skv, Hkv, D] with Hq a multiple of Hkv.
+    mask: bool [B, Sq, Skv] (True = attend) or None for full attention.
+    Returns [B, Sq, Hq, D] in q.dtype.
+    """
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    qf = q.reshape(b, sq, hkv, g, d)
+    # scores: [B, Hkv, G, Sq, Skv]
+    scores = jnp.einsum("bskgd,btkd->bkgst", qf, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+def qk_norm(q, k, q_weight, k_weight, eps: float, pre_reshape: bool = False):
+    """QK RMS-normalization, both placements (ref: attention.rs:176-215).
+
+    post-reshape (Qwen3/Gemma3): q,k are [B,S,H,D], weights are [D].
+    pre-reshape (OLMo2): q,k are [B,S,H*D] flat, weights are [H*D].
+    The math is identical (norm over the last axis) — the distinction is which
+    axis is last at the time of application, so callers pick the call site.
+    """
+    from .norms import rms_norm
+    return rms_norm(q, q_weight, eps), rms_norm(k, k_weight, eps)
+
+
+def causal_sdpa(q, k, v, scale: float | None = None):
+    """Plain causal attention for prefill without a cache (B,S,H,D)."""
+    b, s = q.shape[0], q.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    mask = make_attention_mask(pos, pos)
+    return multi_head_attention(q, k, v, mask, scale)

@@ -1,0 +1,93 @@
+"""1D/2D convolution ops for the audio (VibeVoice/LuxTTS) and image (VAE)
+stacks, plus the fused depthwise-conv variants used by streaming decoders
+and GatedDeltaNet (ref: backends/mod.rs conv1d / conv_transpose1d / conv2d /
+depthwise_conv1d_{silu,bias,bias_ctx}).
+
+Layout: channels-first [B, C, T] / [B, C, H, W], matching the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def conv1d(x, weight, bias=None, stride: int = 1, padding: int = 0,
+           dilation: int = 1, groups: int = 1):
+    """x: [B, Cin, T], weight: [Cout, Cin/groups, K]."""
+    y = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=(stride,),
+        padding=[(padding, padding)],
+        rhs_dilation=(dilation,),
+        feature_group_count=groups,
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    if bias is not None:
+        y = y + bias[None, :, None]
+    return y
+
+
+def conv_transpose1d(x, weight, bias=None, stride: int = 1, padding: int = 0):
+    """x: [B, Cin, T], weight: [Cin, Cout, K] (torch convention)."""
+    y = jax.lax.conv_transpose(
+        x, weight,
+        strides=(stride,),
+        padding=[(padding, padding)],
+        dimension_numbers=("NCH", "IOH", "NCH"),
+    )
+    if bias is not None:
+        y = y + bias[None, :, None]
+    return y
+
+
+def conv2d(x, weight, bias=None, stride: int = 1, padding: int = 0,
+           dilation: int = 1, groups: int = 1):
+    """x: [B, Cin, H, W], weight: [Cout, Cin/groups, Kh, Kw]."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    y = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if bias is not None:
+        y = y + bias[None, :, None, None]
+    return y
+
+
+def depthwise_conv1d(x, weight, bias=None, padding: int = 0):
+    """Depthwise conv: x [B, C, T], weight [C, 1, K]."""
+    return conv1d(x, weight, bias, padding=padding, groups=x.shape[1])
+
+
+def depthwise_conv1d_silu(x, weight, bias=None, padding: int = 0):
+    """Fused depthwise conv + SiLU (ref: backends/mod.rs depthwise_conv1d_silu;
+    used by GDN's short causal conv)."""
+    return jax.nn.silu(depthwise_conv1d(x, weight, bias, padding))
+
+
+def causal_depthwise_conv1d_update(x_t, conv_state, weight, bias=None,
+                                   activation: str | None = "silu"):
+    """Single-step causal depthwise conv for decode.
+
+    x_t: [B, C] new frame; conv_state: [B, C, K-1] previous frames.
+    Returns (y_t [B, C], new_conv_state). This is the streaming form of the
+    reference's depthwise_conv1d_bias_ctx (VibeVoice VAE) and the GDN conv
+    state update (ref: cache.rs conv states :221-238).
+    """
+    k = weight.shape[-1]
+    window = jnp.concatenate([conv_state, x_t[:, :, None]], axis=-1)  # [B,C,K]
+    y = jnp.einsum("bck,ck->bc", window, weight[:, 0, :])
+    if bias is not None:
+        y = y + bias[None, :]
+    if activation == "silu":
+        y = jax.nn.silu(y)
+    new_state = window[:, :, 1:] if k > 1 else conv_state
+    return y, new_state

@@ -1,0 +1,46 @@
+"""FP8 (E4M3) block-wise dequantization.
+
+The reference stores FP8 weights with a per-128x128-block scale tensor
+`weight_scale_inv` and dequantizes either at load (utils/fp8.rs) or
+per-layer at forward for memory parity (utils/native_dtype_backend.rs,
+backends/mod.rs f8e4m3_to_{f32,f16,bf16}). On TPU, float8_e4m3fn is a
+native dtype: dequant is a cast + broadcast-multiply that XLA fuses into
+the consuming matmul.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+FP8_BLOCK = 128  # ref: utils/fp8.rs block-wise (128x128) scales
+
+
+def dequant_fp8_blockwise(weight_fp8, scale_inv, out_dtype=jnp.bfloat16,
+                          block: int = FP8_BLOCK):
+    """weight_fp8: [O, I] float8_e4m3fn; scale_inv: [ceil(O/b), ceil(I/b)] f32.
+
+    Returns weight in out_dtype. Handles edge blocks when O/I are not
+    multiples of the block size.
+    """
+    o, i = weight_fp8.shape
+    w = weight_fp8.astype(jnp.float32)
+    # Expand each block scale across its 128x128 tile, then crop.
+    s = jnp.repeat(jnp.repeat(scale_inv, block, axis=0), block, axis=1)[:o, :i]
+    return (w * s).astype(out_dtype)
+
+
+def quant_fp8_blockwise(weight, block: int = FP8_BLOCK):
+    """Inverse helper (tests + splitter): returns (fp8 weight, scale_inv)."""
+    import numpy as np
+    o, i = weight.shape
+    po = (-o) % block
+    pi = (-i) % block
+    wp = jnp.pad(weight.astype(jnp.float32), ((0, po), (0, pi)))
+    blocks = wp.reshape(
+        (o + po) // block, block, (i + pi) // block, block).transpose(0, 2, 1, 3)
+    amax = jnp.max(jnp.abs(blocks), axis=(2, 3))
+    amax = jnp.maximum(amax, 1e-12)
+    scale = 448.0 / amax                       # E4M3 max normal = 448
+    scale_inv = 1.0 / scale
+    wq = blocks * scale[:, :, None, None]
+    wq = wq.transpose(0, 2, 1, 3).reshape(o + po, i + pi)[:o, :i]
+    return wq.astype(jnp.float8_e4m3fn), scale_inv.astype(jnp.float32)

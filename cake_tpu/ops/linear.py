@@ -1,0 +1,22 @@
+"""Linear / embedding primitives.
+
+Weights follow the HF/safetensors convention [out_features, in_features]
+(ref: backends/mod.rs matmul / linear_forward / preprocess_linear_weight —
+on TPU no weight preprocessing is needed: XLA lays out operands for the MXU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def linear(x, weight, bias=None):
+    """y = x @ W^T (+ b). x: [..., in], weight: [out, in]."""
+    y = jnp.einsum("...i,oi->...o", x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(token_ids, table):
+    """table: [vocab, hidden]; token_ids: int32 [...]."""
+    return jnp.take(table, token_ids, axis=0)

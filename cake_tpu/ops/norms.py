@@ -1,0 +1,97 @@
+"""Normalization ops.
+
+TPU-native equivalents of the reference ComputeBackend norm methods
+(ref: cake-core/src/backends/mod.rs rms_norm / layer_norm / group_norm /
+rms_norm_gated / add_rms_norm / rms_norm_channel). On TPU these are plain
+jnp expressions: XLA fuses them into the surrounding jitted layer, which
+replaces the reference's hand-written CUDA/MSL/WGSL kernels.
+
+All norms accumulate in float32 and cast back to the input dtype, matching
+the reference's F32-internal kernel semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """weight * x / rms(x). Weight may already include the (1+w) residual
+    offset (applied at load time, ref: config.rs load_rms_norm_weight)."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dt)
+
+
+def add_rms_norm(x, residual, weight, eps: float = 1e-6):
+    """Fused residual-add + RMS norm: returns (normed(x+residual), x+residual).
+    (ref: backends/mod.rs add_rms_norm)"""
+    s = x + residual
+    return rms_norm(s, weight, eps), s
+
+
+def rms_norm_gated(x, gate, weight, eps: float = 1e-6, activation: str = "silu"):
+    """Gated RMS norm used by GatedDeltaNet: rms_norm(x) * act(gate).
+    (ref: backends/mod.rs rms_norm_gated; qwen3_5/linear_attention.rs)"""
+    y = rms_norm(x, weight, eps)
+    gf = gate.astype(jnp.float32)
+    if activation == "silu":
+        g = gf * jax.nn.sigmoid(gf)
+    elif activation == "sigmoid":
+        g = jax.nn.sigmoid(gf)
+    else:
+        raise ValueError(f"unknown gate activation {activation}")
+    return (y.astype(jnp.float32) * g).astype(x.dtype)
+
+
+def rms_norm_channel(x, weight, eps: float = 1e-6, axis: int = 1):
+    """RMS norm over a channel axis that is not the last one (streaming VAE
+    conv stacks normalize over channels of [B, C, T] tensors).
+    (ref: backends/mod.rs rms_norm_channel)"""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=axis, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    wshape = [1] * x.ndim
+    wshape[axis] = x.shape[axis]
+    return (y * weight.astype(jnp.float32).reshape(wshape)).astype(dt)
+
+
+def layer_norm(x, weight, bias=None, eps: float = 1e-5):
+    """Standard layer norm over the last axis (ref: backends/mod.rs layer_norm)."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dt)
+
+
+def group_norm(x, weight, bias, num_groups: int, eps: float = 1e-5):
+    """GroupNorm over [B, C, *spatial] (ref: backends/mod.rs group_norm)."""
+    dt = x.dtype
+    b, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xf = x.astype(jnp.float32).reshape(b, num_groups, c // num_groups, -1)
+    mean = jnp.mean(xf, axis=(2, 3), keepdims=True)
+    var = jnp.var(xf, axis=(2, 3), keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(b, c, *spatial)
+    wshape = [1, c] + [1] * len(spatial)
+    y = y * weight.astype(jnp.float32).reshape(wshape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(wshape)
+    return y.astype(dt)
+
+
+def load_rms_norm_weight(weight, residual: bool):
+    """Apply the residual (1+w) pattern at load time in f32
+    (ref: models/common/config.rs load_rms_norm_weight)."""
+    if not residual:
+        return weight
+    return (weight.astype(jnp.float32) + 1.0).astype(weight.dtype)

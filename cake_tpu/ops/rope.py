@@ -1,0 +1,93 @@
+"""Rotary position embeddings.
+
+TPU-native RoPE: cos/sin tables are precomputed once per model in f32
+(ref: models/common/cache.rs:49-99 — incl. llama3 frequency scaling) and
+gathered by position index inside the jitted step, so decode (pos is a
+traced scalar) and bucketed prefill reuse the same compiled code.
+
+Layout note: the reference applies RoPE on [B, H, S, D] after transpose
+(ref: attention.rs apply_rotary_emb). We keep activations in [B, S, H, D]
+throughout — on TPU the einsum-based attention never needs the transpose.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """llama3-style frequency scaling (ref: config.rs RopeScaling)."""
+    factor: float = 8.0
+    high_freq_factor: float = 4.0
+    low_freq_factor: float = 1.0
+    original_max_position_embeddings: int = 8192
+    rope_type: str | None = None
+
+
+def inv_frequencies(rotary_dim: int, theta: float,
+                    scaling: RopeScaling | None = None) -> np.ndarray:
+    """Per-pair inverse frequencies, with optional llama3 smoothing
+    (ref: cache.rs:49-80)."""
+    inv = 1.0 / (theta ** (np.arange(0, rotary_dim, 2, dtype=np.float64) / rotary_dim))
+    if scaling is not None and (scaling.rope_type in (None, "llama3", "default")) \
+            and scaling.factor and scaling.factor != 1.0:
+        low_wavelen = scaling.original_max_position_embeddings / scaling.low_freq_factor
+        high_wavelen = scaling.original_max_position_embeddings / scaling.high_freq_factor
+        wavelen = 2.0 * np.pi / inv
+        scaled = np.where(wavelen > low_wavelen, inv / scaling.factor, inv)
+        smooth = (scaling.original_max_position_embeddings / wavelen
+                  - scaling.low_freq_factor) / (scaling.high_freq_factor
+                                                - scaling.low_freq_factor)
+        mid = (1.0 - smooth) * inv / scaling.factor + smooth * inv
+        is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+        inv = np.where(is_mid, mid, scaled)
+    return inv.astype(np.float64)
+
+
+def rope_tables(max_seq_len: int, rotary_dim: int, theta: float,
+                scaling: RopeScaling | None = None,
+                dtype=jnp.float32):
+    """Precompute (cos, sin) of shape [max_seq_len, rotary_dim // 2]."""
+    inv = inv_frequencies(rotary_dim, theta, scaling)
+    t = np.arange(max_seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv)
+    return jnp.asarray(np.cos(freqs), dtype=dtype), jnp.asarray(np.sin(freqs), dtype=dtype)
+
+
+def apply_rope(x, cos, sin, positions, rotary_dim: int | None = None,
+               interleaved: bool = False):
+    """Apply RoPE to x: [B, S, H, D] with positions: [B, S] or [S] (int32).
+
+    rotary_dim < D applies partial RoPE to the first rotary_dim channels and
+    passes the rest through (ref: attention.rs apply_rotary_emb; Phi-4
+    partial_rotary_factor 0.25).
+    """
+    d = x.shape[-1]
+    rd = d if rotary_dim is None else rotary_dim
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    c = cos[positions][:, :, None, :].astype(jnp.float32)   # [B, S, 1, rd/2]
+    s = sin[positions][:, :, None, :].astype(jnp.float32)
+
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    xf = x_rot.astype(jnp.float32)
+    if interleaved:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x1 * s + x2 * c
+        out = jnp.stack([o1, o2], axis=-1).reshape(xf.shape)
+    else:
+        half = rd // 2
+        x1 = xf[..., :half]
+        x2 = xf[..., half:]
+        o1 = x1 * c - x2 * s
+        o2 = x1 * s + x2 * c
+        out = jnp.concatenate([o1, o2], axis=-1)
+    out = out.astype(x.dtype)
+    if rd == d:
+        return out
+    return jnp.concatenate([out, x_pass], axis=-1)

@@ -1,0 +1,118 @@
+"""On-device token sampling.
+
+The reference keeps sampling on-GPU so only 4 bytes/token cross the bus:
+Gumbel-softmax sampling (ref: text_model.rs create_logits_processor) and a
+scatter-based sign-aware repeat penalty (ref: text_model.rs
+apply_repeat_penalty_gpu). Here everything — penalty, temperature, top-k,
+top-p, gumbel argmax — runs inside the jitted decode step, and only the
+sampled token id leaves the TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Static sampling parameters — one compiled decode step per config
+    (matches ref Sampling enum: ArgMax / GumbelSoftmax / TopK / TopP /
+    TopKThenTopP)."""
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    repeat_penalty: float = 1.0
+    repeat_last_n: int = 64
+
+
+def apply_repeat_penalty(logits, recent_tokens, penalty: float):
+    """Sign-aware repeat penalty on device.
+
+    logits: [V]; recent_tokens: [N] int32 with -1 padding (dropped by the
+    scatter). logit >= 0 -> logit/penalty, logit < 0 -> logit*penalty
+    (ref: text_model.rs apply_repeat_penalty_gpu).
+    """
+    # -1 padding would wrap to the last vocab entry; remap to an out-of-bounds
+    # positive index so mode="drop" discards it.
+    idx = jnp.where(recent_tokens < 0, logits.shape[-1], recent_tokens)
+    flagged = jnp.zeros(logits.shape, jnp.bool_).at[idx].set(True, mode="drop")
+    penalized = jnp.where(logits >= 0, logits / penalty, logits * penalty)
+    return jnp.where(flagged, penalized, logits)
+
+
+def _gumbel(rng, shape):
+    return jax.random.gumbel(rng, shape, dtype=jnp.float32)
+
+
+def sample_argmax(logits):
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample_gumbel(logits, rng, temperature: float):
+    """Gumbel-max sampling == categorical sampling, fully on device."""
+    z = logits.astype(jnp.float32) / temperature + _gumbel(rng, logits.shape)
+    return jnp.argmax(z, axis=-1).astype(jnp.int32)
+
+
+def sample_top_k(logits, rng, k: int, temperature: float):
+    vals, idx = jax.lax.top_k(logits.astype(jnp.float32), k)
+    z = vals / temperature + _gumbel(rng, vals.shape)
+    choice = jnp.argmax(z, axis=-1)
+    return jnp.take_along_axis(idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
+
+
+def _top_p_mask(sorted_probs, p: float):
+    """Keep the smallest prefix of (descending) sorted probs whose mass >= p.
+    A token is kept if the cumulative mass *before* it is < p."""
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    prev = cum - sorted_probs
+    return prev < p
+
+
+def sample_top_p(logits, rng, p: float, temperature: float):
+    lf = logits.astype(jnp.float32) / temperature
+    sorted_logits = jnp.sort(lf, axis=-1)[..., ::-1]
+    order = jnp.argsort(lf, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    keep = _top_p_mask(probs, p)
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+    z = masked + _gumbel(rng, masked.shape)
+    choice = jnp.argmax(z, axis=-1)
+    return jnp.take_along_axis(order, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
+
+
+def sample_top_k_top_p(logits, rng, k: int, p: float, temperature: float):
+    vals, idx = jax.lax.top_k(logits.astype(jnp.float32), k)
+    vals = vals / temperature
+    probs = jax.nn.softmax(vals, axis=-1)
+    keep = _top_p_mask(probs, p)
+    masked = jnp.where(keep, vals, -jnp.inf)
+    z = masked + _gumbel(rng, masked.shape)
+    choice = jnp.argmax(z, axis=-1)
+    return jnp.take_along_axis(idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
+
+
+def sample(logits, rng, cfg: SamplingConfig, recent_tokens=None):
+    """Dispatch on the static SamplingConfig (ref: create_logits_processor).
+
+    logits: [V] or [B, V]. recent_tokens: [N] int32 (-1 padded) or None.
+    """
+    if cfg.repeat_penalty != 1.0 and recent_tokens is not None:
+        logits = apply_repeat_penalty(logits, recent_tokens, cfg.repeat_penalty)
+    if cfg.temperature <= 0.0:
+        return sample_argmax(logits)
+    if cfg.top_k is None and cfg.top_p is None:
+        return sample_gumbel(logits, rng, cfg.temperature)
+    if cfg.top_k is not None and cfg.top_p is None:
+        return sample_top_k(logits, rng, cfg.top_k, cfg.temperature)
+    if cfg.top_k is None and cfg.top_p is not None:
+        return sample_top_p(logits, rng, cfg.top_p, cfg.temperature)
+    return sample_top_k_top_p(logits, rng, cfg.top_k, cfg.top_p, cfg.temperature)
+
+
+def push_recent_token(recent_tokens, token):
+    """Shift a new token into the device-resident recent-token ring
+    (drives the repeat penalty without host round-trips)."""
+    return jnp.concatenate([recent_tokens[1:], token.reshape(1)])

@@ -1,0 +1,104 @@
+"""Dtype utilities shared by the op library and the wire protocol.
+
+The wire dtype tags cover every dtype the reference protocol ships
+(ref: cake-core/src/cake/sharding/proto/message.rs RawTensor dtype:u8),
+extended with bfloat16/f8e4m3 which are first-class on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Stable u8 wire tags. Never reorder — these are a protocol contract.
+WIRE_DTYPES = {
+    0: "float32",
+    1: "float16",
+    2: "bfloat16",
+    3: "float64",
+    4: "uint8",
+    5: "uint32",
+    6: "int64",
+    7: "int32",
+    8: "float8_e4m3fn",
+    9: "int8",
+    10: "int16",
+    11: "uint16",
+    12: "bool",
+}
+WIRE_TAGS = {v: k for k, v in WIRE_DTYPES.items()}
+
+_STR_TO_JNP = {
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float64": jnp.float64,
+    "uint8": jnp.uint8,
+    "uint32": jnp.uint32,
+    "int64": jnp.int64,
+    "int32": jnp.int32,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "uint16": jnp.uint16,
+    "bool": jnp.bool_,
+}
+
+# safetensors header dtype names -> canonical string
+SAFETENSORS_DTYPES = {
+    "F64": "float64",
+    "F32": "float32",
+    "F16": "float16",
+    "BF16": "bfloat16",
+    "I64": "int64",
+    "I32": "int32",
+    "I16": "int16",
+    "I8": "int8",
+    "U8": "uint8",
+    "U16": "uint16",
+    "U32": "uint32",
+    "BOOL": "bool",
+    "F8_E4M3": "float8_e4m3fn",
+}
+
+_ITEMSIZE = {
+    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2,
+    "int64": 8, "int32": 4, "int16": 2, "int8": 1,
+    "uint8": 1, "uint16": 2, "uint32": 4, "bool": 1, "float8_e4m3fn": 1,
+}
+
+
+def parse_dtype(s: str):
+    """Parse a user dtype string (ref: cake/mod.rs parse_dtype_str)."""
+    s = s.lower().strip()
+    aliases = {
+        "f32": "float32", "f16": "float16", "bf16": "bfloat16",
+        "f64": "float64", "u8": "uint8", "u32": "uint32",
+        "i64": "int64", "i32": "int32", "f8": "float8_e4m3fn",
+        "f8e4m3": "float8_e4m3fn", "half": "float16", "float": "float32",
+    }
+    s = aliases.get(s, s)
+    if s not in _STR_TO_JNP:
+        raise ValueError(f"unsupported dtype {s!r}")
+    return _STR_TO_JNP[s]
+
+
+def dtype_name(dt) -> str:
+    """Canonical string name for a jnp/np dtype."""
+    return jnp.dtype(dt).name
+
+
+def itemsize(name: str) -> int:
+    return _ITEMSIZE[name]
+
+
+def to_numpy_bytes(arr) -> bytes:
+    """Raw little-endian bytes of an array (bf16/f8 via uint16/uint8 views)."""
+    a = np.asarray(arr)
+    return a.tobytes()
+
+
+def from_numpy_bytes(data: bytes, dtype_str: str, shape) -> np.ndarray:
+    """Inverse of to_numpy_bytes. bfloat16/f8 round-trip via ml_dtypes (numpy
+    understands them through jnp.dtype)."""
+    np_dt = jnp.dtype(_STR_TO_JNP[dtype_str])  # np.dtype (ml_dtypes-backed for bf16/f8)
+    return np.frombuffer(bytearray(data), dtype=np_dt).reshape(shape)

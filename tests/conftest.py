@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU mesh so every sharding
+path (TP/DP/SP/EP) is exercised without TPU hardware, mirroring the
+reference's everything-runs-on-CPU-CI test strategy (SURVEY §4).
+
+Note: the env may pre-import jax with JAX_PLATFORMS pointing at a TPU
+plugin (sitecustomize), so the env var alone is not enough — we override
+through jax.config before any backend is initialized.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,299 @@
+"""Op library parity tests vs numpy references
+(mirrors ref tests/unit_tests/test_backend_ops.rs cross-checks)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu import ops
+
+
+def np_rms_norm(x, w, eps):
+    x = x.astype(np.float32)
+    var = np.mean(x * x, axis=-1, keepdims=True)
+    return x / np.sqrt(var + eps) * w
+
+
+def test_rms_norm(rng):
+    x = rng.standard_normal((2, 5, 64)).astype(np.float32)
+    w = rng.standard_normal(64).astype(np.float32)
+    got = ops.rms_norm(jnp.asarray(x), jnp.asarray(w), 1e-6)
+    np.testing.assert_allclose(got, np_rms_norm(x, w, 1e-6), atol=1e-5)
+
+
+def test_rms_norm_residual_weight():
+    w = jnp.asarray([0.5, -0.25], dtype=jnp.float32)
+    got = ops.load_rms_norm_weight(w, residual=True)
+    np.testing.assert_allclose(got, [1.5, 0.75])
+    same = ops.load_rms_norm_weight(w, residual=False)
+    np.testing.assert_allclose(same, [0.5, -0.25])
+
+
+def test_add_rms_norm(rng):
+    x = rng.standard_normal((2, 3, 16)).astype(np.float32)
+    r = rng.standard_normal((2, 3, 16)).astype(np.float32)
+    w = np.ones(16, np.float32)
+    y, s = ops.add_rms_norm(jnp.asarray(x), jnp.asarray(r), jnp.asarray(w))
+    np.testing.assert_allclose(s, x + r, atol=1e-6)
+    np.testing.assert_allclose(y, np_rms_norm(x + r, w, 1e-6), atol=1e-5)
+
+
+def test_layer_norm(rng):
+    x = rng.standard_normal((4, 32)).astype(np.float32)
+    w = rng.standard_normal(32).astype(np.float32)
+    b = rng.standard_normal(32).astype(np.float32)
+    got = ops.layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), 1e-5)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_group_norm(rng):
+    x = rng.standard_normal((2, 8, 5)).astype(np.float32)
+    w = rng.standard_normal(8).astype(np.float32)
+    b = rng.standard_normal(8).astype(np.float32)
+    got = ops.group_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                         num_groups=4, eps=1e-5)
+    xr = x.reshape(2, 4, 2, 5)
+    mean = xr.mean((2, 3), keepdims=True)
+    var = xr.var((2, 3), keepdims=True)
+    want = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(2, 8, 5)
+    want = want * w[None, :, None] + b[None, :, None]
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_silu_mul_gelu_mul(rng):
+    g = rng.standard_normal((3, 8)).astype(np.float32)
+    u = rng.standard_normal((3, 8)).astype(np.float32)
+    want = g / (1 + np.exp(-g)) * u
+    np.testing.assert_allclose(ops.silu_mul(jnp.asarray(g), jnp.asarray(u)),
+                               want, atol=1e-5)
+    got = ops.gelu_mul(jnp.asarray(g), jnp.asarray(u))
+    assert got.shape == (3, 8)
+
+
+def test_fused_elementwise(rng):
+    a, b, c = (rng.standard_normal(7).astype(np.float32) for _ in range(3))
+    ja, jb, jc = map(jnp.asarray, (a, b, c))
+    np.testing.assert_allclose(ops.add3(ja, jb, jc), a + b + c, atol=1e-6)
+    np.testing.assert_allclose(ops.exp_mul(ja, jb), np.exp(a) * b, rtol=1e-5)
+    np.testing.assert_allclose(ops.sub_mul(ja, jb, jc), (a - b) * c, atol=1e-6)
+    np.testing.assert_allclose(ops.add_scaled(ja, jb, 0.5), a + 0.5 * b, atol=1e-6)
+    np.testing.assert_allclose(ops.adaln_modulate(ja, jb, jc),
+                               a * (1 + c) + b, atol=1e-5)
+    np.testing.assert_allclose(ops.stable_softplus(jnp.asarray([800.0]))[0],
+                               800.0, rtol=1e-6)
+
+
+def test_rope_rotation_property(rng):
+    """RoPE must preserve norms and depend only on relative positions in QK dots."""
+    d = 32
+    cos, sin = ops.rope_tables(64, d, 10000.0)
+    x = rng.standard_normal((1, 4, 2, d)).astype(np.float32)
+    pos = jnp.arange(4, dtype=jnp.int32)
+    y = ops.apply_rope(jnp.asarray(x), cos, sin, pos)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(y), axis=-1),
+                               np.linalg.norm(x, axis=-1), rtol=1e-4)
+    # relative-position invariance: <R_m q, R_n k> == <R_{m+s} q, R_{n+s} k>
+    q = rng.standard_normal((1, 1, 1, d)).astype(np.float32)
+    k = rng.standard_normal((1, 1, 1, d)).astype(np.float32)
+
+    def dot_at(pq, pk):
+        rq = ops.apply_rope(jnp.asarray(q), cos, sin, jnp.asarray([pq], jnp.int32))
+        rk = ops.apply_rope(jnp.asarray(k), cos, sin, jnp.asarray([pk], jnp.int32))
+        return float(jnp.sum(rq * rk))
+
+    assert abs(dot_at(5, 3) - dot_at(25, 23)) < 1e-3
+
+
+def test_rope_partial(rng):
+    d = 16
+    rd = 8
+    cos, sin = ops.rope_tables(32, rd, 10000.0)
+    x = rng.standard_normal((1, 2, 1, d)).astype(np.float32)
+    pos = jnp.arange(2, dtype=jnp.int32)
+    y = ops.apply_rope(jnp.asarray(x), cos, sin, pos, rotary_dim=rd)
+    # pass-through channels untouched
+    np.testing.assert_allclose(np.asarray(y)[..., rd:], x[..., rd:], atol=1e-6)
+    assert not np.allclose(np.asarray(y)[0, 1, 0, :rd], x[0, 1, 0, :rd])
+
+
+def test_rope_llama3_scaling():
+    sc = ops.RopeScaling(factor=8.0, high_freq_factor=4.0, low_freq_factor=1.0,
+                         original_max_position_embeddings=8192, rope_type="llama3")
+    inv_plain = ops.inv_frequencies(128, 500000.0)
+    inv_scaled = ops.inv_frequencies(128, 500000.0, sc)
+    # high-frequency (short wavelength) components unchanged
+    np.testing.assert_allclose(inv_scaled[0], inv_plain[0])
+    # low-frequency components divided by factor
+    np.testing.assert_allclose(inv_scaled[-1], inv_plain[-1] / 8.0, rtol=1e-6)
+
+
+def np_attention(q, k, v, mask):
+    hq, hkv = q.shape[2], k.shape[2]
+    rep = hq // hkv
+    k = np.repeat(k, rep, axis=2)
+    v = np.repeat(v, rep, axis=2)
+    qt = q.transpose(0, 2, 1, 3).astype(np.float32)
+    kt = k.transpose(0, 2, 1, 3).astype(np.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(np.float32)
+    scores = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(q.shape[-1])
+    scores = np.where(mask[:, None, :, :], scores, -1e30)
+    m = scores.max(-1, keepdims=True)
+    e = np.exp(scores - m)
+    p = e / e.sum(-1, keepdims=True)
+    return (p @ vt).transpose(0, 2, 1, 3)
+
+
+def test_attention_matches_reference(rng):
+    b, sq, skv, hq, hkv, d = 2, 5, 9, 4, 2, 8
+    q = rng.standard_normal((b, sq, hq, d)).astype(np.float32)
+    k = rng.standard_normal((b, skv, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((b, skv, hkv, d)).astype(np.float32)
+    qpos = np.broadcast_to(np.arange(4, 4 + sq, dtype=np.int32), (b, sq))
+    kpos = np.broadcast_to(np.arange(skv, dtype=np.int32), (b, skv))
+    mask = ops.make_attention_mask(jnp.asarray(qpos), jnp.asarray(kpos))
+    got = ops.multi_head_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                                   mask)
+    want = np_attention(q, k, v, np.asarray(mask))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_attention_mask_semantics():
+    qpos = jnp.asarray([[3]], jnp.int32)
+    kpos = jnp.asarray([[0, 1, 2, 3, 4, -1]], jnp.int32)
+    m = np.asarray(ops.make_attention_mask(qpos, kpos))
+    # causal: sees 0..3, not 4; -1 slot invisible
+    assert m[0, 0].tolist() == [True, True, True, True, False, False]
+    m2 = np.asarray(ops.make_attention_mask(qpos, kpos, window=2))
+    # window=2: only positions {2,3} visible
+    assert m2[0, 0].tolist() == [False, False, True, True, False, False]
+
+
+def test_causal_sdpa_is_causal(rng):
+    b, s, h, d = 1, 6, 2, 4
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    out1 = ops.causal_sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    # changing future keys must not affect earlier outputs
+    k2 = k.copy()
+    k2[:, -1] += 10.0
+    v2 = v.copy()
+    v2[:, -1] -= 5.0
+    out2 = ops.causal_sdpa(jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2))
+    np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], atol=1e-5)
+
+
+def test_fp8_roundtrip(rng):
+    w = rng.standard_normal((200, 300)).astype(np.float32)
+    wq, scale_inv = ops.quant_fp8_blockwise(jnp.asarray(w))
+    assert wq.dtype == jnp.float8_e4m3fn
+    assert scale_inv.shape == (2, 3)
+    back = ops.dequant_fp8_blockwise(wq, scale_inv, out_dtype=jnp.float32)
+    err = np.abs(np.asarray(back) - w).mean()
+    assert err < 0.05
+
+
+def test_conv1d_and_depthwise(rng):
+    x = rng.standard_normal((1, 4, 10)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3)).astype(np.float32)
+    b = rng.standard_normal(6).astype(np.float32)
+    y = ops.conv1d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), padding=1)
+    assert y.shape == (1, 6, 10)
+    # torch cross-check
+    import torch
+    want = torch.nn.functional.conv1d(torch.from_numpy(x), torch.from_numpy(w),
+                                      torch.from_numpy(b), padding=1).numpy()
+    np.testing.assert_allclose(y, want, atol=1e-4)
+
+    wd = rng.standard_normal((4, 1, 3)).astype(np.float32)
+    yd = ops.depthwise_conv1d(jnp.asarray(x), jnp.asarray(wd), padding=2)
+    wantd = torch.nn.functional.conv1d(torch.from_numpy(x), torch.from_numpy(wd),
+                                       padding=2, groups=4).numpy()
+    np.testing.assert_allclose(yd, wantd, atol=1e-4)
+
+
+def test_causal_depthwise_conv_update_matches_full(rng):
+    """Streaming single-step conv must equal the full causal conv."""
+    b, c, t, k = 1, 3, 6, 4
+    x = rng.standard_normal((b, c, t)).astype(np.float32)
+    w = rng.standard_normal((c, 1, k)).astype(np.float32)
+    # full causal conv: left-pad k-1
+    import torch
+    xp = torch.nn.functional.pad(torch.from_numpy(x), (k - 1, 0))
+    full = torch.nn.functional.conv1d(xp, torch.from_numpy(w), groups=c).numpy()
+    state = jnp.zeros((b, c, k - 1), jnp.float32)
+    outs = []
+    for i in range(t):
+        y, state = ops.causal_depthwise_conv1d_update(
+            jnp.asarray(x[:, :, i]), state, jnp.asarray(w), activation=None)
+        outs.append(np.asarray(y))
+    got = np.stack(outs, axis=-1)
+    np.testing.assert_allclose(got, full, atol=1e-5)
+
+
+def test_conv2d(rng):
+    x = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((5, 3, 3, 3)).astype(np.float32)
+    y = ops.conv2d(jnp.asarray(x), jnp.asarray(w), stride=2, padding=1)
+    import torch
+    want = torch.nn.functional.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                                      stride=2, padding=1).numpy()
+    np.testing.assert_allclose(y, want, atol=1e-4)
+
+
+class TestSampling:
+    def test_argmax(self):
+        logits = jnp.asarray([0.1, 5.0, -2.0])
+        cfg = ops.SamplingConfig(temperature=0.0)
+        tok = ops.sample(logits, jax.random.PRNGKey(0), cfg)
+        assert int(tok) == 1
+
+    def test_gumbel_distribution(self):
+        logits = jnp.log(jnp.asarray([0.7, 0.2, 0.1]))
+        cfg = ops.SamplingConfig(temperature=1.0)
+        keys = jax.random.split(jax.random.PRNGKey(0), 400)
+        toks = jax.vmap(lambda k: ops.sample(logits, k, cfg))(keys)
+        freq = np.bincount(np.asarray(toks), minlength=3) / 400
+        np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.08)
+
+    def test_top_k_restricts(self):
+        logits = jnp.asarray([1.0, 0.9, 0.8, -10.0, -10.0])
+        cfg = ops.SamplingConfig(temperature=1.0, top_k=2)
+        keys = jax.random.split(jax.random.PRNGKey(1), 100)
+        toks = np.asarray(jax.vmap(lambda k: ops.sample(logits, k, cfg))(keys))
+        assert set(toks.tolist()) <= {0, 1}
+
+    def test_top_p_restricts(self):
+        logits = jnp.log(jnp.asarray([0.6, 0.3, 0.05, 0.05]))
+        cfg = ops.SamplingConfig(temperature=1.0, top_p=0.8)
+        keys = jax.random.split(jax.random.PRNGKey(2), 100)
+        toks = np.asarray(jax.vmap(lambda k: ops.sample(logits, k, cfg))(keys))
+        assert set(toks.tolist()) <= {0, 1}
+
+    def test_top_k_then_top_p(self):
+        logits = jnp.log(jnp.asarray([0.5, 0.3, 0.1, 0.1]))
+        cfg = ops.SamplingConfig(temperature=1.0, top_k=3, top_p=0.6)
+        keys = jax.random.split(jax.random.PRNGKey(3), 100)
+        toks = np.asarray(jax.vmap(lambda k: ops.sample(logits, k, cfg))(keys))
+        assert set(toks.tolist()) <= {0, 1}
+
+    def test_repeat_penalty_sign_aware(self):
+        logits = jnp.asarray([2.0, -2.0, 1.0])
+        recent = jnp.asarray([0, 1, -1, -1], jnp.int32)
+        out = np.asarray(ops.apply_repeat_penalty(logits, recent, 2.0))
+        np.testing.assert_allclose(out, [1.0, -4.0, 1.0])
+
+    def test_repeat_penalty_in_sample(self):
+        logits = jnp.asarray([5.0, 4.9, 0.0])
+        recent = jnp.asarray([0], jnp.int32)
+        cfg = ops.SamplingConfig(temperature=0.0, repeat_penalty=3.0)
+        tok = ops.sample(logits, jax.random.PRNGKey(0), cfg, recent)
+        assert int(tok) == 1
+
+    def test_push_recent_token(self):
+        ring = jnp.asarray([-1, -1, 7], jnp.int32)
+        out = ops.push_recent_token(ring, jnp.asarray(9, jnp.int32))
+        assert out.tolist() == [-1, 7, 9]

@@ -1,0 +1,4 @@
+from .common import (ModelConfig, SamplingConfig, TextModel, Token,
+                     config_from_dir, config_from_hf_dict, init_cache,
+                     init_params, tiny_config)
+from .registry import FAMILY_ADAPTERS, TEXT_FAMILIES, modality_for_arch

@@ -1,0 +1,8 @@
+from .cache import cache_reset, init_cache, update_kv_cache
+from .config import (ARCH_ADAPTERS, FAMILY_ADAPTERS, LayerSpec,
+                     LinearAttnConfig, ModelConfig, config_from_dir,
+                     config_from_hf_dict, detect_arch, tiny_config)
+from .layers import (block_forward, embed_tokens, forward_layers, init_params,
+                     lm_head_logits, make_rope)
+from .text_model import (LocalStage, SamplingConfig, TextModel, Token,
+                         bucket_for, render_chat)

@@ -1,0 +1,109 @@
+"""Static-shape KV cache.
+
+The reference cache concatenates/trims KV tensors per token
+(ref: models/common/cache.rs:163-210) — dynamic shapes that would force an
+XLA recompile every step. The TPU design preallocates fixed buffers and
+scatters new entries in, carrying an absolute-position array per layer
+(-1 = empty) that drives position-based masking (ops/attention.py):
+
+  * full-attention layers: buffer of max_seq_len, slot i holds position i;
+  * sliding-window layers: ring buffer of window size W, slot p%W holds
+    position p (ref cache.rs:173-182 trims instead — same visibility);
+  * linear-attention layers: O(1) recurrent + conv state instead of KV
+    (ref cache.rs:18-23,221-238 GDN states).
+
+The cache is a plain pytree (list of per-layer dicts + scalar pos) so it
+flows through jit/donate/shard unchanged. Each connection gets a fresh
+cache (ref worker.rs get_client_context / cache.as_new()).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .config import LayerSpec, LinearAttnConfig, ModelConfig
+
+
+def init_layer_cache(cfg: ModelConfig, spec: LayerSpec, batch: int,
+                     max_seq_len: int, dtype=jnp.bfloat16) -> dict:
+    if spec.kind == "linear":
+        la: LinearAttnConfig = cfg.linear_attn
+        conv_ch = (la.key_head_dim * la.num_key_heads * 2
+                   + la.value_head_dim * la.num_value_heads)
+        return {
+            "conv": jnp.zeros((batch, conv_ch, la.conv_kernel_dim - 1), dtype),
+            # delta-rule recurrent state kept in f32 (ref: GDN F32 state)
+            "state": jnp.zeros((batch, la.num_value_heads, la.key_head_dim,
+                                la.value_head_dim), jnp.float32),
+        }
+    size = max_seq_len if spec.window is None else min(spec.window, max_seq_len)
+    return {
+        "k": jnp.zeros((batch, size, cfg.num_key_value_heads, cfg.head_dim), dtype),
+        "v": jnp.zeros((batch, size, cfg.num_key_value_heads, cfg.head_dim), dtype),
+        "pos": jnp.full((batch, size), -1, jnp.int32),
+    }
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_seq_len: int,
+               dtype=jnp.bfloat16, layer_range: tuple[int, int] | None = None) -> dict:
+    """Cache for a contiguous layer range (workers hold only their range —
+    ref: partial VarBuilder loading, utils/mod.rs:251-333)."""
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    return {
+        "layers": [init_layer_cache(cfg, cfg.layer_spec(i), batch, max_seq_len, dtype)
+                   for i in range(lo, hi)],
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def update_kv_cache(layer_cache: dict, k_new, v_new, pos, valid_len=None):
+    """Write S new KV entries at absolute positions pos..pos+S-1.
+
+    k_new/v_new: [B, S, Hkv, D]; pos: traced scalar int32.
+    Ring semantics: slot = position % size. When S > size only the last
+    `size` entries are written (the earlier ones would be overwritten anyway),
+    keeping scatter indices unique.
+
+    valid_len (traced scalar, bucketed prefill): entries with index >=
+    valid_len are padding — their slots are remapped out-of-bounds so the
+    scatter drops them (jax default scatter mode drops OOB writes).
+    """
+    size = layer_cache["k"].shape[1]
+    s = k_new.shape[1]
+    if s > size:
+        # Keep the last `size` VALID entries: with bucketed-prefill padding
+        # the tail of k_new is garbage, so the slice starts at
+        # valid_len - size (clamped), not at s - size.
+        if valid_len is None:
+            start = jnp.asarray(s - size, jnp.int32)
+        else:
+            start = jnp.clip(valid_len - size, 0, s - size).astype(jnp.int32)
+        k_new = jax.lax.dynamic_slice_in_dim(k_new, start, size, axis=1)
+        v_new = jax.lax.dynamic_slice_in_dim(v_new, start, size, axis=1)
+        offset = start
+        s = size
+    else:
+        offset = jnp.asarray(0, jnp.int32)
+    idx = offset + jnp.arange(s, dtype=jnp.int32)          # [S] source indices
+    positions = pos + idx
+    slots = positions % size
+    if valid_len is not None:
+        slots = jnp.where(idx < valid_len, slots, size)    # OOB -> dropped
+    k = layer_cache["k"].at[:, slots].set(k_new, mode="drop")
+    v = layer_cache["v"].at[:, slots].set(v_new, mode="drop")
+    p = layer_cache["pos"].at[:, slots].set(positions[None, :], mode="drop")
+    return {"k": k, "v": v, "pos": p}
+
+
+def cache_reset(cache: dict) -> dict:
+    """Clear all state (ref: cache clear on Goodbye, worker.rs:364-384)."""
+    def zero_layer(lc):
+        out = {}
+        for name, buf in lc.items():
+            if name == "pos":
+                out[name] = jnp.full_like(buf, -1)
+            else:
+                out[name] = jnp.zeros_like(buf)
+        return out
+    return {"layers": [zero_layer(lc) for lc in cache["layers"]],
+            "pos": jnp.zeros_like(cache["pos"])}

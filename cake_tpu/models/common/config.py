@@ -1,0 +1,392 @@
+"""Unified text-model configuration.
+
+One generalized ModelConfig covers all text families, normalized from HF
+config.json by per-architecture adapters (ref: models/common/config.rs:86-150
+Config + per-family config.rs into_config()). Per-layer behavior (sliding
+window / rope / linear-attention / MoE interleaves) is resolved here into
+LayerSpec tuples so the model code is a single generic block driven by data.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+from ...ops.rope import RopeScaling
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearAttnConfig:
+    """Gated DeltaNet linear-attention hyperparameters
+    (ref: config.rs LinearAttnConfig; qwen3_5/linear_attention.rs)."""
+    layer_types: tuple[str, ...] = ()
+    conv_kernel_dim: int = 4
+    num_key_heads: int = 16
+    key_head_dim: int = 128
+    num_value_heads: int = 16
+    value_head_dim: int = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerSpec:
+    """Resolved per-layer behavior, consumed by the generic decoder block."""
+    kind: str = "full"            # 'full' | 'swa' | 'linear'
+    use_rope: bool = True
+    window: int | None = None     # sliding-window size when kind == 'swa'
+    is_moe: bool = False
+    norm_style: str = "pre"       # 'pre' | 'post' (OLMo2) | 'sandwich' (Gemma3)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    arch: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    head_dim: int = 128
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: RopeScaling | None = None
+    partial_rotary_factor: float = 1.0
+    max_seq_len: int = 4096
+    bos_token_id: int | None = None
+    eos_token_ids: tuple[int, ...] = ()
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False
+    fused_qkv: bool = False        # Phi-3/4 pre-fused qkv_proj
+    fused_gate_up: bool = False    # Phi-3/4 pre-fused gate_up_proj
+    qk_norm: bool = False
+    qk_norm_pre_reshape: bool = False  # OLMo2: norm full q/k before head split
+    residual_rms_norm: bool = False    # (1+w) norms (Gemma3, Qwen3.5)
+    norm_style: str = "pre"
+    sliding_window: int | None = None
+    global_layers: tuple[bool, ...] = ()   # per-layer global flag (Gemma3/EXAONE4)
+    global_rope: bool = True       # EXAONE4 global layers: NoPE
+    local_rope: bool = True        # Gemma3 local layers: no RoPE (reference parity)
+    hidden_act: str = "silu"       # 'silu' | 'gelu_tanh'
+    embed_scale: float | None = None
+    model_prefix: str = "model"
+    # MoE
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int | None = None
+    norm_topk_prob: bool = False
+    shared_expert_intermediate_size: int | None = None
+    moe_gate_act: str = "softmax"  # 'softmax' | 'sigmoid' (Qwen3.5 MoE shared gate)
+    decoder_sparse_step: int = 1
+    mlp_only_layers: tuple[int, ...] = ()
+    # Linear (recurrent) attention
+    linear_attn: LinearAttnConfig | None = None
+    attn_output_gate: bool = False
+    # Attention logit scale override (None = head_dim**-0.5); Gemma3 models
+    # may set query_pre_attn_scalar.
+    attn_scale: float | None = None
+
+    # ---- per-layer resolution ----
+
+    def layer_spec(self, i: int) -> LayerSpec:
+        if self.linear_attn is not None and i < len(self.linear_attn.layer_types):
+            if self.linear_attn.layer_types[i] == "linear_attention":
+                return LayerSpec(kind="linear", use_rope=False,
+                                 is_moe=self._layer_is_moe(i),
+                                 norm_style=self.norm_style)
+        if self.global_layers:
+            is_global = self.global_layers[i] if i < len(self.global_layers) else True
+            if is_global:
+                return LayerSpec(kind="full", use_rope=self.global_rope,
+                                 is_moe=self._layer_is_moe(i),
+                                 norm_style=self.norm_style)
+            return LayerSpec(kind="swa", use_rope=self.local_rope,
+                             window=self.sliding_window,
+                             is_moe=self._layer_is_moe(i),
+                             norm_style=self.norm_style)
+        if self.sliding_window is not None:
+            return LayerSpec(kind="swa", use_rope=True, window=self.sliding_window,
+                             is_moe=self._layer_is_moe(i), norm_style=self.norm_style)
+        return LayerSpec(kind="full", use_rope=True,
+                         is_moe=self._layer_is_moe(i), norm_style=self.norm_style)
+
+    def _layer_is_moe(self, i: int) -> bool:
+        if self.num_experts == 0 or i in self.mlp_only_layers:
+            return False
+        return (i + 1) % max(self.decoder_sparse_step, 1) == 0
+
+    def layer_specs(self) -> tuple[LayerSpec, ...]:
+        return tuple(self.layer_spec(i) for i in range(self.num_hidden_layers))
+
+    @property
+    def size_q(self) -> int:
+        return self.head_dim * self.num_attention_heads
+
+    @property
+    def size_kv(self) -> int:
+        return self.head_dim * self.num_key_value_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        return int(self.head_dim * self.partial_rotary_factor)
+
+    def is_eos(self, token_id: int) -> bool:
+        return token_id in self.eos_token_ids
+
+
+def _eos_tuple(v) -> tuple[int, ...]:
+    """eos_token_id is a single int or an array (ref: config.rs EosTokenId)."""
+    if v is None:
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    return tuple(int(x) for x in v)
+
+
+def _rope_scaling(d: dict | None) -> RopeScaling | None:
+    if not d:
+        return None
+    return RopeScaling(
+        factor=float(d.get("factor", 1.0)),
+        high_freq_factor=float(d.get("high_freq_factor", 4.0)),
+        low_freq_factor=float(d.get("low_freq_factor", 1.0)),
+        original_max_position_embeddings=int(
+            d.get("original_max_position_embeddings", 8192)),
+        rope_type=d.get("rope_type") or d.get("type"),
+    )
+
+
+def _base(d: dict, arch: str, **over) -> dict:
+    """Common HF fields shared by every family."""
+    heads = int(d["num_attention_heads"])
+    hidden = int(d["hidden_size"])
+    out = dict(
+        arch=arch,
+        vocab_size=int(d["vocab_size"]),
+        hidden_size=hidden,
+        intermediate_size=int(d["intermediate_size"]),
+        num_hidden_layers=int(d["num_hidden_layers"]),
+        num_attention_heads=heads,
+        num_key_value_heads=int(d.get("num_key_value_heads") or heads),
+        head_dim=int(d.get("head_dim") or hidden // heads),
+        rms_norm_eps=float(d.get("rms_norm_eps", 1e-5)),
+        rope_theta=float(d.get("rope_theta", 10000.0)),
+        rope_scaling=_rope_scaling(d.get("rope_scaling")),
+        max_seq_len=int(d.get("max_position_embeddings", 4096)),
+        bos_token_id=d.get("bos_token_id"),
+        eos_token_ids=_eos_tuple(d.get("eos_token_id")),
+        tie_word_embeddings=bool(d.get("tie_word_embeddings", False)),
+    )
+    out.update(over)
+    return out
+
+
+def _llama(d):
+    return ModelConfig(**_base(d, "llama"))
+
+
+def _qwen2(d):
+    return ModelConfig(**_base(d, "qwen2", qkv_bias=True))
+
+
+def _qwen3(d):
+    return ModelConfig(**_base(d, "qwen3", qk_norm=True))
+
+
+def _qwen3_moe(d):
+    return ModelConfig(**_base(
+        d, "qwen3_moe", qk_norm=True,
+        num_experts=int(d.get("num_experts", 128)),
+        num_experts_per_tok=int(d.get("num_experts_per_tok", 8)),
+        moe_intermediate_size=int(d["moe_intermediate_size"]),
+        norm_topk_prob=bool(d.get("norm_topk_prob", True)),
+        decoder_sparse_step=int(d.get("decoder_sparse_step", 1)),
+        mlp_only_layers=tuple(d.get("mlp_only_layers", ())),
+    ))
+
+
+def _phi4(d):
+    return ModelConfig(**_base(
+        d, "phi4", fused_qkv=True, fused_gate_up=True,
+        partial_rotary_factor=float(d.get("partial_rotary_factor", 1.0)),
+    ))
+
+
+def _mistral(d):
+    return ModelConfig(**_base(
+        d, "mistral",
+        sliding_window=d.get("sliding_window"),
+    ))
+
+
+def _gemma3(d):
+    """Gemma3: interleaved local(SWA, no RoPE)/global per 6 layers, sandwich
+    norms with (1+w) weights, GELU-tanh MLP, embeddings scaled by sqrt(h),
+    always-tied lm_head (ref: gemma3/config.rs into_config)."""
+    n = int(d["num_hidden_layers"])
+    pattern = int(d.get("sliding_window_pattern", 6))
+    sched = d.get("sliding_window_attention_schedule") or []
+    if sched:
+        global_layers = tuple(bool(x) for x in sched[:n])
+    else:
+        global_layers = tuple((i + 1) % pattern == 0 for i in range(n))
+    return ModelConfig(**_base(
+        d, "gemma3",
+        rope_theta=float(d.get("rope_theta", 10000.0)),
+        qk_norm=True, residual_rms_norm=True, norm_style="sandwich",
+        sliding_window=int(d.get("sliding_window", 1024)),
+        global_layers=global_layers, local_rope=False,
+        hidden_act="gelu_tanh",
+        embed_scale=float(d["hidden_size"]) ** 0.5,
+        tie_word_embeddings=True,
+        attn_scale=(float(d["query_pre_attn_scalar"]) ** -0.5
+                    if d.get("query_pre_attn_scalar") else None),
+    ))
+
+
+def _falcon3(d):
+    return ModelConfig(**_base(d, "falcon3"))
+
+
+def _olmo2(d):
+    return ModelConfig(**_base(
+        d, "olmo2", qk_norm=True, qk_norm_pre_reshape=True, norm_style="post",
+    ))
+
+
+def _exaone4(d):
+    """EXAONE 4.0: 3 local(SWA+RoPE) : 1 global(full, NoPE), QK-norm
+    (ref: exaone4/config.rs into_config, exaone4/block.rs:55-67)."""
+    n = int(d["num_hidden_layers"])
+    period = int(d.get("global_layer_period") or 4)
+    global_layers = tuple((i + 1) % period == 0 for i in range(n))
+    return ModelConfig(**_base(
+        d, "exaone4", qk_norm=True,
+        sliding_window=int(d.get("sliding_window", 4096)),
+        global_layers=global_layers, global_rope=False,
+    ))
+
+
+def _qwen3_5_common(d, arch, **over):
+    """Qwen3.5 wraps the text fields in text_config; hybrid GDN linear
+    attention from layer_types (ref: qwen3_5/config.rs:95-160)."""
+    tc = d.get("text_config", d)
+    rp = tc.get("rope_parameters") or {}
+    layer_types = tuple(tc.get("layer_types", ()))
+    linear = None
+    if layer_types:
+        linear = LinearAttnConfig(
+            layer_types=layer_types,
+            conv_kernel_dim=int(tc.get("linear_conv_kernel_dim", 4)),
+            num_key_heads=int(tc.get("linear_num_key_heads", 16)),
+            key_head_dim=int(tc.get("linear_key_head_dim", 128)),
+            num_value_heads=int(tc.get("linear_num_value_heads", 16)),
+            value_head_dim=int(tc.get("linear_value_head_dim", 128)),
+        )
+    base = _base(
+        tc, arch,
+        rope_theta=float(rp.get("rope_theta", 10000.0)),
+        partial_rotary_factor=float(rp.get("partial_rotary_factor", 0.25)),
+        residual_rms_norm=True,
+        model_prefix="model.language_model",
+        linear_attn=linear,
+        tie_word_embeddings=bool(d.get("tie_word_embeddings", False)
+                                 or tc.get("tie_word_embeddings", False)),
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+def _qwen3_5(d):
+    return _qwen3_5_common(d, "qwen3_5")
+
+
+def _qwen3_5_moe(d):
+    tc = d.get("text_config", d)
+    return _qwen3_5_common(
+        d, "qwen3_5_moe",
+        num_experts=int(tc.get("num_experts", 256)),
+        num_experts_per_tok=int(tc.get("num_experts_per_tok", 8)),
+        moe_intermediate_size=int(tc["moe_intermediate_size"]),
+        norm_topk_prob=bool(tc.get("norm_topk_prob", True)),
+        shared_expert_intermediate_size=tc.get("shared_expert_intermediate_size"),
+        moe_gate_act="sigmoid",
+        attn_output_gate=bool(tc.get("attn_output_gate", True)),
+        decoder_sparse_step=int(tc.get("decoder_sparse_step", 1)),
+        mlp_only_layers=tuple(tc.get("mlp_only_layers", ())),
+    )
+
+
+# HF architectures string -> adapter (ref: cake/mod.rs arch_str_to_text_model_arch;
+# unknown strings fall back to llama, matching the reference)
+ARCH_ADAPTERS = {
+    "LlamaForCausalLM": _llama,
+    "Qwen2ForCausalLM": _qwen2,
+    "Qwen3ForCausalLM": _qwen3,
+    "Qwen3MoeForCausalLM": _qwen3_moe,
+    "Qwen3_5ForConditionalGeneration": _qwen3_5,
+    "Qwen3_5MoeForConditionalGeneration": _qwen3_5_moe,
+    "Phi3ForCausalLM": _phi4,
+    "Phi4ForCausalLM": _phi4,
+    "MistralForCausalLM": _mistral,
+    "Gemma3ForCausalLM": _gemma3,
+    "FalconForCausalLM": _falcon3,
+    "OLMo2ForCausalLM": _olmo2,
+    "Olmo2ForCausalLM": _olmo2,
+    "ExaoneForCausalLM": _exaone4,
+    "Exaone4ForCausalLM": _exaone4,
+}
+
+# short family names (CLI --arch overrides, tests)
+FAMILY_ADAPTERS = {
+    "llama": _llama, "llama3": _llama,
+    "qwen2": _qwen2, "qwen3": _qwen3, "qwen3_moe": _qwen3_moe,
+    "qwen3_5": _qwen3_5, "qwen3_5_moe": _qwen3_5_moe,
+    "phi4": _phi4, "phi3": _phi4,
+    "mistral": _mistral, "gemma3": _gemma3, "falcon3": _falcon3,
+    "olmo2": _olmo2, "exaone4": _exaone4,
+}
+
+
+def detect_arch(config: dict) -> str:
+    """First architectures entry (ref: config.rs detect_text_model_arch)."""
+    archs = config.get("architectures") or []
+    return archs[0] if archs else ""
+
+
+def config_from_hf_dict(d: dict, arch: str | None = None) -> ModelConfig:
+    name = arch or detect_arch(d)
+    adapter = ARCH_ADAPTERS.get(name) or FAMILY_ADAPTERS.get(name, _llama)
+    return adapter(d)
+
+
+def config_from_dir(model_dir: str, arch: str | None = None) -> ModelConfig:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        d = json.load(f)
+    return config_from_hf_dict(d, arch)
+
+
+def tiny_config(arch: str = "llama", **over) -> ModelConfig:
+    """Tiny synthetic config for tests (mirrors ref tests/unit_tests/helpers.rs:
+    hidden=64, 4 layers, GQA 4/2)."""
+    d: dict[str, Any] = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=128,
+        eos_token_id=2,
+    )
+    if arch in ("qwen3_moe", "qwen3_5_moe"):
+        d.update(num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32)
+    d.update(over)
+    if arch in ("qwen3_5", "qwen3_5_moe"):
+        d["text_config"] = dict(d)
+        n = d["num_hidden_layers"]
+        d["text_config"]["layer_types"] = [
+            "linear_attention" if (i + 1) % 4 else "full_attention"
+            for i in range(n)]
+        d["text_config"].update(
+            head_dim=16, linear_conv_kernel_dim=4, linear_num_key_heads=4,
+            linear_key_head_dim=16, linear_num_value_heads=4,
+            linear_value_head_dim=16)
+        d["text_config"].update(over)
+    return FAMILY_ADAPTERS[arch](d)

@@ -1,0 +1,313 @@
+"""Generic decoder-layer machinery: one config-driven block implementation
+covers every dense text family (ref: models/common/{attention.rs,mlp.rs,
+transformer.rs} + the per-family thin blocks).
+
+Functional style: parameters are nested dicts (pytrees), forwards are pure
+functions closed over the static ModelConfig/LayerSpec — jit compiles a
+contiguous layer range into a single XLA program (the TPU replacement for
+the reference's per-layer Box<dyn Forwarder> dispatch).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import (apply_rope, embedding, gelu_mul, linear,
+                    make_attention_mask, multi_head_attention, rms_norm,
+                    rope_tables, silu_mul)
+from ...ops.moe import moe_ffn
+from .cache import update_kv_cache
+from .config import LayerSpec, ModelConfig
+
+# ---------------------------------------------------------------------------
+# Parameter initialization (random weights; checkpoint loading lives in
+# utils/loaders.py which produces the same pytree layout)
+# ---------------------------------------------------------------------------
+
+
+def _norm_shape(cfg: ModelConfig):
+    return (cfg.hidden_size,)
+
+
+def init_attention_params(cfg: ModelConfig, spec: LayerSpec, key, dtype):
+    ks = jax.random.split(key, 4)
+    sq, skv, h = cfg.size_q, cfg.size_kv, cfg.hidden_size
+    q_out = 2 * sq if (cfg.attn_output_gate and spec.kind == "full") else sq
+    std = 0.02
+    p = {
+        "wqkv": jax.random.normal(ks[0], (q_out + 2 * skv, h), dtype) * std,
+        "o_proj": jax.random.normal(ks[1], (h, sq), dtype) * std,
+    }
+    if cfg.qkv_bias:
+        p["bqkv"] = jnp.zeros((q_out + 2 * skv,), dtype)
+    if cfg.qk_norm:
+        if cfg.qk_norm_pre_reshape:
+            p["q_norm"] = jnp.ones((sq,), dtype)
+            p["k_norm"] = jnp.ones((skv,), dtype)
+        else:
+            p["q_norm"] = jnp.ones((cfg.head_dim,), dtype)
+            p["k_norm"] = jnp.ones((cfg.head_dim,), dtype)
+    return p
+
+
+def init_mlp_params(cfg: ModelConfig, key, dtype):
+    k1, k2 = jax.random.split(key)
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    return {
+        "gate_up": jax.random.normal(k1, (2 * i, h), dtype) * 0.02,
+        "down": jax.random.normal(k2, (h, i), dtype) * 0.02,
+    }
+
+
+def init_moe_params(cfg: ModelConfig, key, dtype):
+    ks = jax.random.split(key, 6)
+    h, e = cfg.hidden_size, cfg.num_experts
+    i = cfg.moe_intermediate_size
+    p = {
+        "router": jax.random.normal(ks[0], (e, h), dtype) * 0.02,
+        "gate_up": jax.random.normal(ks[1], (e, 2 * i, h), dtype) * 0.02,
+        "down": jax.random.normal(ks[2], (e, h, i), dtype) * 0.02,
+    }
+    if cfg.shared_expert_intermediate_size:
+        si = cfg.shared_expert_intermediate_size
+        p["shared_gate_up"] = jax.random.normal(ks[3], (2 * si, h), dtype) * 0.02
+        p["shared_down"] = jax.random.normal(ks[4], (h, si), dtype) * 0.02
+        p["shared_gate"] = jax.random.normal(ks[5], (1, h), dtype) * 0.02
+    return p
+
+
+def init_layer_params(cfg: ModelConfig, spec: LayerSpec, key, dtype):
+    ks = jax.random.split(key, 2)
+    p: dict = {}
+    if spec.kind == "linear":
+        from ..qwen3_5 import init_gdn_params  # lazy: GDN lives with its family
+        p["linear_attn"] = init_gdn_params(cfg, ks[0], dtype)
+    else:
+        p["self_attn"] = init_attention_params(cfg, spec, ks[0], dtype)
+    p["mlp"] = (init_moe_params(cfg, ks[1], dtype) if spec.is_moe
+                else init_mlp_params(cfg, ks[1], dtype))
+    ones = jnp.ones(_norm_shape(cfg), dtype)
+    if spec.norm_style == "pre":
+        p["input_layernorm"] = {"weight": ones}
+        p["post_attention_layernorm"] = {"weight": ones}
+    elif spec.norm_style == "post":
+        p["post_attention_layernorm"] = {"weight": ones}
+        p["post_feedforward_layernorm"] = {"weight": ones}
+    elif spec.norm_style == "sandwich":
+        p["input_layernorm"] = {"weight": ones}
+        p["post_attention_layernorm"] = {"weight": ones}
+        p["pre_feedforward_layernorm"] = {"weight": ones}
+        p["post_feedforward_layernorm"] = {"weight": ones}
+    return p
+
+
+def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16,
+                layer_range: tuple[int, int] | None = None,
+                include_embed: bool | None = None,
+                include_head: bool | None = None) -> dict:
+    """Build the parameter pytree. layer_range selects a contiguous subset of
+    layers (worker partial load — ref: utils/mod.rs:251-333); embed/head
+    default to included iff the range touches the first/last layer."""
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    if include_embed is None:
+        include_embed = lo == 0
+    if include_head is None:
+        include_head = hi == cfg.num_hidden_layers
+    if include_head and cfg.tie_word_embeddings:
+        include_embed = True  # tied head reads the embedding table
+    keys = jax.random.split(key, cfg.num_hidden_layers + 2)
+    params: dict = {"layers": [
+        init_layer_params(cfg, cfg.layer_spec(i), keys[i], dtype)
+        for i in range(lo, hi)
+    ]}
+    if include_embed:
+        params["embed_tokens"] = {
+            "weight": jax.random.normal(keys[-1], (cfg.vocab_size, cfg.hidden_size),
+                                        dtype) * 0.02}
+    if include_head:
+        params["norm"] = {"weight": jnp.ones(_norm_shape(cfg), dtype)}
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {
+                "weight": jax.random.normal(keys[-2],
+                                            (cfg.vocab_size, cfg.hidden_size),
+                                            dtype) * 0.02}
+    params["rope"] = make_rope(cfg)
+    return params
+
+
+def make_rope(cfg: ModelConfig) -> dict:
+    cos, sin = rope_tables(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta,
+                           cfg.rope_scaling)
+    return {"cos": cos, "sin": sin}
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
+                      layer_cache: dict, pos0, rope: dict, valid_len=None):
+    """x: [B, S, H], pos0: traced scalar (first absolute position).
+    Returns (y [B, S, H], new_layer_cache)."""
+    b, s, _ = x.shape
+    hq, hkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    sq, skv = cfg.size_q, cfg.size_kv
+    gated = cfg.attn_output_gate and spec.kind == "full"
+    q_out = 2 * sq if gated else sq
+
+    qkv = linear(x, p["wqkv"], p.get("bqkv"))
+    q = qkv[..., :q_out]
+    k = qkv[..., q_out:q_out + skv]
+    v = qkv[..., q_out + skv:]
+
+    gate = None
+    if gated:
+        # q_proj emits 2x heads; per-head [q, gate] interleave -> sigmoid gate
+        # on the attention output (ref: qwen3_5_moe attn_output_gate).
+        qg = q.reshape(b, s, hq, 2 * d)
+        q, gate = qg[..., :d].reshape(b, s, sq), qg[..., d:].reshape(b, s, sq)
+
+    if cfg.qk_norm and cfg.qk_norm_pre_reshape:
+        q = rms_norm(q, p["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"], cfg.rms_norm_eps)
+
+    q = q.reshape(b, s, hq, d)
+    k = k.reshape(b, s, hkv, d)
+    v = v.reshape(b, s, hkv, d)
+
+    if cfg.qk_norm and not cfg.qk_norm_pre_reshape:
+        q = rms_norm(q, p["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"], cfg.rms_norm_eps)
+
+    positions = pos0 + jnp.arange(s, dtype=jnp.int32)
+    if spec.use_rope:
+        q = apply_rope(q, rope["cos"], rope["sin"], positions, cfg.rotary_dim)
+        k = apply_rope(k, rope["cos"], rope["sin"], positions, cfg.rotary_dim)
+
+    # Attend over [previous cache ; in-pass K/V]. In-pass keys must be
+    # presented in full (not through the ring): with a window-sized ring,
+    # early prefill queries need keys the ring has already evicted.
+    idx = jnp.arange(s, dtype=jnp.int32)
+    kv_pos_new = positions if valid_len is None else jnp.where(
+        idx < valid_len, positions, -1)                    # pads invisible
+    kv_pos = jnp.concatenate([
+        layer_cache["pos"],
+        jnp.broadcast_to(kv_pos_new[None, :], (b, s))], axis=1)
+    k_all = jnp.concatenate([layer_cache["k"], k], axis=1)
+    v_all = jnp.concatenate([layer_cache["v"], v], axis=1)
+    q_pos = jnp.broadcast_to(positions[None, :], (b, s))
+    mask = make_attention_mask(q_pos, kv_pos, window=spec.window)
+    y = multi_head_attention(q, k_all, v_all, mask, scale=cfg.attn_scale)
+    new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
+    y = y.reshape(b, s, sq)
+    if gate is not None:
+        y = y * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(y.dtype)
+    return linear(y, p["o_proj"]), new_cache
+
+
+def mlp_forward(cfg: ModelConfig, p: dict, x):
+    """Fused gate_up matmul -> silu_mul / gelu_mul -> down
+    (ref: models/common/mlp.rs:11-60)."""
+    i = p["gate_up"].shape[0] // 2
+    gu = linear(x, p["gate_up"])
+    gate, up = gu[..., :i], gu[..., i:]
+    h = gelu_mul(gate, up) if cfg.hidden_act == "gelu_tanh" else silu_mul(gate, up)
+    return linear(h, p["down"])
+
+
+def moe_forward(cfg: ModelConfig, p: dict, x):
+    b, s, h = x.shape
+    flat = x.reshape(b * s, h)
+    y = moe_ffn(flat, p["router"], p["gate_up"], p["down"],
+                cfg.num_experts_per_tok, cfg.norm_topk_prob,
+                cfg.moe_gate_act,
+                "gelu" if cfg.hidden_act == "gelu_tanh" else "silu")
+    if "shared_gate_up" in p:
+        # always-active shared expert, sigmoid-gated (ref: qwen3_5_moe/moe.rs)
+        si = p["shared_gate_up"].shape[0] // 2
+        gu = linear(flat, p["shared_gate_up"])
+        sh = silu_mul(gu[..., :si], gu[..., si:])
+        sh = linear(sh, p["shared_down"])
+        g = jax.nn.sigmoid(linear(flat, p["shared_gate"]).astype(jnp.float32))
+        y = y + sh * g.astype(sh.dtype)
+    return y.reshape(b, s, h)
+
+
+def _ffn(cfg, spec, p, x):
+    return moe_forward(cfg, p["mlp"], x) if spec.is_moe \
+        else mlp_forward(cfg, p["mlp"], x)
+
+
+def _attn(cfg, spec, p, x, lc, pos0, rope, valid_len=None):
+    if spec.kind == "linear":
+        from ..qwen3_5 import gdn_forward
+        return gdn_forward(cfg, p["linear_attn"], x, lc, pos0, valid_len)
+    return attention_forward(cfg, spec, p["self_attn"], x, lc, pos0, rope,
+                             valid_len)
+
+
+def block_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
+                  layer_cache: dict, pos0, rope: dict, valid_len=None):
+    """One decoder block; norm placement per family
+    (ref: common/transformer.rs pre-norm; olmo2/block.rs post-norm;
+    gemma3/block.rs sandwich)."""
+    eps = cfg.rms_norm_eps
+    if spec.norm_style == "pre":
+        h = rms_norm(x, p["input_layernorm"]["weight"], eps)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len)
+        x = x + attn_out
+        h = rms_norm(x, p["post_attention_layernorm"]["weight"], eps)
+        x = x + _ffn(cfg, spec, p, h)
+    elif spec.norm_style == "post":
+        attn_out, layer_cache = _attn(cfg, spec, p, x, layer_cache, pos0, rope, valid_len)
+        x = x + rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
+        x = x + rms_norm(_ffn(cfg, spec, p, x),
+                         p["post_feedforward_layernorm"]["weight"], eps)
+    elif spec.norm_style == "sandwich":
+        h = rms_norm(x, p["input_layernorm"]["weight"], eps)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len)
+        attn_out = rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
+        x = x + attn_out
+        h = rms_norm(x, p["pre_feedforward_layernorm"]["weight"], eps)
+        ffn_out = rms_norm(_ffn(cfg, spec, p, h),
+                           p["post_feedforward_layernorm"]["weight"], eps)
+        x = x + ffn_out
+    else:
+        raise ValueError(f"unknown norm style {spec.norm_style}")
+    return x, layer_cache
+
+
+def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
+                   layer_range: tuple[int, int] | None = None, valid_len=None):
+    """Run a contiguous range of blocks over hidden states — the jit unit for
+    both local stages and remote workers (ref: Forwarder.forward_batch /
+    worker.rs op-batch execution, but compiled as ONE device program)."""
+    lo, hi = layer_range or (0, len(params["layers"]))
+    specs = cfg.layer_specs()[lo:hi]
+    new_layers = list(cache["layers"])
+    rope = params["rope"]
+    for j, spec in enumerate(specs):
+        x, new_layers[j] = block_forward(cfg, spec, params["layers"][j], x,
+                                         cache["layers"][j], pos0, rope,
+                                         valid_len)
+    advance = x.shape[1] if valid_len is None else valid_len
+    new_cache = {"layers": new_layers, "pos": pos0 + advance}
+    return x, new_cache
+
+
+def embed_tokens(cfg: ModelConfig, params: dict, tokens):
+    x = embedding(tokens, params["embed_tokens"]["weight"])
+    if cfg.embed_scale is not None:
+        # Gemma scales embeddings by sqrt(hidden) in the model dtype
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    return x
+
+
+def lm_head_logits(cfg: ModelConfig, params: dict, x_last):
+    """Final norm + head on the last position only (ref: text_model.rs:336-352
+    last-token lm_head)."""
+    h = rms_norm(x_last, params["norm"]["weight"], cfg.rms_norm_eps)
+    w = (params["embed_tokens"]["weight"] if cfg.tie_word_embeddings
+         else params["lm_head"]["weight"])
+    return linear(h, w).astype(jnp.float32)

@@ -1,0 +1,256 @@
+"""Generic decoder-only text model runtime.
+
+TPU replacement for the reference's TextModelBase (ref: models/common/
+text_model.rs): instead of a per-layer Forwarder loop with dynamic-shape KV
+concat, the model compiles
+
+  * one `prefill` program per (batch, padded-length-bucket) — the prompt is
+    right-padded to a power-of-two bucket and padded slots are dropped from
+    the KV scatter (ref hard-part #1: static shapes, bucketed prefill);
+  * one `decode_step` program — embed -> all local layers -> head -> sampling
+    entirely on device, only the 4-byte token id crosses the host boundary
+    per token (ref: text_model.rs GPU sampling / repeat penalty);
+  * one `decode_chunk` program — lax.scan over N decode steps for the
+    fully-local fast path: N tokens per host round-trip.
+
+Distributed layer sharding plugs in through `stages`: an ordered list of
+LocalStage (jit-compiled contiguous layer range) and remote stages (any
+object with forward_hidden(x, pos0, valid_len) — the TCP Client in
+cluster/client.py). This mirrors the reference's contiguous same-worker
+batching (text_model.rs:298-331) with the whole local range as ONE device
+program.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.sampling import SamplingConfig, push_recent_token, sample
+from .cache import init_cache
+from .config import ModelConfig
+from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
+
+PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def bucket_for(n: int, max_len: int) -> int:
+    for b in PREFILL_BUCKETS:
+        if n <= b:
+            return min(b, max_len)
+    return max_len
+
+
+@dataclass
+class Token:
+    id: int
+    text: str | None
+    is_end_of_stream: bool
+
+
+class LocalStage:
+    """A contiguous range of layers resident on this host's TPU."""
+
+    def __init__(self, cfg: ModelConfig, params: dict, lo: int, hi: int):
+        self.cfg, self.params, self.lo, self.hi = cfg, params, lo, hi
+
+        @functools.partial(jax.jit, static_argnames=("padded",), donate_argnums=(2,))
+        def _fwd(params, x, cache, pos0, valid_len, padded):
+            del padded  # static marker to separate prefill/decode programs
+            return forward_layers(cfg, params, x, cache, pos0,
+                                  layer_range=(lo, hi), valid_len=valid_len)
+
+        self._fwd = _fwd
+
+    def forward_hidden(self, x, cache, pos0, valid_len):
+        return self._fwd(self.params, x, cache, pos0, valid_len,
+                         padded=x.shape[1])
+
+
+class TextModel:
+    """Single-process text model (all layers local). The distributed master
+    variant lives in cluster/master.py and reuses the same compiled pieces."""
+
+    def __init__(self, cfg: ModelConfig, params: dict | None = None,
+                 tokenizer=None, dtype=jnp.bfloat16, seed: int = 42,
+                 max_cache_len: int | None = None):
+        self.cfg = cfg
+        self.dtype = dtype
+        self.tokenizer = tokenizer
+        self.max_cache_len = min(max_cache_len or cfg.max_seq_len, cfg.max_seq_len)
+        if params is None:
+            params = init_params(cfg, jax.random.PRNGKey(seed), dtype)
+        self.params = params
+        self._rng = jax.random.PRNGKey(seed)
+        self._build()
+
+    # -- compiled programs --------------------------------------------------
+
+    def _build(self):
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def _prefill(params, tokens, cache, pos0, valid_len):
+            x = embed_tokens(cfg, params, tokens)
+            x, cache = forward_layers(cfg, params, x, cache, pos0,
+                                      valid_len=valid_len)
+            # logits at the last valid position
+            idx = jnp.clip(valid_len - 1, 0, x.shape[1] - 1)
+            x_last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
+            logits = lm_head_logits(cfg, params, x_last)[:, 0]
+            return logits, cache
+
+        @functools.partial(jax.jit, static_argnames=("scfg", "n"),
+                           donate_argnums=(2,))
+        def _decode_chunk(params, token, cache, rng, recent, scfg, n):
+            """lax.scan over n decode steps, sampling on device."""
+            def body(carry, _):
+                tok, cache, rng, recent = carry
+                rng, sk = jax.random.split(rng)
+                x = embed_tokens(cfg, params, tok[:, None])
+                x, cache = forward_layers(cfg, params, x, cache, cache["pos"])
+                logits = lm_head_logits(cfg, params, x)[:, -1]
+                nxt = sample(logits[0], sk, scfg, recent)
+                recent = push_recent_token(recent, nxt)
+                nxt_b = jnp.broadcast_to(nxt, tok.shape)
+                return (nxt_b, cache, rng, recent), nxt
+
+            (tok, cache, rng, recent), toks = jax.lax.scan(
+                body, (token, cache, rng, recent), None, length=n)
+            return toks, cache, rng, recent
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def _decode_step(params, token, cache):
+            """One decode step returning raw logits (distributed master path +
+            logit-parity tests)."""
+            x = embed_tokens(cfg, params, token[:, None])
+            x, cache = forward_layers(cfg, params, x, cache, cache["pos"])
+            logits = lm_head_logits(cfg, params, x)[:, -1]
+            return logits, cache
+
+        self._prefill = _prefill
+        self._decode_chunk = _decode_chunk
+        self._decode_step = _decode_step
+
+    # -- cache / state ------------------------------------------------------
+
+    def new_cache(self, batch: int = 1):
+        return init_cache(self.cfg, batch, self.max_cache_len, self.dtype)
+
+    # -- inference ----------------------------------------------------------
+
+    def prefill(self, cache, token_ids: Iterable[int], pos0: int = 0):
+        ids = list(token_ids)
+        n = len(ids)
+        bkt = bucket_for(n, self.max_cache_len)
+        if n > bkt:
+            raise ValueError(f"prompt length {n} exceeds cache {bkt}")
+        if pos0 + n > self.max_cache_len:
+            raise ValueError(
+                f"prefill past cache end: pos0={pos0} + {n} tokens > "
+                f"max_cache_len={self.max_cache_len}")
+        padded = np.zeros((1, bkt), np.int32)
+        padded[0, :n] = ids
+        logits, cache = self._prefill(self.params, jnp.asarray(padded), cache,
+                                      jnp.asarray(pos0, jnp.int32),
+                                      jnp.asarray(n, jnp.int32))
+        return logits, cache
+
+    def decode_logits(self, cache, token_id: int):
+        """Single-token decode returning raw [B, V] logits."""
+        return self._decode_step(self.params,
+                                 jnp.asarray([token_id], jnp.int32), cache)
+
+    def generate(self, prompt_ids: list[int], max_new_tokens: int = 256,
+                 sampling: SamplingConfig | None = None,
+                 on_token: Callable[[Token], None] | None = None,
+                 chunk: int = 16, rng=None) -> tuple[list[int], dict]:
+        """Streamed generation. Returns (token_ids, stats).
+
+        Decode runs in on-device chunks of `chunk` tokens; EOS is checked
+        between chunks (overshoot compute is wasted but state advances are
+        discarded past EOS).
+        """
+        cfg = self.cfg
+        scfg = sampling or SamplingConfig()
+        rng = self._rng if rng is None else rng
+        cache = self.new_cache(1)
+
+        t0 = time.monotonic()
+        logits, cache = self._prefill_start(prompt_ids, cache)
+        rng, sk = jax.random.split(rng)
+        recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
+        first = sample(logits[0], sk, scfg, recent)
+        recent = push_recent_token(recent, first)
+        ttft = time.monotonic() - t0
+
+        out: list[int] = []
+        tok_arr = first[None]
+        tid = int(first)
+        out.append(tid)
+        if on_token:
+            on_token(self._mk_token(tid))
+        done = cfg.is_eos(tid)
+
+        t1 = time.monotonic()
+        # never decode past the cache (full-attn buffers are not rings)
+        budget = self.max_cache_len - len(prompt_ids) - 1 - chunk
+        max_new_tokens = min(max_new_tokens, max(budget, 1))
+        while not done and len(out) < max_new_tokens:
+            n = min(chunk, max_new_tokens - len(out))
+            toks, cache, rng, recent = self._decode_chunk(
+                self.params, tok_arr, cache, rng, recent, scfg, n)
+            toks_np = np.asarray(toks)
+            for t in toks_np:
+                tid = int(t)
+                out.append(tid)
+                if on_token:
+                    on_token(self._mk_token(tid))
+                if cfg.is_eos(tid) or len(out) >= max_new_tokens:
+                    done = True
+                    break
+            tok_arr = jnp.asarray([out[-1]], jnp.int32)
+        dt = time.monotonic() - t1
+        stats = {
+            "ttft_s": ttft,
+            "decode_tokens": max(len(out) - 1, 0),
+            "decode_s": dt,
+            "tok_per_s": (len(out) - 1) / dt if dt > 0 and len(out) > 1 else 0.0,
+        }
+        return out, stats
+
+    def _prefill_start(self, prompt_ids, cache):
+        return self.prefill(cache, prompt_ids)
+
+    def _mk_token(self, tid: int) -> Token:
+        text = None
+        if self.tokenizer is not None:
+            try:
+                text = self.tokenizer.decode([tid])
+            except Exception:
+                text = None
+        return Token(id=tid, text=text, is_end_of_stream=self.cfg.is_eos(tid))
+
+    # -- chat ---------------------------------------------------------------
+
+    def chat_generate(self, messages: list[dict], **kw):
+        """Apply the tokenizer's chat template (fallback: ChatML —
+        ref: models/common/chatml_history.rs) and generate."""
+        prompt = render_chat(self.tokenizer, messages)
+        enc = self.tokenizer.encode(prompt)
+        ids = enc.ids if hasattr(enc, "ids") else enc
+        return self.generate(list(ids), **kw)
+
+
+def render_chat(tokenizer, messages: list[dict]) -> str:
+    """ChatML fallback template (ref: chatml_history.rs)."""
+    parts = []
+    for m in messages:
+        parts.append(f"<|im_start|>{m['role']}\n{m['content']}<|im_end|>\n")
+    parts.append("<|im_start|>assistant\n")
+    return "".join(parts)

@@ -1,0 +1,17 @@
+"""Qwen3.5 hybrid Gated DeltaNet linear attention.
+
+Placeholder module boundary: the GDN recurrent delta-rule scan, causal-conv
+state, and gated RMS norm (ref: models/qwen3_5/linear_attention.rs,
+qwen3_5/block.rs) land here; the generic block machinery in
+models/common/layers.py already routes `LayerSpec(kind="linear")` layers to
+init_gdn_params/gdn_forward.
+"""
+from __future__ import annotations
+
+
+def init_gdn_params(cfg, key, dtype):
+    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")
+
+
+def gdn_forward(cfg, p, x, layer_cache, pos0, valid_len=None):
+    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")

@@ -1,0 +1,134 @@
+"""Config normalization tests (mirrors ref per-family config.rs tests)."""
+import pytest
+
+from cake_tpu.models.common.config import (config_from_hf_dict, tiny_config)
+
+
+def base_dict(**over):
+    d = dict(
+        architectures=["LlamaForCausalLM"],
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        rms_norm_eps=1e-5, rope_theta=500000.0,
+        max_position_embeddings=8192,
+        eos_token_id=[128001, 128008, 128009],
+        bos_token_id=128000,
+    )
+    d.update(over)
+    return d
+
+
+def test_llama3():
+    c = config_from_hf_dict(base_dict(rope_scaling=dict(
+        factor=8.0, high_freq_factor=4.0, low_freq_factor=1.0,
+        original_max_position_embeddings=8192, rope_type="llama3")))
+    assert c.arch == "llama"
+    assert c.head_dim == 128
+    assert c.is_eos(128008) and not c.is_eos(0)
+    assert c.rope_scaling.factor == 8.0
+    assert all(s.kind == "full" for s in c.layer_specs())
+
+
+def test_unknown_arch_falls_back_to_llama():
+    c = config_from_hf_dict(base_dict(architectures=["SomethingNew"]))
+    assert c.arch == "llama"
+
+
+def test_qwen2_bias():
+    c = config_from_hf_dict(base_dict(architectures=["Qwen2ForCausalLM"]))
+    assert c.qkv_bias and not c.qk_norm
+
+
+def test_qwen3_qk_norm_and_head_dim():
+    c = config_from_hf_dict(base_dict(architectures=["Qwen3ForCausalLM"],
+                                      head_dim=64))
+    assert c.qk_norm and not c.qk_norm_pre_reshape
+    assert c.head_dim == 64 and not c.qkv_bias
+
+
+def test_qwen3_moe():
+    c = config_from_hf_dict(base_dict(
+        architectures=["Qwen3MoeForCausalLM"], num_experts=128,
+        num_experts_per_tok=8, moe_intermediate_size=768, norm_topk_prob=True))
+    assert c.num_experts == 128 and c.num_experts_per_tok == 8
+    assert all(s.is_moe for s in c.layer_specs())
+
+
+def test_phi4_fused_partial_rope():
+    c = config_from_hf_dict(base_dict(architectures=["Phi3ForCausalLM"],
+                                      partial_rotary_factor=0.25))
+    assert c.fused_qkv and c.fused_gate_up
+    assert c.rotary_dim == int(c.head_dim * 0.25)
+
+
+def test_mistral_sliding_window():
+    c = config_from_hf_dict(base_dict(architectures=["MistralForCausalLM"],
+                                      sliding_window=4096))
+    assert all(s.kind == "swa" and s.window == 4096 for s in c.layer_specs())
+
+
+def test_gemma3_pattern():
+    """Every 6th layer global; local = SWA + no RoPE (reference parity)."""
+    c = config_from_hf_dict(base_dict(
+        architectures=["Gemma3ForCausalLM"], num_hidden_layers=12,
+        sliding_window=1024, query_pre_attn_scalar=256))
+    specs = c.layer_specs()
+    assert [s.kind for s in specs] == (["swa"] * 5 + ["full"]) * 2
+    assert not specs[0].use_rope and specs[5].use_rope
+    assert c.norm_style == "sandwich" and c.residual_rms_norm
+    assert c.hidden_act == "gelu_tanh" and c.tie_word_embeddings
+    assert abs(c.embed_scale - 4096 ** 0.5) < 1e-6
+    assert abs(c.attn_scale - 256 ** -0.5) < 1e-9
+
+
+def test_olmo2_post_norm():
+    c = config_from_hf_dict(base_dict(architectures=["OLMo2ForCausalLM"]))
+    assert c.norm_style == "post" and c.qk_norm_pre_reshape
+
+
+def test_exaone4_pattern():
+    """3 local (SWA+RoPE) : 1 global (NoPE) — ref exaone4/config.rs tests."""
+    c = config_from_hf_dict(base_dict(
+        architectures=["ExaoneForCausalLM"], num_hidden_layers=32,
+        sliding_window=4096))
+    specs = c.layer_specs()
+    assert not specs[0].kind == "full" and specs[3].kind == "full"
+    assert specs[7].kind == "full" and specs[30].kind == "swa"
+    assert specs[0].use_rope and not specs[3].use_rope   # global = NoPE
+    assert c.qk_norm
+
+
+def test_qwen3_5_nested_text_config():
+    d = dict(
+        architectures=["Qwen3_5ForConditionalGeneration"],
+        tie_word_embeddings=True,
+        text_config=dict(
+            hidden_size=1024, intermediate_size=3584, vocab_size=248320,
+            num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=2,
+            head_dim=256, rms_norm_eps=1e-6,
+            rope_parameters=dict(rope_theta=5000000.0, partial_rotary_factor=0.25),
+            max_position_embeddings=32768,
+            layer_types=["linear_attention", "linear_attention",
+                         "linear_attention", "full_attention"] * 2,
+            linear_conv_kernel_dim=4, linear_num_key_heads=16,
+            linear_key_head_dim=128, linear_num_value_heads=32,
+            linear_value_head_dim=128,
+            eos_token_id=248045,
+        ))
+    c = config_from_hf_dict(d)
+    assert c.arch == "qwen3_5"
+    assert c.model_prefix == "model.language_model"
+    assert c.residual_rms_norm and c.tie_word_embeddings
+    assert c.rope_theta == 5000000.0 and c.partial_rotary_factor == 0.25
+    assert c.linear_attn.num_value_heads == 32
+    specs = c.layer_specs()
+    assert [s.kind for s in specs] == ["linear"] * 3 + ["full"] + ["linear"] * 3 + ["full"]
+
+
+def test_tiny_configs_build():
+    for fam in ("llama", "qwen2", "qwen3", "qwen3_moe", "phi4", "mistral",
+                "gemma3", "falcon3", "olmo2", "exaone4", "qwen3_5",
+                "qwen3_5_moe"):
+        c = tiny_config(fam)
+        assert c.num_hidden_layers == 4
+        assert len(c.layer_specs()) == 4

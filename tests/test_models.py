@@ -1,0 +1,149 @@
+"""Model machinery tests: every dense family's block loads and runs with a
+tiny synthetic config (mirrors ref tests/unit_tests/test_blocks.rs), plus
+the core KV-cache invariant: incremental decode logits == full-prefill
+logits at every position.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models import TextModel, init_params, tiny_config
+from cake_tpu.models.common.cache import init_cache, update_kv_cache
+from cake_tpu.ops.sampling import SamplingConfig
+
+DENSE_FAMILIES = ("llama", "qwen2", "qwen3", "phi4", "mistral", "gemma3",
+                  "falcon3", "olmo2", "exaone4", "qwen3_moe")
+
+
+def make_model(fam, **over):
+    cfg = tiny_config(fam, **over)
+    return TextModel(cfg, dtype=jnp.float32, max_cache_len=64)
+
+
+@pytest.mark.parametrize("fam", DENSE_FAMILIES)
+def test_prefill_decode_parity(fam):
+    """Prefill(t0..tn) must equal prefill(t0..tk) + decode(tk+1..tn)
+    — exercises cache scatter, masking, rope offsets, every norm style."""
+    model = make_model(fam)
+    toks = list(np.random.default_rng(0).integers(0, 255, size=9))
+
+    logits_full, _ = model.prefill(model.new_cache(), toks)
+
+    cache = model.new_cache()
+    _, cache = model.prefill(cache, toks[:5])
+    logits_inc = None
+    for t in toks[5:]:
+        logits_inc, cache = model.decode_logits(cache, int(t))
+    np.testing.assert_allclose(np.asarray(logits_inc), np.asarray(logits_full),
+                               atol=2e-3, rtol=1e-3)
+
+
+def test_padding_invariance():
+    """Bucketed prefill: logits must not depend on pad amount."""
+    model = make_model("llama")
+    toks = [1, 2, 3, 4, 5]
+    l1, _ = model.prefill(model.new_cache(), toks)          # bucket 32
+    # same tokens hand-padded to a LARGER bucket via the raw compiled entry
+    padded = np.zeros((1, 64), np.int32)
+    padded[0, :5] = toks
+    l2, _ = model._prefill(model.params, jnp.asarray(padded), model.new_cache(),
+                           jnp.asarray(0, jnp.int32), jnp.asarray(5, jnp.int32))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=2e-3,
+                               rtol=1e-3)
+    # chunked prefill across two calls must also agree
+    cache = model.new_cache()
+    _, cache = model.prefill(cache, toks[:2])
+    l3, _ = model.prefill(cache, toks[2:], pos0=2)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l3), atol=2e-3,
+                               rtol=1e-3)
+
+
+def test_prefill_past_cache_end_raises():
+    model = make_model("llama")  # max_cache_len 64
+    cache = model.new_cache()
+    _, cache = model.prefill(cache, list(range(1, 33)))
+    with pytest.raises(ValueError, match="prefill past cache end"):
+        model.prefill(cache, list(range(1, 33)), pos0=40)
+
+
+def test_tied_head_worker_partition_has_embed():
+    cfg = tiny_config("gemma3")  # tied embeddings
+    p = init_params(cfg, jax.random.PRNGKey(0), jnp.float32,
+                    layer_range=(2, 4))
+    assert "embed_tokens" in p and "norm" in p  # head needs the tied table
+
+
+def test_sliding_window_ring():
+    """SWA ring cache: old positions must be evicted and invisible."""
+    cfg = tiny_config("mistral", sliding_window=8)
+    model = TextModel(cfg, dtype=jnp.float32, max_cache_len=64)
+    toks = list(np.random.default_rng(1).integers(0, 255, size=20))
+    # incremental decode across >window tokens: ring wraps several times
+    cache = model.new_cache()
+    _, cache = model.prefill(cache, toks[:4])
+    for t in toks[4:]:
+        logits, cache = model.decode_logits(cache, int(t))
+    # reference computation: full prefill (mask enforces the same window)
+    logits_full, _ = model.prefill(model.new_cache(), toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_full),
+                               atol=2e-3, rtol=1e-3)
+    # ring buffer is physically window-sized
+    assert cache["layers"][0]["k"].shape[1] == 8
+
+
+def test_generate_streams_and_stops():
+    model = make_model("llama")
+    seen = []
+    toks, stats = model.generate([1, 2, 3], max_new_tokens=12,
+                                 sampling=SamplingConfig(temperature=0.0),
+                                 on_token=seen.append, chunk=4)
+    assert 1 <= len(toks) <= 12
+    assert [t.id for t in seen] == toks
+    assert stats["decode_tokens"] == len(toks) - 1
+    # greedy must be deterministic
+    toks2, _ = model.generate([1, 2, 3], max_new_tokens=12,
+                              sampling=SamplingConfig(temperature=0.0), chunk=4)
+    assert toks == toks2
+
+
+def test_generate_eos_stops():
+    model = make_model("llama")
+    # token 2 is EOS in tiny_config; force it via a cooked lm_head bias:
+    # instead just check that if EOS appears the stream ends with it
+    toks, _ = model.generate([1], max_new_tokens=50,
+                             sampling=SamplingConfig(temperature=1.0))
+    if any(model.cfg.is_eos(t) for t in toks):
+        assert model.cfg.is_eos(toks[-1])
+
+
+def test_moe_runs_and_routes():
+    model = make_model("qwen3_moe")
+    logits, _ = model.prefill(model.new_cache(), [1, 2, 3, 4])
+    assert logits.shape == (1, model.cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_worker_layer_range_params():
+    """Partial param init: a worker holding layers 1..3 has no embed/head."""
+    cfg = tiny_config("llama")
+    p = init_params(cfg, jax.random.PRNGKey(0), jnp.float32, layer_range=(1, 3))
+    assert "embed_tokens" not in p and "lm_head" not in p and "norm" not in p
+    assert len(p["layers"]) == 2
+    # cache for the same range
+    c = init_cache(cfg, 1, 32, jnp.float32, layer_range=(1, 3))
+    assert len(c["layers"]) == 2
+
+
+def test_update_kv_cache_wrap_and_drop():
+    lc = {
+        "k": jnp.zeros((1, 4, 1, 2)), "v": jnp.zeros((1, 4, 1, 2)),
+        "pos": jnp.full((1, 4), -1, jnp.int32),
+    }
+    k_new = jnp.arange(12, dtype=jnp.float32).reshape(1, 6, 1, 2)
+    out = update_kv_cache(lc, k_new, k_new, jnp.asarray(0), valid_len=jnp.asarray(6))
+    # 6 entries into ring of 4: positions 2..5 survive in slots 2,3,0,1
+    assert out["pos"][0].tolist() == [4, 5, 2, 3]
+    # valid_len drops tail: only first 2 of 6 written
+    out2 = update_kv_cache(lc, k_new, k_new, jnp.asarray(0), valid_len=jnp.asarray(2))
+    assert out2["pos"][0].tolist() == [0, 1, -1, -1]

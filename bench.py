@@ -1,0 +1,83 @@
+"""Headline benchmark: Qwen3-0.6B-shaped single-chip decode throughput.
+
+Prints ONE JSON line:
+  {"metric": "qwen3_0.6b_decode", "value": <tok/s>, "unit": "tok/s",
+   "vs_baseline": <value / 185.7>}
+
+Baseline: the reference's best published small-model decode — Qwen2.5-0.5B
+F16 at 185.7 tok/s on an RTX 3080 Laptop (BASELINE.md; the closest published
+number to the BASELINE.json north-star config). Random weights: throughput
+is weight-value independent, and the environment has no network egress.
+
+Usage: python bench.py [--smoke] [--tokens N] [--runs N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_TOK_S = 185.7
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny model quick check")
+    ap.add_argument("--tokens", type=int, default=256)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    args = ap.parse_args()
+
+    from cake_tpu.models import (SamplingConfig, TextModel, config_from_hf_dict,
+                                 tiny_config)
+    from __graft_entry__ import FLAGSHIP
+
+    if args.smoke:
+        cfg = tiny_config("qwen3")
+        cache_len = 128
+        args.tokens = min(args.tokens, 64)
+    else:
+        cfg = config_from_hf_dict(FLAGSHIP)
+        cache_len = 2048
+
+    model = TextModel(cfg, dtype=jnp.bfloat16, max_cache_len=cache_len)
+    prompt = list(np.random.default_rng(0).integers(
+        0, cfg.vocab_size - 1, size=args.prompt_len))
+    scfg = SamplingConfig(temperature=0.0)   # greedy, seeded (ref bench: temp=0)
+
+    # warmup / compile
+    model.generate(prompt, max_new_tokens=args.chunk, sampling=scfg,
+                   chunk=args.chunk)
+
+    rates, ttfts = [], []
+    for _ in range(args.runs):
+        toks, stats = model.generate(prompt, max_new_tokens=args.tokens,
+                                     sampling=scfg, chunk=args.chunk)
+        rates.append(stats["tok_per_s"])
+        ttfts.append(stats["ttft_s"])
+
+    value = float(np.mean(rates))
+    result = {
+        "metric": "qwen3_0.6b_decode" if not args.smoke else "smoke_decode",
+        "value": round(value, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(value / BASELINE_TOK_S, 3),
+    }
+    extra = {
+        "p50_ttft_s": round(float(np.median(ttfts)), 4),
+        "runs": args.runs, "tokens": args.tokens,
+        "device": str(jax.devices()[0]),
+        "dtype": "bfloat16",
+    }
+    print(json.dumps(result))
+    print(json.dumps({"detail": extra}), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

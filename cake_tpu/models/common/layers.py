@@ -30,32 +30,42 @@ def _norm_shape(cfg: ModelConfig):
 
 
 def init_attention_params(cfg: ModelConfig, spec: LayerSpec, key, dtype):
+    """Separate q/k/v/o projections (HF layout). The reference fuses QKV into
+    one matmul (ref: attention.rs:90-115) — a GPU bandwidth trick; on TPU,
+    separate tensors shard head-aligned over the tp axis and XLA fuses the
+    three GEMMs' epilogues anyway, so fusion would only break TP alignment.
+    Phi-4's pre-fused qkv_proj / gate_up_proj are split at load time."""
     ks = jax.random.split(key, 4)
     sq, skv, h = cfg.size_q, cfg.size_kv, cfg.hidden_size
     q_out = 2 * sq if (cfg.attn_output_gate and spec.kind == "full") else sq
     std = 0.02
     p = {
-        "wqkv": jax.random.normal(ks[0], (q_out + 2 * skv, h), dtype) * std,
-        "o_proj": jax.random.normal(ks[1], (h, sq), dtype) * std,
+        "q_proj": {"weight": jax.random.normal(ks[0], (q_out, h), dtype) * std},
+        "k_proj": {"weight": jax.random.normal(ks[1], (skv, h), dtype) * std},
+        "v_proj": {"weight": jax.random.normal(ks[2], (skv, h), dtype) * std},
+        "o_proj": {"weight": jax.random.normal(ks[3], (h, sq), dtype) * std},
     }
     if cfg.qkv_bias:
-        p["bqkv"] = jnp.zeros((q_out + 2 * skv,), dtype)
+        p["q_proj"]["bias"] = jnp.zeros((q_out,), dtype)
+        p["k_proj"]["bias"] = jnp.zeros((skv,), dtype)
+        p["v_proj"]["bias"] = jnp.zeros((skv,), dtype)
     if cfg.qk_norm:
         if cfg.qk_norm_pre_reshape:
-            p["q_norm"] = jnp.ones((sq,), dtype)
-            p["k_norm"] = jnp.ones((skv,), dtype)
+            p["q_norm"] = {"weight": jnp.ones((sq,), dtype)}
+            p["k_norm"] = {"weight": jnp.ones((skv,), dtype)}
         else:
-            p["q_norm"] = jnp.ones((cfg.head_dim,), dtype)
-            p["k_norm"] = jnp.ones((cfg.head_dim,), dtype)
+            p["q_norm"] = {"weight": jnp.ones((cfg.head_dim,), dtype)}
+            p["k_norm"] = {"weight": jnp.ones((cfg.head_dim,), dtype)}
     return p
 
 
-def init_mlp_params(cfg: ModelConfig, key, dtype):
-    k1, k2 = jax.random.split(key)
-    h, i = cfg.hidden_size, cfg.intermediate_size
+def init_mlp_params(cfg: ModelConfig, key, dtype, inter: int | None = None):
+    k1, k2, k3 = jax.random.split(key, 3)
+    h, i = cfg.hidden_size, inter or cfg.intermediate_size
     return {
-        "gate_up": jax.random.normal(k1, (2 * i, h), dtype) * 0.02,
-        "down": jax.random.normal(k2, (h, i), dtype) * 0.02,
+        "gate_proj": {"weight": jax.random.normal(k1, (i, h), dtype) * 0.02},
+        "up_proj": {"weight": jax.random.normal(k2, (i, h), dtype) * 0.02},
+        "down_proj": {"weight": jax.random.normal(k3, (h, i), dtype) * 0.02},
     }
 
 
@@ -64,15 +74,18 @@ def init_moe_params(cfg: ModelConfig, key, dtype):
     h, e = cfg.hidden_size, cfg.num_experts
     i = cfg.moe_intermediate_size
     p = {
-        "router": jax.random.normal(ks[0], (e, h), dtype) * 0.02,
-        "gate_up": jax.random.normal(ks[1], (e, 2 * i, h), dtype) * 0.02,
-        "down": jax.random.normal(ks[2], (e, h, i), dtype) * 0.02,
+        "gate": {"weight": jax.random.normal(ks[0], (e, h), dtype) * 0.02},
+        "experts": {
+            "gate_proj": jax.random.normal(ks[1], (e, i, h), dtype) * 0.02,
+            "up_proj": jax.random.normal(ks[2], (e, i, h), dtype) * 0.02,
+            "down_proj": jax.random.normal(ks[3], (e, h, i), dtype) * 0.02,
+        },
     }
     if cfg.shared_expert_intermediate_size:
-        si = cfg.shared_expert_intermediate_size
-        p["shared_gate_up"] = jax.random.normal(ks[3], (2 * si, h), dtype) * 0.02
-        p["shared_down"] = jax.random.normal(ks[4], (h, si), dtype) * 0.02
-        p["shared_gate"] = jax.random.normal(ks[5], (1, h), dtype) * 0.02
+        p["shared_expert"] = init_mlp_params(
+            cfg, ks[4], dtype, inter=cfg.shared_expert_intermediate_size)
+        p["shared_expert_gate"] = {
+            "weight": jax.random.normal(ks[5], (1, h), dtype) * 0.02}
     return p
 
 
@@ -86,18 +99,20 @@ def init_layer_params(cfg: ModelConfig, spec: LayerSpec, key, dtype):
         p["self_attn"] = init_attention_params(cfg, spec, ks[0], dtype)
     p["mlp"] = (init_moe_params(cfg, ks[1], dtype) if spec.is_moe
                 else init_mlp_params(cfg, ks[1], dtype))
-    ones = jnp.ones(_norm_shape(cfg), dtype)
+    # fresh buffer per norm: donation/aliasing breaks if leaves share storage
+    def ones():
+        return jnp.ones(_norm_shape(cfg), dtype)
     if spec.norm_style == "pre":
-        p["input_layernorm"] = {"weight": ones}
-        p["post_attention_layernorm"] = {"weight": ones}
+        norm_names = ("input_layernorm", "post_attention_layernorm")
     elif spec.norm_style == "post":
-        p["post_attention_layernorm"] = {"weight": ones}
-        p["post_feedforward_layernorm"] = {"weight": ones}
+        norm_names = ("post_attention_layernorm", "post_feedforward_layernorm")
     elif spec.norm_style == "sandwich":
-        p["input_layernorm"] = {"weight": ones}
-        p["post_attention_layernorm"] = {"weight": ones}
-        p["pre_feedforward_layernorm"] = {"weight": ones}
-        p["post_feedforward_layernorm"] = {"weight": ones}
+        norm_names = ("input_layernorm", "post_attention_layernorm",
+                      "pre_feedforward_layernorm", "post_feedforward_layernorm")
+    else:
+        norm_names = ()
+    for name in norm_names:
+        p[name] = {"weight": ones()}
     return p
 
 
@@ -156,10 +171,9 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
     gated = cfg.attn_output_gate and spec.kind == "full"
     q_out = 2 * sq if gated else sq
 
-    qkv = linear(x, p["wqkv"], p.get("bqkv"))
-    q = qkv[..., :q_out]
-    k = qkv[..., q_out:q_out + skv]
-    v = qkv[..., q_out + skv:]
+    q = linear(x, p["q_proj"]["weight"], p["q_proj"].get("bias"))
+    k = linear(x, p["k_proj"]["weight"], p["k_proj"].get("bias"))
+    v = linear(x, p["v_proj"]["weight"], p["v_proj"].get("bias"))
 
     gate = None
     if gated:
@@ -169,16 +183,16 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
         q, gate = qg[..., :d].reshape(b, s, sq), qg[..., d:].reshape(b, s, sq)
 
     if cfg.qk_norm and cfg.qk_norm_pre_reshape:
-        q = rms_norm(q, p["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, p["k_norm"], cfg.rms_norm_eps)
+        q = rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
 
     q = q.reshape(b, s, hq, d)
     k = k.reshape(b, s, hkv, d)
     v = v.reshape(b, s, hkv, d)
 
     if cfg.qk_norm and not cfg.qk_norm_pre_reshape:
-        q = rms_norm(q, p["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, p["k_norm"], cfg.rms_norm_eps)
+        q = rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
 
     positions = pos0 + jnp.arange(s, dtype=jnp.int32)
     if spec.use_rope:
@@ -188,48 +202,52 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
     # Attend over [previous cache ; in-pass K/V]. In-pass keys must be
     # presented in full (not through the ring): with a window-sized ring,
     # early prefill queries need keys the ring has already evicted.
+    # layer_cache=None is the stateless path (training / no-cache prefill).
     idx = jnp.arange(s, dtype=jnp.int32)
     kv_pos_new = positions if valid_len is None else jnp.where(
         idx < valid_len, positions, -1)                    # pads invisible
-    kv_pos = jnp.concatenate([
-        layer_cache["pos"],
-        jnp.broadcast_to(kv_pos_new[None, :], (b, s))], axis=1)
-    k_all = jnp.concatenate([layer_cache["k"], k], axis=1)
-    v_all = jnp.concatenate([layer_cache["v"], v], axis=1)
+    kv_pos_new = jnp.broadcast_to(kv_pos_new[None, :], (b, s))
+    if layer_cache is None:
+        kv_pos, k_all, v_all = kv_pos_new, k, v
+        new_cache = None
+    else:
+        kv_pos = jnp.concatenate([layer_cache["pos"], kv_pos_new], axis=1)
+        k_all = jnp.concatenate([layer_cache["k"], k], axis=1)
+        v_all = jnp.concatenate([layer_cache["v"], v], axis=1)
     q_pos = jnp.broadcast_to(positions[None, :], (b, s))
     mask = make_attention_mask(q_pos, kv_pos, window=spec.window)
     y = multi_head_attention(q, k_all, v_all, mask, scale=cfg.attn_scale)
-    new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
+    if layer_cache is not None:
+        new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
     y = y.reshape(b, s, sq)
     if gate is not None:
         y = y * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(y.dtype)
-    return linear(y, p["o_proj"]), new_cache
+    return linear(y, p["o_proj"]["weight"]), new_cache
 
 
 def mlp_forward(cfg: ModelConfig, p: dict, x):
-    """Fused gate_up matmul -> silu_mul / gelu_mul -> down
-    (ref: models/common/mlp.rs:11-60)."""
-    i = p["gate_up"].shape[0] // 2
-    gu = linear(x, p["gate_up"])
-    gate, up = gu[..., :i], gu[..., i:]
+    """gate/up matmuls -> silu_mul / gelu_mul -> down (ref: common/mlp.rs).
+    Projections stay separate for tp-aligned sharding; XLA fuses the
+    elementwise epilogue into the GEMMs."""
+    gate = linear(x, p["gate_proj"]["weight"])
+    up = linear(x, p["up_proj"]["weight"])
     h = gelu_mul(gate, up) if cfg.hidden_act == "gelu_tanh" else silu_mul(gate, up)
-    return linear(h, p["down"])
+    return linear(h, p["down_proj"]["weight"])
 
 
 def moe_forward(cfg: ModelConfig, p: dict, x):
     b, s, h = x.shape
     flat = x.reshape(b * s, h)
-    y = moe_ffn(flat, p["router"], p["gate_up"], p["down"],
+    y = moe_ffn(flat, p["gate"]["weight"], p["experts"]["gate_proj"],
+                p["experts"]["up_proj"], p["experts"]["down_proj"],
                 cfg.num_experts_per_tok, cfg.norm_topk_prob,
                 cfg.moe_gate_act,
                 "gelu" if cfg.hidden_act == "gelu_tanh" else "silu")
-    if "shared_gate_up" in p:
+    if "shared_expert" in p:
         # always-active shared expert, sigmoid-gated (ref: qwen3_5_moe/moe.rs)
-        si = p["shared_gate_up"].shape[0] // 2
-        gu = linear(flat, p["shared_gate_up"])
-        sh = silu_mul(gu[..., :si], gu[..., si:])
-        sh = linear(sh, p["shared_down"])
-        g = jax.nn.sigmoid(linear(flat, p["shared_gate"]).astype(jnp.float32))
+        sh = mlp_forward(cfg, p["shared_expert"], flat)
+        g = jax.nn.sigmoid(
+            linear(flat, p["shared_expert_gate"]["weight"]).astype(jnp.float32))
         y = y + sh * g.astype(sh.dtype)
     return y.reshape(b, s, h)
 
@@ -294,6 +312,24 @@ def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
     advance = x.shape[1] if valid_len is None else valid_len
     new_cache = {"layers": new_layers, "pos": pos0 + advance}
     return x, new_cache
+
+
+def forward_train(cfg: ModelConfig, params: dict, tokens):
+    """Stateless forward over all positions -> [B, S, V] f32 logits.
+
+    Beyond-parity surface (the reference is inference-only): used by the
+    training step in parallel/train.py and by logit-parity tests.
+    """
+    x = embed_tokens(cfg, params, tokens)
+    specs = cfg.layer_specs()
+    rope = params["rope"]
+    pos0 = jnp.asarray(0, jnp.int32)
+    for j, spec in enumerate(specs[:len(params["layers"])]):
+        x, _ = block_forward(cfg, spec, params["layers"][j], x, None, pos0, rope)
+    h = rms_norm(x, params["norm"]["weight"], cfg.rms_norm_eps)
+    w = (params["embed_tokens"]["weight"] if cfg.tie_word_embeddings
+         else params["lm_head"]["weight"])
+    return linear(h, w).astype(jnp.float32)
 
 
 def embed_tokens(cfg: ModelConfig, params: dict, tokens):

@@ -202,9 +202,11 @@ class TextModel:
         budget = self.max_cache_len - len(prompt_ids) - 1 - chunk
         max_new_tokens = min(max_new_tokens, max(budget, 1))
         while not done and len(out) < max_new_tokens:
-            n = min(chunk, max_new_tokens - len(out))
+            # Always run the full chunk (one compiled program for all calls);
+            # overshoot past EOS/max_new is discarded on the host — wasted
+            # FLOPs bounded by chunk-1, zero recompiles.
             toks, cache, rng, recent = self._decode_chunk(
-                self.params, tok_arr, cache, rng, recent, scfg, n)
+                self.params, tok_arr, cache, rng, recent, scfg, chunk)
             toks_np = np.asarray(toks)
             for t in toks_np:
                 tid = int(t)

@@ -15,3 +15,11 @@ def init_gdn_params(cfg, key, dtype):
 
 def gdn_forward(cfg, p, x, layer_cache, pos0, valid_len=None):
     raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")
+
+
+def load_gdn_params(loader, layer_prefix: str):
+    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")
+
+
+def export_gdn_params(cfg, params, layer_prefix: str):
+    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")

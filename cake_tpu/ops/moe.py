@@ -45,25 +45,22 @@ def combine_weights(weights, idx, num_experts: int):
     return w_te.at[rows, idx].add(weights)
 
 
-def moe_ffn(x, router_weight, gate_up, down, k: int, norm_topk_prob: bool,
-            gate_act: str = "softmax", act: str = "silu"):
-    """x: [T, H]; router_weight: [E, H]; gate_up: [E, 2I, H]; down: [E, H, I].
-
-    Returns [T, H] in x.dtype.
+def moe_ffn(x, router_weight, gate_proj, up_proj, down_proj, k: int,
+            norm_topk_prob: bool, gate_act: str = "softmax", act: str = "silu"):
+    """x: [T, H]; router_weight: [E, H]; gate/up_proj: [E, I, H];
+    down_proj: [E, H, I]. Returns [T, H] in x.dtype.
     """
-    t, h = x.shape
-    e = gate_up.shape[0]
-    inter = gate_up.shape[1] // 2
+    e = gate_proj.shape[0]
     logits = jnp.einsum("th,eh->te", x, router_weight,
                         preferred_element_type=jnp.float32)
     weights, idx = router_topk(logits, k, norm_topk_prob, gate_act)
     w_te = combine_weights(weights, idx, e).astype(x.dtype)
 
-    gu = jnp.einsum("th,eih->tei", x, gate_up)          # [T, E, 2I]
-    g, u = gu[..., :inter], gu[..., inter:]
+    g = jnp.einsum("th,eih->tei", x, gate_proj)         # [T, E, I]
+    u = jnp.einsum("th,eih->tei", x, up_proj)
     if act == "silu":
         a = jax.nn.silu(g) * u
     else:
         a = jax.nn.gelu(g, approximate=True) * u
-    y_e = jnp.einsum("tei,ehi->teh", a, down)           # [T, E, H]
+    y_e = jnp.einsum("tei,ehi->teh", a, down_proj)      # [T, E, H]
     return jnp.einsum("te,teh->th", w_te, y_e).astype(x.dtype)

@@ -1,0 +1,10 @@
+"""TPU-native parallelism: device meshes, GSPMD shardings (tp/dp/ep),
+ring-attention sequence parallelism (sp), and a sharded train step.
+
+Host-level pipeline parallelism (layer-range sharding over the LAN) lives
+in cluster/ — the same split the reference makes (SURVEY §2g)."""
+from .mesh import axis_size, make_mesh, named, single_device_mesh
+from .ring_attention import ring_attention, ring_attention_sharded
+from .sharding import (cache_shardings, check_tp_divisibility,
+                       params_shardings, shard_cache, shard_params)
+from .train import loss_fn, make_train_step

@@ -1,0 +1,46 @@
+"""Device mesh helpers.
+
+Axis convention (the TPU-native replacement for the reference's intra-worker
+multi-GPU layer split, ref: worker.rs:126-229; see SURVEY §2g):
+
+  dp - data / batch replicas
+  tp - tensor parallel (attention heads / FFN channels)
+  sp - sequence / context parallel (ring attention)
+  ep - expert parallel (MoE expert banks)
+
+Pipeline parallelism is host-level by design (cluster/ layer ranges over the
+wire, like the reference); within a host a contiguous layer range is one jit
+over this mesh.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """axes e.g. {"dp": 2, "tp": 4}; product must equal device count."""
+    devices = devices if devices is not None else jax.devices()
+    if not axes:
+        axes = {"tp": len(devices)}
+    sizes = list(axes.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh {axes} does not match {len(devices)} devices")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("tp",))
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    """NamedSharding with axis names absent from the mesh dropped to None."""
+    clean = tuple(s if (s is None or s in mesh.axis_names) else None
+                  for s in spec)
+    return NamedSharding(mesh, P(*clean))

@@ -1,0 +1,118 @@
+"""Parameter / cache sharding rules.
+
+GSPMD style: annotate the pytrees with NamedSharding and let XLA insert the
+collectives (psum after the row×col sharded matmul pair) — the TPU-idiomatic
+replacement for hand-written NCCL calls the reference never had (SURVEY §2g:
+TP is a "natural TPU win the reference cannot do").
+
+Megatron-style layout per decoder layer (projections kept separate so row
+chunks stay head-aligned — see layers.py init_attention_params):
+  q/k/v_proj [out, H] : rows over tp (head-parallel)
+  o_proj   [H, q]     : cols over tp -> XLA inserts the psum
+  gate/up_proj [I, H] : rows over tp
+  down_proj [H, I]    : cols over tp
+  MoE expert banks    : leading E axis over ep (+ inner tp)
+  KV cache            : heads over tp, batch over dp
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.common.config import ModelConfig
+
+
+def _ax(mesh: Mesh, name: str):
+    return name if name in mesh.axis_names and mesh.shape[name] > 1 else None
+
+
+def param_pspec(path: tuple[str, ...], mesh: Mesh) -> P:
+    """PartitionSpec for a parameter identified by its pytree path."""
+    tp, ep = _ax(mesh, "tp"), _ax(mesh, "ep")
+    name = path[-1]
+    parent = path[-2] if len(path) >= 2 else ""
+    if parent == "experts":
+        # stacked expert banks [E, I, H] / [E, H, I]: experts over ep,
+        # FFN channels over tp
+        if name in ("gate_proj", "up_proj"):
+            return P(ep, tp, None)
+        if name == "down_proj":
+            return P(ep, None, tp)
+    if name == "weight" or name == "bias":
+        if parent in ("q_proj", "k_proj", "v_proj"):
+            return P(tp, None) if name == "weight" else P(tp)
+        if parent == "o_proj":
+            return P(None, tp)
+        if parent in ("gate_proj", "up_proj"):
+            return P(tp, None)
+        if parent == "down_proj":
+            return P(None, tp)
+        if parent in ("embed_tokens", "lm_head", "gate",
+                      "shared_expert_gate"):
+            return P(None, None)
+    if parent == "rope":
+        return P(None, None)
+    return P(None)      # norms and other vectors
+
+
+def _dense_pspec_for(leaf, spec: P) -> P:
+    """Trim a spec to the leaf's rank (MoE dense tensors are 3D, rest 2D)."""
+    ndim = getattr(leaf, "ndim", 0)
+    parts = list(spec)
+    if len(parts) > ndim:
+        parts = parts[-ndim:] if ndim else []
+    while len(parts) < ndim:
+        parts.append(None)
+    return P(*parts)
+
+
+def params_shardings(params, mesh: Mesh):
+    """Pytree of NamedSharding matching `params`."""
+    def f(path, leaf):
+        keys = tuple(getattr(p, "key", getattr(p, "idx", None)) for p in path)
+        keys = tuple(str(k) for k in keys if k is not None)
+        spec = _dense_pspec_for(leaf, param_pspec(keys, mesh))
+        # fail with the tensor name, not a deep GSPMD error, on indivisibility
+        for dim, ax in enumerate(spec):
+            if ax is not None and leaf.shape[dim] % mesh.shape[ax]:
+                raise ValueError(
+                    f"{'.'.join(keys)}: dim {dim} of shape {leaf.shape} not "
+                    f"divisible by mesh axis {ax}={mesh.shape[ax]}")
+        return NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map_with_path(f, params)
+
+
+def cache_shardings(cache, mesh: Mesh):
+    dp, tp = _ax(mesh, "dp"), _ax(mesh, "tp")
+
+    def f(path, leaf):
+        name = str(getattr(path[-1], "key", getattr(path[-1], "idx", "")))
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 4 and name in ("k", "v"):
+            return NamedSharding(mesh, P(dp, None, tp, None))
+        if ndim == 4 and name == "state":       # GDN [B, Hv, Dk, Dv]
+            return NamedSharding(mesh, P(dp, tp, None, None))
+        if ndim == 3 and name == "conv":        # GDN conv state [B, C, K-1]
+            return NamedSharding(mesh, P(dp, tp, None))
+        if ndim == 2 and name == "pos":
+            return NamedSharding(mesh, P(dp, None))
+        return NamedSharding(mesh, P())
+    return jax.tree_util.tree_map_with_path(f, cache)
+
+
+def shard_params(params, mesh: Mesh):
+    return jax.device_put(params, params_shardings(params, mesh))
+
+
+def shard_cache(cache, mesh: Mesh):
+    return jax.device_put(cache, cache_shardings(cache, mesh))
+
+
+def check_tp_divisibility(cfg: ModelConfig, mesh: Mesh):
+    tp = mesh.shape.get("tp", 1)
+    if cfg.num_key_value_heads % tp or cfg.num_attention_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide heads {cfg.num_attention_heads}/"
+            f"{cfg.num_key_value_heads}")
+    if cfg.intermediate_size % tp:
+        raise ValueError(f"tp={tp} must divide intermediate {cfg.intermediate_size}")

@@ -1,0 +1,13 @@
+from .dtypes import (WIRE_DTYPES, WIRE_TAGS, dtype_name, from_numpy_bytes,
+                     itemsize, parse_dtype, to_numpy_bytes)
+from .export import params_to_hf_tensors
+from .gguf import GgufReader, GgufStorage, gguf_config_dict, gguf_to_hf_name
+from .hub import (cake_cache_dir, hf_cache_dir, looks_like_repo_id,
+                  probe_cached_repo, pull, resolve_model)
+from .loaders import ParamLoader, load_model_params
+from .models import ModelEntry, delete_model, find_model, list_models
+from .quant import (Fp8Quantization, GptqQuantization, NoQuantization,
+                    detect_quantization)
+from .safetensors_io import (TensorRecord, TensorStorage, index_file,
+                             layer_of, save_safetensors)
+from .split import split_model

@@ -1,0 +1,84 @@
+"""Params pytree -> HF-named tensor dict (inverse of utils/loaders.py).
+
+Used by the splitter (`cake split` — ref: utils/split.rs writes per-worker
+safetensors bundles) and by round-trip tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.common.config import ModelConfig
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def params_to_hf_tensors(cfg: ModelConfig, params: dict,
+                         layer_offset: int = 0,
+                         fuse_phi: bool = False) -> dict[str, np.ndarray]:
+    """fuse_phi: write Phi-style fused qkv_proj/gate_up_proj names."""
+    out: dict[str, np.ndarray] = {}
+    pre = cfg.model_prefix
+
+    def put_norm(name, w):
+        arr = _np(w).astype(np.float32)
+        if cfg.residual_rms_norm:
+            arr = arr - 1.0     # stored as delta from 0 (ref: config.rs)
+        out[name] = arr.astype(_np(w).dtype)
+
+    if "embed_tokens" in params:
+        out[f"{pre}.embed_tokens.weight"] = _np(params["embed_tokens"]["weight"])
+    if "norm" in params:
+        put_norm(f"{pre}.norm.weight", params["norm"]["weight"])
+    if "lm_head" in params:
+        out["lm_head.weight"] = _np(params["lm_head"]["weight"])
+
+    for j, layer in enumerate(params["layers"]):
+        i = layer_offset + j
+        lp = f"{pre}.layers.{i}"
+        for norm in ("input_layernorm", "post_attention_layernorm",
+                     "pre_feedforward_layernorm", "post_feedforward_layernorm"):
+            if norm in layer:
+                put_norm(f"{lp}.{norm}.weight", layer[norm]["weight"])
+        if "self_attn" in layer:
+            a = layer["self_attn"]
+            if fuse_phi:
+                out[f"{lp}.self_attn.qkv_proj.weight"] = np.concatenate([
+                    _np(a["q_proj"]["weight"]), _np(a["k_proj"]["weight"]),
+                    _np(a["v_proj"]["weight"])], axis=0)
+            else:
+                for proj in ("q_proj", "k_proj", "v_proj"):
+                    out[f"{lp}.self_attn.{proj}.weight"] = _np(a[proj]["weight"])
+                    if "bias" in a[proj]:
+                        out[f"{lp}.self_attn.{proj}.bias"] = _np(a[proj]["bias"])
+            out[f"{lp}.self_attn.o_proj.weight"] = _np(a["o_proj"]["weight"])
+            for qk in ("q_norm", "k_norm"):
+                if qk in a:
+                    put_norm(f"{lp}.self_attn.{qk}.weight", a[qk]["weight"])
+        if "linear_attn" in layer:
+            from ..models.qwen3_5 import export_gdn_params
+            out.update(export_gdn_params(cfg, layer["linear_attn"], lp))
+        mlp = layer["mlp"]
+        if "experts" in mlp:    # MoE
+            out[f"{lp}.mlp.gate.weight"] = _np(mlp["gate"]["weight"])
+            for e in range(cfg.num_experts):
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    out[f"{lp}.mlp.experts.{e}.{proj}.weight"] = \
+                        _np(mlp["experts"][proj][e])
+            if "shared_expert" in mlp:
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    out[f"{lp}.mlp.shared_expert.{proj}.weight"] = \
+                        _np(mlp["shared_expert"][proj]["weight"])
+                out[f"{lp}.mlp.shared_expert_gate.weight"] = \
+                    _np(mlp["shared_expert_gate"]["weight"])
+        else:
+            if fuse_phi:
+                out[f"{lp}.mlp.gate_up_proj.weight"] = np.concatenate([
+                    _np(mlp["gate_proj"]["weight"]),
+                    _np(mlp["up_proj"]["weight"])], axis=0)
+                out[f"{lp}.mlp.down_proj.weight"] = _np(mlp["down_proj"]["weight"])
+            else:
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    out[f"{lp}.mlp.{proj}.weight"] = _np(mlp[proj]["weight"])
+    return out

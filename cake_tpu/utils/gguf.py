@@ -1,0 +1,297 @@
+"""GGUF (llama.cpp) checkpoint loading: header/metadata parsing, arch config
+extraction, `blk.N.attn_q` -> HF name mapping, and dequantization of the
+common K-quant formats at load (ref: utils/gguf.rs:1-26 + dispatch in
+cake/mod.rs:237-263).
+
+Supported tensor types: F32, F16, BF16, Q4_0, Q8_0, Q4_K, Q6_K — the set a
+Q4_K_M model actually contains (Q4_K for bulk weights, Q6_K for a few,
+F32 norms). Dequant formulas follow the public ggml block layouts,
+vectorized with numpy.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+GGUF_MAGIC = 0x46554747   # "GGUF" little-endian
+
+# metadata value type tags
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32, _T_F32, _T_BOOL, _T_STR, \
+    _T_ARR, _T_U64, _T_I64, _T_F64 = range(13)
+
+# tensor dtype tags (ggml_type)
+GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q8_0 = 2, 8
+GGML_Q4_K, GGML_Q6_K = 12, 14
+GGML_BF16 = 30
+
+QK_K = 256
+
+
+@dataclass(frozen=True)
+class GgufTensor:
+    name: str
+    dims: tuple[int, ...]    # ggml order: dims[0] is innermost (in_features)
+    ggml_type: int
+    offset: int              # relative to data section
+
+
+class GgufReader:
+    def __init__(self, path: str):
+        self.path = path
+        self.metadata: dict = {}
+        self.tensors: dict[str, GgufTensor] = {}
+        with open(path, "rb") as f:
+            magic, version = struct.unpack("<II", f.read(8))
+            if magic != GGUF_MAGIC:
+                raise ValueError(f"{path}: not a GGUF file")
+            if version < 2:
+                raise ValueError(f"GGUF version {version} unsupported")
+            n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+            for _ in range(n_kv):
+                key = self._read_str(f)
+                vtype = struct.unpack("<I", f.read(4))[0]
+                self.metadata[key] = self._read_value(f, vtype)
+            for _ in range(n_tensors):
+                name = self._read_str(f)
+                n_dims = struct.unpack("<I", f.read(4))[0]
+                dims = struct.unpack(f"<{n_dims}Q", f.read(8 * n_dims))
+                ttype, offset = struct.unpack("<IQ", f.read(12))
+                self.tensors[name] = GgufTensor(name, dims, ttype, offset)
+            align = self.metadata.get("general.alignment", 32)
+            pos = f.tell()
+            self.data_start = (pos + align - 1) // align * align
+
+    @staticmethod
+    def _read_str(f) -> str:
+        n = struct.unpack("<Q", f.read(8))[0]
+        return f.read(n).decode("utf-8", errors="replace")
+
+    def _read_value(self, f, vtype):
+        scalars = {_T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+                   _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_U64: "<Q",
+                   _T_I64: "<q", _T_F64: "<d"}
+        if vtype in scalars:
+            fmt = scalars[vtype]
+            return struct.unpack(fmt, f.read(struct.calcsize(fmt)))[0]
+        if vtype == _T_BOOL:
+            return bool(f.read(1)[0])
+        if vtype == _T_STR:
+            return self._read_str(f)
+        if vtype == _T_ARR:
+            etype, n = struct.unpack("<IQ", f.read(12))
+            return [self._read_value(f, etype) for _ in range(n)]
+        raise ValueError(f"unknown GGUF metadata type {vtype}")
+
+    # -- tensor data ------------------------------------------------------
+
+    def _raw(self, t: GgufTensor, nbytes: int) -> bytes:
+        with open(self.path, "rb") as f:
+            f.seek(self.data_start + t.offset)
+            return f.read(nbytes)
+
+    def read_tensor(self, name: str) -> np.ndarray:
+        """Dequantized f32/f16 tensor in torch layout [out, in]."""
+        t = self.tensors[name]
+        n = int(np.prod(t.dims))
+        if t.ggml_type == GGML_F32:
+            data = np.frombuffer(self._raw(t, 4 * n), np.float32)
+        elif t.ggml_type == GGML_F16:
+            data = np.frombuffer(self._raw(t, 2 * n), np.float16)
+        elif t.ggml_type == GGML_BF16:
+            import jax.numpy as jnp
+            data = np.frombuffer(self._raw(t, 2 * n), jnp.dtype(jnp.bfloat16))
+        elif t.ggml_type == GGML_Q4_0:
+            data = dequant_q4_0(self._raw(t, n // 32 * 18), n)
+        elif t.ggml_type == GGML_Q8_0:
+            data = dequant_q8_0(self._raw(t, n // 32 * 34), n)
+        elif t.ggml_type == GGML_Q4_K:
+            data = dequant_q4_k(self._raw(t, n // QK_K * 144), n)
+        elif t.ggml_type == GGML_Q6_K:
+            data = dequant_q6_k(self._raw(t, n // QK_K * 210), n)
+        else:
+            raise NotImplementedError(f"ggml type {t.ggml_type} for {name}")
+        return data.reshape(tuple(reversed(t.dims)))
+
+
+# -- block dequantizers (vectorized over blocks) ---------------------------
+
+def dequant_q4_0(raw: bytes, n: int) -> np.ndarray:
+    """Block = f16 scale + 32x4bit; w = d*(q-8)."""
+    nb = n // 32
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 18)
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)      # [nb,1]
+    qs = b[:, 2:]
+    lo = (qs & 0xF).astype(np.int8)
+    hi = (qs >> 4).astype(np.int8)
+    q = np.concatenate([lo, hi], axis=1).astype(np.float32) - 8.0
+    return (q * d).reshape(-1)
+
+
+def dequant_q8_0(raw: bytes, n: int) -> np.ndarray:
+    nb = n // 32
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 34)
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)
+    q = b[:, 2:].copy().view(np.int8).astype(np.float32)
+    return (q * d).reshape(-1)
+
+
+def _k4_scale_min(scales: np.ndarray):
+    """Unpack the 12-byte 6-bit (scale, min) table of Q4_K -> sc/m [nb, 8]."""
+    s = scales.astype(np.uint8)
+    sc = np.empty(s.shape[:-1] + (8,), np.uint8)
+    m = np.empty_like(sc)
+    sc[..., :4] = s[..., 0:4] & 63
+    m[..., :4] = s[..., 4:8] & 63
+    sc[..., 4:] = (s[..., 8:12] & 0xF) | ((s[..., 0:4] >> 6) << 4)
+    m[..., 4:] = (s[..., 8:12] >> 4) | ((s[..., 4:8] >> 6) << 4)
+    return sc.astype(np.float32), m.astype(np.float32)
+
+
+def dequant_q4_k(raw: bytes, n: int) -> np.ndarray:
+    """Super-block 256 = d f16 + dmin f16 + 12B scales + 128B qs;
+    w = d*sc*q - dmin*m, 8 groups of 32 (low nibbles then high per 64)."""
+    nb = n // QK_K
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 144)
+    d = b[:, 0:2].copy().view(np.float16).astype(np.float32)      # [nb,1]
+    dmin = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    sc, mins = _k4_scale_min(b[:, 4:16])                          # [nb,8]
+    qs = b[:, 16:]                                                # [nb,128]
+    qs4 = qs.reshape(nb, 4, 32)                                   # per 64-pair
+    lo = (qs4 & 0xF).astype(np.float32)                           # groups 0,2,4,6
+    hi = (qs4 >> 4).astype(np.float32)                            # groups 1,3,5,7
+    q = np.stack([lo, hi], axis=2).reshape(nb, 8, 32)
+    scale = (d * sc)[:, :, None]
+    minv = (dmin * mins)[:, :, None]
+    return (scale * q - minv).reshape(-1)
+
+
+def dequant_q6_k(raw: bytes, n: int) -> np.ndarray:
+    """Super-block 256 = 128B ql + 64B qh + 16B scales(i8) + d f16;
+    w = d * sc * (q - 32) with the ggml half-block interleave."""
+    nb = n // QK_K
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 210)
+    ql = b[:, 0:128].reshape(nb, 2, 64)
+    qh = b[:, 128:192].reshape(nb, 2, 32)
+    sc = b[:, 192:208].copy().view(np.int8).astype(np.float32).reshape(nb, 2, 8)
+    d = b[:, 208:210].copy().view(np.float16).astype(np.float32)  # [nb,1]
+
+    l0 = ql[:, :, 0:32]
+    l32 = ql[:, :, 32:64]
+    q1 = (l0 & 0xF) | (((qh >> 0) & 3) << 4)
+    q2 = (l32 & 0xF) | (((qh >> 2) & 3) << 4)
+    q3 = (l0 >> 4) | (((qh >> 4) & 3) << 4)
+    q4 = (l32 >> 4) | (((qh >> 6) & 3) << 4)
+    # y[l+0]:sc[l/16], y[l+32]:sc[2+l/16], y[l+64]:sc[4+l/16], y[l+96]:sc[6+l/16]
+    q = np.stack([q1, q2, q3, q4], axis=2).astype(np.float32) - 32.0  # [nb,2,4,32]
+    idx = np.arange(32) // 16                                     # 0/1 per l
+    sel = np.stack([sc[:, :, 0 + idx], sc[:, :, 2 + idx],
+                    sc[:, :, 4 + idx], sc[:, :, 6 + idx]], axis=2)
+    y = (d[:, :, None, None] * sel * q)
+    return y.reshape(-1)
+
+
+# -- name + config mapping --------------------------------------------------
+
+GGUF_NAME_MAP = {
+    "attn_q": "self_attn.q_proj", "attn_k": "self_attn.k_proj",
+    "attn_v": "self_attn.v_proj", "attn_output": "self_attn.o_proj",
+    "attn_q_norm": "self_attn.q_norm", "attn_k_norm": "self_attn.k_norm",
+    "ffn_gate": "mlp.gate_proj", "ffn_up": "mlp.up_proj",
+    "ffn_down": "mlp.down_proj",
+    "attn_norm": "input_layernorm", "ffn_norm": "post_attention_layernorm",
+}
+
+
+def gguf_to_hf_name(name: str, prefix: str = "model") -> str | None:
+    """blk.N.attn_q.weight -> model.layers.N.self_attn.q_proj.weight
+    (ref: gguf.rs name mapping)."""
+    if name == "token_embd.weight":
+        return f"{prefix}.embed_tokens.weight"
+    if name == "output_norm.weight":
+        return f"{prefix}.norm.weight"
+    if name == "output.weight":
+        return "lm_head.weight"
+    if name.startswith("blk."):
+        _, layer, rest = name.split(".", 2)
+        stem, suffix = rest.rsplit(".", 1)
+        mapped = GGUF_NAME_MAP.get(stem)
+        if mapped:
+            return f"{prefix}.layers.{layer}.{mapped}.{suffix}"
+    return None
+
+
+GGUF_ARCH_TO_HF = {
+    "llama": "LlamaForCausalLM", "qwen2": "Qwen2ForCausalLM",
+    "qwen3": "Qwen3ForCausalLM", "qwen3moe": "Qwen3MoeForCausalLM",
+    "phi3": "Phi3ForCausalLM", "mistral": "MistralForCausalLM",
+    "gemma3": "Gemma3ForCausalLM", "falcon": "FalconForCausalLM",
+    "olmo2": "Olmo2ForCausalLM", "exaone4": "Exaone4ForCausalLM",
+}
+
+
+def gguf_config_dict(reader: GgufReader) -> dict:
+    """Build an HF-style config dict from GGUF metadata
+    (ref: gguf.rs arch/config extraction)."""
+    md = reader.metadata
+    arch = md.get("general.architecture", "llama")
+
+    def g(key, default=None):
+        return md.get(f"{arch}.{key}", default)
+
+    heads = int(g("attention.head_count", 32))
+    hidden = int(g("embedding_length", 4096))
+    vocab = int(g("vocab_size", 0))
+    if not vocab and "token_embd.weight" in reader.tensors:
+        vocab = reader.tensors["token_embd.weight"].dims[1]
+    d = {
+        "architectures": [GGUF_ARCH_TO_HF.get(arch, "LlamaForCausalLM")],
+        "hidden_size": hidden,
+        "intermediate_size": int(g("feed_forward_length", 11008)),
+        "num_hidden_layers": int(g("block_count", 32)),
+        "num_attention_heads": heads,
+        "num_key_value_heads": int(g("attention.head_count_kv", heads)),
+        "vocab_size": int(vocab),
+        "rms_norm_eps": float(g("attention.layer_norm_rms_epsilon", 1e-5)),
+        "rope_theta": float(g("rope.freq_base", 10000.0)),
+        "max_position_embeddings": int(g("context_length", 4096)),
+        "tie_word_embeddings": "output.weight" not in reader.tensors,
+    }
+    if g("attention.key_length"):
+        d["head_dim"] = int(g("attention.key_length"))
+    if g("attention.sliding_window"):
+        d["sliding_window"] = int(g("attention.sliding_window"))
+    eos = md.get("tokenizer.ggml.eos_token_id")
+    if eos is not None:
+        d["eos_token_id"] = int(eos)
+    bos = md.get("tokenizer.ggml.bos_token_id")
+    if bos is not None:
+        d["bos_token_id"] = int(bos)
+    return d
+
+
+class GgufStorage:
+    """TensorStorage-compatible facade over a GGUF file: HF names in,
+    dequantized arrays out — so ParamLoader works unchanged."""
+
+    def __init__(self, path: str, prefix: str = "model"):
+        self.reader = GgufReader(path)
+        self._map: dict[str, str] = {}
+        for gname in self.reader.tensors:
+            hf = gguf_to_hf_name(gname, prefix)
+            if hf:
+                self._map[hf] = gname
+
+    def names(self):
+        return self._map.keys()
+
+    def __contains__(self, name):
+        return name in self._map
+
+    def read(self, name: str) -> np.ndarray:
+        return self.reader.read_tensor(self._map[name])
+
+    def close(self):
+        pass

@@ -1,0 +1,73 @@
+"""HuggingFace hub integration: repo-id detection, cache probing,
+auto-download (ref: utils/hf.rs — repo-id detection, cache probing,
+auto-download).
+
+Zero-egress environments: downloads fail fast with a clear message and
+local paths always work.
+"""
+from __future__ import annotations
+
+import os
+
+MODEL_FILE_PATTERNS = ("*.safetensors", "*.json", "tokenizer*", "*.gguf",
+                       "*.model")
+
+
+def looks_like_repo_id(name: str) -> bool:
+    """`org/name` that is not an existing path (ref: utils/hf.rs detection)."""
+    if os.path.exists(name):
+        return False
+    parts = name.split("/")
+    return len(parts) == 2 and all(p and not p.startswith(".") for p in parts)
+
+
+def hf_cache_dir() -> str:
+    return os.environ.get(
+        "HF_HUB_CACHE",
+        os.path.join(os.environ.get(
+            "HF_HOME", os.path.expanduser("~/.cache/huggingface")), "hub"))
+
+
+def cake_cache_dir() -> str:
+    """Our own worker model-data cache root (ref: sharding/mod.rs cache dir)."""
+    return os.environ.get("CAKE_TPU_CACHE",
+                          os.path.expanduser("~/.cache/cake-tpu"))
+
+
+def probe_cached_repo(repo_id: str) -> str | None:
+    """Find an already-downloaded snapshot without network."""
+    safe = "models--" + repo_id.replace("/", "--")
+    snap_root = os.path.join(hf_cache_dir(), safe, "snapshots")
+    if not os.path.isdir(snap_root):
+        return None
+    snaps = sorted(os.listdir(snap_root))
+    for s in reversed(snaps):
+        p = os.path.join(snap_root, s)
+        if os.path.isdir(p) and any(f.endswith((".safetensors", ".gguf"))
+                                    for f in os.listdir(p)):
+            return p
+    return None  # weightless snapshot (interrupted pull) -> re-download
+
+
+def resolve_model(name_or_path: str, download: bool = True) -> str:
+    """Local dir -> itself; repo id -> cached snapshot or download."""
+    if os.path.isdir(name_or_path):
+        return name_or_path
+    if not looks_like_repo_id(name_or_path):
+        raise FileNotFoundError(f"model path {name_or_path!r} does not exist")
+    cached = probe_cached_repo(name_or_path)
+    if cached:
+        return cached
+    if not download:
+        raise FileNotFoundError(f"{name_or_path} not in HF cache")
+    return pull(name_or_path)
+
+
+def pull(repo_id: str) -> str:
+    """Download a repo snapshot (ref: `cake pull`)."""
+    try:
+        from huggingface_hub import snapshot_download
+        return snapshot_download(repo_id, allow_patterns=list(MODEL_FILE_PATTERNS))
+    except Exception as e:  # zero-egress / auth failures
+        raise RuntimeError(
+            f"cannot download {repo_id} (offline environment?): {e}") from e

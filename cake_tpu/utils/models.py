@@ -1,0 +1,100 @@
+"""Local model manager: scan HF + cake caches, report Complete/Partial
+status, list/find/delete (ref: utils/models.rs:33-130; `cake list|rm`)."""
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass
+
+from .hub import cake_cache_dir, hf_cache_dir
+
+
+@dataclass
+class ModelEntry:
+    repo_id: str
+    path: str
+    source: str          # "hf" | "cake"
+    size_bytes: int
+    complete: bool
+
+
+def _dir_size(path: str) -> int:
+    total = 0
+    for root, _, files in os.walk(path):
+        for f in files:
+            fp = os.path.join(root, f)
+            try:
+                total += os.stat(fp).st_size
+            except OSError:
+                pass
+    return total
+
+
+def _is_complete(snap: str) -> bool:
+    """Complete = has config.json (or gguf) and at least one weight file whose
+    blobs resolve (ref: Complete/Partial status in utils/models.rs)."""
+    try:
+        files = os.listdir(snap)
+    except OSError:
+        return False
+    has_cfg = "config.json" in files or any(f.endswith(".gguf") for f in files)
+    weights = [f for f in files if f.endswith((".safetensors", ".gguf"))]
+    if not (has_cfg and weights):
+        return False
+    for w in weights:
+        p = os.path.join(snap, w)
+        real = os.path.realpath(p)
+        if not os.path.exists(real) or os.stat(real).st_size == 0:
+            return False
+    return True
+
+
+def list_models() -> list[ModelEntry]:
+    out: list[ModelEntry] = []
+    hub = hf_cache_dir()
+    if os.path.isdir(hub):
+        for entry in sorted(os.listdir(hub)):
+            if not entry.startswith("models--"):
+                continue
+            repo_id = entry[len("models--"):].replace("--", "/", 1)
+            snap_root = os.path.join(hub, entry, "snapshots")
+            snaps = (sorted(os.listdir(snap_root))
+                     if os.path.isdir(snap_root) else [])
+            for s in reversed(snaps):
+                snap = os.path.join(snap_root, s)
+                out.append(ModelEntry(
+                    repo_id=repo_id, path=snap, source="hf",
+                    size_bytes=_dir_size(os.path.join(hub, entry)),
+                    complete=_is_complete(snap)))
+                break
+    cake = cake_cache_dir()
+    if os.path.isdir(cake):
+        for entry in sorted(os.listdir(cake)):
+            p = os.path.join(cake, entry)
+            if os.path.isdir(p):
+                out.append(ModelEntry(
+                    repo_id=entry, path=p, source="cake",
+                    size_bytes=_dir_size(p), complete=_is_complete(p)))
+    return out
+
+
+def find_model(repo_id: str) -> ModelEntry | None:
+    for m in list_models():
+        if m.repo_id == repo_id:
+            return m
+    return None
+
+
+def delete_model(repo_id: str) -> bool:
+    """Remove a cached model (ref: `cake rm`)."""
+    hub = hf_cache_dir()
+    target = os.path.join(hub, "models--" + repo_id.replace("/", "--"))
+    removed = False
+    if os.path.isdir(target):
+        shutil.rmtree(target)
+        removed = True
+    cake_target = os.path.join(cake_cache_dir(), repo_id)
+    if os.path.isdir(cake_target):
+        shutil.rmtree(cake_target)
+        removed = True
+    return removed

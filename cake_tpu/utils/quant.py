@@ -1,0 +1,108 @@
+"""Quantization strategies: detection from config.json and dequant-at-load
+transforms (ref: utils/mod.rs Quantization trait; utils/fp8.rs; utils/gptq.rs).
+
+Each strategy intercepts weight loads by name: given a TensorStorage and a
+weight name, it either dequantizes companion tensors (FP8 weight_scale_inv,
+GPTQ qweight/scales/qzeros) or falls through to a plain read — exactly the
+reference's transparent VarBuilder-backend design.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+FP8_BLOCK = 128
+
+
+class NoQuantization:
+    name = "none"
+    vram_factor = 1.0      # ref: utils/mod.rs VRAM expansion estimate
+
+    def load(self, storage, name: str) -> np.ndarray:
+        return storage.read(name)
+
+    def has(self, storage, name: str) -> bool:
+        return name in storage
+
+
+class Fp8Quantization:
+    """Block-wise FP8 (E4M3) with per-128x128 `weight_scale_inv`
+    (ref: utils/fp8.rs). Dequant to f32 at load; the native-dtype path
+    (keep FP8 in HBM) lives in the model loaders via keep_native."""
+    name = "fp8"
+    vram_factor = 2.0      # f8 -> bf16 doubles bytes when dequantized
+
+    def load(self, storage, name: str) -> np.ndarray:
+        scale_name = name.replace(".weight", ".weight_scale_inv")
+        if not name.endswith(".weight") or scale_name not in storage:
+            return storage.read(name)
+        w = storage.read(name).astype(np.float32)
+        s = storage.read(scale_name).astype(np.float32)
+        o, i = w.shape
+        s_full = np.repeat(np.repeat(s, FP8_BLOCK, 0), FP8_BLOCK, 1)[:o, :i]
+        return w * s_full
+
+    def has(self, storage, name: str) -> bool:
+        return name in storage
+
+
+class GptqQuantization:
+    """AutoGPTQ 4-bit: qweight int32 [in/8, out] (8x4bit packed along in),
+    scales f16 [groups, out], qzeros int32 [groups, out/8].
+    weight[o, i] = (q4(i,o) - zero4(g(i),o) - 1) * scale(g(i),o)
+    (ref: utils/gptq.rs dequantize_gptq_4bit, incl. the AutoGPTQ -1 zero
+    convention)."""
+    name = "gptq"
+    vram_factor = 4.0
+
+    def __init__(self, group_size: int = 128):
+        self.group_size = group_size
+
+    def has(self, storage, name: str) -> bool:
+        return (name in storage
+                or name.replace(".weight", ".qweight") in storage)
+
+    def load(self, storage, name: str) -> np.ndarray:
+        qname = name.replace(".weight", ".qweight")
+        if not name.endswith(".weight") or qname not in storage:
+            return storage.read(name)
+        qweight = storage.read(qname).view(np.uint32)
+        scales = storage.read(name.replace(".weight", ".scales")).astype(np.float32)
+        qzeros = storage.read(name.replace(".weight", ".qzeros")).view(np.uint32)
+        return dequantize_gptq_4bit(qweight, scales, qzeros, self.group_size)
+
+
+def unpack_int4(packed: np.ndarray, axis: int) -> np.ndarray:
+    """Unpack 8x4-bit nibbles from each uint32 along `axis` (LSB first)."""
+    shifts = np.arange(8, dtype=np.uint32) * 4
+    nibbles = (packed[..., None] >> shifts) & 0xF          # [..., 8]
+    nibbles = np.moveaxis(nibbles, -1, axis + 1 if axis >= 0 else axis)
+    shape = list(packed.shape)
+    shape[axis] *= 8
+    return nibbles.reshape(shape).astype(np.int32)
+
+
+def dequantize_gptq_4bit(qweight: np.ndarray, scales: np.ndarray,
+                         qzeros: np.ndarray, group_size: int = 128) -> np.ndarray:
+    """Returns [out_features, in_features] f32."""
+    q = unpack_int4(qweight, axis=0)                # [in, out]
+    zeros = unpack_int4(qzeros, axis=1)             # [groups, out]
+    in_features = q.shape[0]
+    g_idx = np.arange(in_features) // group_size
+    w = (q - zeros[g_idx] - 1).astype(np.float32) * scales[g_idx]
+    return np.ascontiguousarray(w.T)
+
+
+def detect_quantization(config: dict):
+    """From config.json quantization_config (top-level or text_config —
+    ref: gptq.rs is_gptq_quantized, utils/mod.rs detection)."""
+    for root in (config, config.get("text_config") or {}):
+        qc = root.get("quantization_config")
+        if not qc:
+            continue
+        method = qc.get("quant_method", "")
+        if method == "gptq" or (qc.get("mode") == "affine"
+                                and qc.get("bits") == 4):
+            return GptqQuantization(int(qc.get("group_size", 128)))
+        if method == "fp8" or qc.get("fmt") in ("e4m3", "float8_e4m3fn"):
+            return Fp8Quantization()
+    return NoQuantization()

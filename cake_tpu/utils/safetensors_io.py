@@ -1,0 +1,165 @@
+"""Safetensors reading: header parsing, name->(file, offset) indexing, and
+pread-based single-tensor loads without mmap-ing whole checkpoints
+(ref: utils/tensor_storage.rs SafetensorsStorage — the foundation of
+layer-subset loading and disk expert offload).
+
+Uses the native cakekit C++ pread core when built (csrc/), pure-Python
+os.pread otherwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from .dtypes import SAFETENSORS_DTYPES, itemsize
+
+# probe the native C++ IO core once at import (csrc/ builds it; optional)
+try:
+    from . import cakekit as _CAKEKIT
+    if not _CAKEKIT.available():
+        _CAKEKIT = None
+except ImportError:
+    _CAKEKIT = None
+
+
+@dataclass(frozen=True)
+class TensorRecord:
+    file: str
+    dtype: str            # canonical dtype name
+    shape: tuple[int, ...]
+    start: int            # absolute byte offset in file
+    end: int
+
+    @property
+    def nbytes(self) -> int:
+        return self.end - self.start
+
+
+def read_header(path: str) -> tuple[dict, int]:
+    """Returns (header dict, data_start offset)."""
+    with open(path, "rb") as f:
+        n = struct.unpack("<Q", f.read(8))[0]
+        header = json.loads(f.read(n))
+    return header, 8 + n
+
+
+def index_file(path: str) -> dict[str, TensorRecord]:
+    header, base = read_header(path)
+    out = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        dt = SAFETENSORS_DTYPES[meta["dtype"]]
+        b, e = meta["data_offsets"]
+        out[name] = TensorRecord(file=path, dtype=dt,
+                                 shape=tuple(meta["shape"]),
+                                 start=base + b, end=base + e)
+    return out
+
+
+class TensorStorage:
+    """name -> TensorRecord index over one or many .safetensors files;
+    reads single tensors by pread (page-cache friendly, no mmap —
+    ref: tensor_storage.rs:1-50)."""
+
+    def __init__(self, records: dict[str, TensorRecord]):
+        self.records = records
+        self._fds: dict[str, int] = {}
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str) -> "TensorStorage":
+        """Loads model.safetensors.index.json if present, else every
+        *.safetensors in the directory (ref: utils/mod.rs load paths)."""
+        records: dict[str, TensorRecord] = {}
+        idx = os.path.join(model_dir, "model.safetensors.index.json")
+        if os.path.exists(idx):
+            with open(idx) as f:
+                weight_map = json.load(f)["weight_map"]
+            for fname in sorted(set(weight_map.values())):
+                records.update(index_file(os.path.join(model_dir, fname)))
+        else:
+            for fname in sorted(os.listdir(model_dir)):
+                if fname.endswith(".safetensors"):
+                    records.update(index_file(os.path.join(model_dir, fname)))
+        if not records:
+            raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+        return cls(records)
+
+    def names(self):
+        return self.records.keys()
+
+    def __contains__(self, name):
+        return name in self.records
+
+    def _fd(self, path: str) -> int:
+        if path not in self._fds:
+            self._fds[path] = os.open(path, os.O_RDONLY)
+        return self._fds[path]
+
+    def read_bytes(self, name: str) -> bytes:
+        r = self.records[name]
+        if _CAKEKIT is not None:
+            return _CAKEKIT.pread(r.file, r.start, r.nbytes)
+        return os.pread(self._fd(r.file), r.nbytes, r.start)
+
+    def read(self, name: str) -> np.ndarray:
+        """Read one tensor as a numpy array (bf16/f8 via ml_dtypes)."""
+        r = self.records[name]
+        import jax.numpy as jnp
+        np_dt = jnp.dtype(r.dtype)
+        data = self.read_bytes(name)
+        return np.frombuffer(bytearray(data), dtype=np_dt).reshape(r.shape)
+
+    def nbytes(self, name: str) -> int:
+        return self.records[name].nbytes
+
+    def close(self):
+        for fd in self._fds.values():
+            os.close(fd)
+        self._fds.clear()
+
+
+def layer_of(name: str, prefix: str = "model") -> int | None:
+    """Extract the decoder-layer index from a weight name, None for
+    non-layer tensors (ref: utils/mod.rs layer-subset filters)."""
+    marker = ".layers."
+    i = name.find(marker)
+    if i < 0:
+        return None
+    rest = name[i + len(marker):]
+    head = rest.split(".", 1)[0]
+    return int(head) if head.isdigit() else None
+
+
+def save_safetensors(path: str, tensors: dict[str, np.ndarray],
+                     metadata: dict | None = None):
+    """Minimal safetensors writer (splitter + tests)."""
+    import jax.numpy as jnp
+    header: dict = {}
+    if metadata:
+        header["__metadata__"] = metadata
+    offset = 0
+    blobs = []
+    inv = {v: k for k, v in SAFETENSORS_DTYPES.items()}
+    for name, arr in tensors.items():
+        dt_name = jnp.dtype(arr.dtype).name
+        blob = np.ascontiguousarray(arr).tobytes()
+        header[name] = {
+            "dtype": inv[dt_name],
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        offset += len(blob)
+        blobs.append(blob)
+    hjson = json.dumps(header).encode()
+    pad = (-len(hjson)) % 8
+    hjson += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for b in blobs:
+            f.write(b)

@@ -1,0 +1,51 @@
+"""`cake split`: write per-worker safetensors bundles from layer ranges so
+workers can be provisioned out-of-band instead of streaming weights at setup
+(ref: utils/split.rs:155).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .safetensors_io import TensorStorage, layer_of, save_safetensors
+
+
+def split_model(model_dir: str, assignments: dict[str, tuple[int, int]],
+                out_dir: str, num_layers: int) -> dict[str, str]:
+    """assignments: worker name -> [lo, hi) layer range. Non-layer tensors
+    (embed/norm/head) go to every bundle that needs them: embed with layer 0,
+    head with the last layer. Returns worker -> bundle path."""
+    st = TensorStorage.from_model_dir(model_dir)
+    out_paths: dict[str, str] = {}
+    os.makedirs(out_dir, exist_ok=True)
+    for worker, (lo, hi) in assignments.items():
+        tensors = {}
+        for name in st.names():
+            li = layer_of(name)
+            if li is not None:
+                keep = lo <= li < hi
+            elif "embed_tokens" in name:
+                keep = lo == 0          # embeddings ride with layer 0
+            elif "lm_head" in name or ".norm." in name or name.endswith("norm.weight"):
+                keep = hi == num_layers  # final norm + head with the last layer
+            else:
+                keep = True             # unclassified non-layer: every bundle
+            if keep:
+                tensors[name] = st.read(name)
+        wdir = os.path.join(out_dir, worker)
+        os.makedirs(wdir, exist_ok=True)
+        path = os.path.join(wdir, "model.safetensors")
+        save_safetensors(path, tensors,
+                         metadata={"layers": f"{lo}-{hi - 1}"})
+        # each bundle is a loadable model dir: copy config + tokenizer files
+        for aux in ("config.json", "tokenizer.json", "tokenizer_config.json",
+                    "generation_config.json"):
+            src = os.path.join(model_dir, aux)
+            if os.path.exists(src):
+                with open(src, "rb") as f:
+                    data = f.read()
+                with open(os.path.join(wdir, aux), "wb") as f:
+                    f.write(data)
+        out_paths[worker] = path
+    st.close()
+    return out_paths

@@ -1,0 +1,170 @@
+"""GGUF loading tests: binary header round-trip, name mapping, block
+dequantizers vs scalar reference implementations of the ggml layouts."""
+import struct
+
+import numpy as np
+import pytest
+
+from cake_tpu.utils.gguf import (GGUF_MAGIC, GgufReader, GgufStorage,
+                                 dequant_q4_0, dequant_q4_k, dequant_q6_k,
+                                 dequant_q8_0, gguf_config_dict,
+                                 gguf_to_hf_name)
+
+
+def _w_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def _w_kv_u32(key, val) -> bytes:
+    return _w_str(key) + struct.pack("<II", 4, val)
+
+
+def _w_kv_f32(key, val) -> bytes:
+    return _w_str(key) + struct.pack("<If", 6, val)
+
+
+def _w_kv_str(key, val) -> bytes:
+    return _w_str(key) + struct.pack("<I", 8) + _w_str(val)
+
+
+def write_tiny_gguf(path, tensors: dict[str, np.ndarray], meta_arch="llama"):
+    """Minimal GGUF v3 writer for tests (F32 tensors only)."""
+    kvs = [
+        _w_kv_str("general.architecture", meta_arch),
+        _w_kv_u32("general.alignment", 32),
+        _w_kv_u32(f"{meta_arch}.embedding_length", 64),
+        _w_kv_u32(f"{meta_arch}.block_count", 2),
+        _w_kv_u32(f"{meta_arch}.attention.head_count", 4),
+        _w_kv_u32(f"{meta_arch}.attention.head_count_kv", 2),
+        _w_kv_u32(f"{meta_arch}.feed_forward_length", 128),
+        _w_kv_u32(f"{meta_arch}.context_length", 512),
+        _w_kv_f32(f"{meta_arch}.rope.freq_base", 10000.0),
+        _w_kv_f32(f"{meta_arch}.attention.layer_norm_rms_epsilon", 1e-5),
+        _w_kv_u32("tokenizer.ggml.eos_token_id", 2),
+    ]
+    infos = []
+    data = b""
+    for name, arr in tensors.items():
+        # ggml dims reversed: innermost first
+        dims = tuple(reversed(arr.shape))
+        infos.append(_w_str(name)
+                     + struct.pack("<I", len(dims))
+                     + struct.pack(f"<{len(dims)}Q", *dims)
+                     + struct.pack("<IQ", 0, len(data)))      # F32
+        blob = np.ascontiguousarray(arr, np.float32).tobytes()
+        data += blob + b"\0" * ((-len(blob)) % 32)
+    header = struct.pack("<IIQQ", GGUF_MAGIC, 3, len(tensors), len(kvs))
+    body = header + b"".join(kvs) + b"".join(infos)
+    pad = (-len(body)) % 32
+    with open(path, "wb") as f:
+        f.write(body + b"\0" * pad + data)
+
+
+def test_gguf_read_roundtrip(tmp_path, rng):
+    w = rng.standard_normal((8, 64)).astype(np.float32)
+    e = rng.standard_normal((256, 64)).astype(np.float32)
+    p = str(tmp_path / "m.gguf")
+    write_tiny_gguf(p, {"blk.0.attn_q.weight": w, "token_embd.weight": e})
+    r = GgufReader(p)
+    assert r.metadata["llama.embedding_length"] == 64
+    np.testing.assert_array_equal(r.read_tensor("blk.0.attn_q.weight"), w)
+    np.testing.assert_array_equal(r.read_tensor("token_embd.weight"), e)
+    cfg = gguf_config_dict(r)
+    assert cfg["architectures"] == ["LlamaForCausalLM"]
+    assert cfg["vocab_size"] == 256 and cfg["num_key_value_heads"] == 2
+    assert cfg["eos_token_id"] == 2 and cfg["tie_word_embeddings"]
+
+    st = GgufStorage(p)
+    assert "model.layers.0.self_attn.q_proj.weight" in st
+    np.testing.assert_array_equal(
+        st.read("model.layers.0.self_attn.q_proj.weight"), w)
+
+
+def test_name_mapping():
+    assert gguf_to_hf_name("blk.3.ffn_gate.weight") == \
+        "model.layers.3.mlp.gate_proj.weight"
+    assert gguf_to_hf_name("blk.0.attn_norm.weight") == \
+        "model.layers.0.input_layernorm.weight"
+    assert gguf_to_hf_name("output.weight") == "lm_head.weight"
+    assert gguf_to_hf_name("rope_freqs.weight") is None
+
+
+def test_q4_0_dequant():
+    # one block: d=0.5, qs nibbles 0..15 repeating
+    d = np.float16(0.5).tobytes()
+    qs = bytes(range(16))
+    got = dequant_q4_0(d + qs, 32)
+    lo = np.array([q & 0xF for q in range(16)], np.float32)
+    hi = np.array([q >> 4 for q in range(16)], np.float32)
+    want = np.concatenate([lo, hi])
+    np.testing.assert_allclose(got, (want - 8) * 0.5)
+
+
+def test_q8_0_dequant():
+    d = np.float16(0.25).tobytes()
+    q = np.arange(-16, 16, dtype=np.int8)
+    got = dequant_q8_0(d + q.tobytes(), 32)
+    np.testing.assert_allclose(got, q.astype(np.float32) * 0.25)
+
+
+def _scalar_q4k(block: bytes) -> np.ndarray:
+    """Scalar reference following ggml dequantize_row_q4_K."""
+    d = np.frombuffer(block[0:2], np.float16)[0].astype(np.float32)
+    dmin = np.frombuffer(block[2:4], np.float16)[0].astype(np.float32)
+    scales = block[4:16]
+    qs = block[16:144]
+    def sm(j):
+        if j < 4:
+            return scales[j] & 63, scales[j + 4] & 63
+        sc = (scales[j + 4] & 0xF) | ((scales[j - 4] >> 6) << 4)
+        m = (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4)
+        return sc, m
+    y = np.zeros(256, np.float32)
+    is_ = 0
+    qoff = 0
+    for j in range(0, 256, 64):
+        sc1, m1 = sm(is_)
+        sc2, m2 = sm(is_ + 1)
+        for l in range(32):
+            y[j + l] = d * sc1 * (qs[qoff + l] & 0xF) - dmin * m1
+            y[j + 32 + l] = d * sc2 * (qs[qoff + l] >> 4) - dmin * m2
+        is_ += 2
+        qoff += 32
+    return y
+
+
+def test_q4_k_dequant_vs_scalar(rng):
+    block = bytes(np.float16(0.33).tobytes()) + bytes(np.float16(0.11).tobytes()) \
+        + bytes(rng.integers(0, 256, 12, dtype=np.uint32).astype(np.uint8)) \
+        + bytes(rng.integers(0, 256, 128, dtype=np.uint32).astype(np.uint8))
+    got = dequant_q4_k(block, 256)
+    np.testing.assert_allclose(got, _scalar_q4k(block), atol=1e-4)
+
+
+def _scalar_q6k(block: bytes) -> np.ndarray:
+    ql = block[0:128]
+    qh = block[128:192]
+    sc = np.frombuffer(block[192:208], np.int8)
+    d = np.frombuffer(block[208:210], np.float16)[0].astype(np.float32)
+    y = np.zeros(256, np.float32)
+    for n in range(2):
+        yo, qlo, qho, so = n * 128, n * 64, n * 32, n * 8
+        for l in range(32):
+            is_ = l // 16
+            q1 = ((ql[qlo + l] & 0xF) | (((qh[qho + l] >> 0) & 3) << 4)) - 32
+            q2 = ((ql[qlo + l + 32] & 0xF) | (((qh[qho + l] >> 2) & 3) << 4)) - 32
+            q3 = ((ql[qlo + l] >> 4) | (((qh[qho + l] >> 4) & 3) << 4)) - 32
+            q4 = ((ql[qlo + l + 32] >> 4) | (((qh[qho + l] >> 6) & 3) << 4)) - 32
+            y[yo + l] = d * sc[so + is_] * q1
+            y[yo + l + 32] = d * sc[so + is_ + 2] * q2
+            y[yo + l + 64] = d * sc[so + is_ + 4] * q3
+            y[yo + l + 96] = d * sc[so + is_ + 6] * q4
+    return y
+
+
+def test_q6_k_dequant_vs_scalar(rng):
+    block = bytes(rng.integers(0, 256, 208, dtype=np.uint32).astype(np.uint8)) \
+        + np.float16(0.77).tobytes()
+    got = dequant_q6_k(block, 256)
+    np.testing.assert_allclose(got, _scalar_q6k(block), atol=1e-4)

@@ -1,0 +1,96 @@
+"""Sharding tests on the 8-device virtual CPU mesh: TP/DP inference parity,
+ring attention vs single-device reference, sharded train step, EP MoE."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models import TextModel, init_params, tiny_config
+from cake_tpu.models.common.cache import init_cache
+from cake_tpu.models.common.layers import forward_train
+from cake_tpu.ops.attention import causal_sdpa
+from cake_tpu.parallel import (make_mesh, make_train_step, params_shardings,
+                               ring_attention, shard_cache, shard_params)
+
+
+def test_mesh_creation():
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3, "tp": 4})
+
+
+def test_tp_sharded_forward_matches_single_device():
+    """The SAME forward jitted with tp-sharded params must produce the same
+    logits as unsharded execution (GSPMD inserts the collectives)."""
+    cfg = tiny_config("qwen2", num_key_value_heads=4)   # kv 4 % tp 4 == 0
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 255, (2, 16)))
+
+    ref = forward_train(cfg, params, toks)
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    sharded = shard_params(params, mesh)
+    got = jax.jit(lambda p, t: forward_train(cfg, p, t))(sharded, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-3,
+                               rtol=1e-3)
+    # weights really are distributed
+    w = sharded["layers"][0]["self_attn"]["q_proj"]["weight"]
+    assert len(w.sharding.device_set) == 8 or len(w.addressable_shards) > 1
+
+
+def test_tp_sharded_decode_with_cache():
+    cfg = tiny_config("llama", num_key_value_heads=4)
+    params = init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    model = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=32)
+    logits_ref, _ = model.prefill(model.new_cache(), [1, 2, 3, 4, 5])
+
+    mesh = make_mesh({"tp": 4}, devices=jax.devices()[:4])
+    model_sh = TextModel(cfg, shard_params(params, mesh), dtype=jnp.float32,
+                         max_cache_len=32)
+    cache = shard_cache(model_sh.new_cache(), mesh)
+    logits_sh, _ = model_sh.prefill(cache, [1, 2, 3, 4, 5])
+    np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(logits_ref),
+                               atol=2e-3, rtol=1e-3)
+
+
+def test_ring_attention_matches_causal_sdpa():
+    mesh = make_mesh({"sp": 8})
+    b, s, h, hkv, d = 2, 64, 4, 2, 16
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    ref = causal_sdpa(q, k, v)
+    got = ring_attention(q, k, v, mesh, axis="sp")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_train_step_dp_tp():
+    cfg = tiny_config("llama", num_key_value_heads=4, vocab_size=64)
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    params = shard_params(params, mesh)
+    step, opt_state = make_train_step(cfg, mesh, params)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 63, (4, 17)))
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert losses[2] < losses[0]          # it actually optimizes
+    assert np.isfinite(losses).all()
+
+
+def test_ep_moe_sharded_forward():
+    cfg = tiny_config("qwen3_moe", num_key_value_heads=4)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 255, (1, 8)))
+    ref = forward_train(cfg, params, toks)
+    mesh = make_mesh({"ep": 4, "tp": 2})
+    sharded = shard_params(params, mesh)
+    w = sharded["layers"][0]["mlp"]["experts"]["gate_proj"]
+    assert len(w.addressable_shards) > 1
+    got = jax.jit(lambda p, t: forward_train(cfg, p, t))(sharded, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-3,
+                               rtol=1e-3)

@@ -1,0 +1,86 @@
+"""Hub/model-manager/splitter tests (ref: utils/{hf,models,split}.rs)."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models import init_params, tiny_config
+from cake_tpu.utils.export import params_to_hf_tensors
+from cake_tpu.utils.hub import looks_like_repo_id, probe_cached_repo, resolve_model
+from cake_tpu.utils.models import delete_model, find_model, list_models
+from cake_tpu.utils.safetensors_io import TensorStorage, save_safetensors
+from cake_tpu.utils.split import split_model
+
+
+def test_looks_like_repo_id(tmp_path):
+    assert looks_like_repo_id("Qwen/Qwen3-0.6B")
+    assert not looks_like_repo_id("not-a-repo")
+    assert not looks_like_repo_id("a/b/c")
+    assert not looks_like_repo_id(str(tmp_path))
+
+
+def test_resolve_model_local(tmp_path):
+    assert resolve_model(str(tmp_path)) == str(tmp_path)
+
+
+def test_hub_cache_probe_and_manager(tmp_path, monkeypatch):
+    monkeypatch.setenv("HF_HUB_CACHE", str(tmp_path / "hub"))
+    monkeypatch.setenv("CAKE_TPU_CACHE", str(tmp_path / "cake"))
+    snap = tmp_path / "hub" / "models--org--tiny" / "snapshots" / "abc"
+    snap.mkdir(parents=True)
+    save_safetensors(str(snap / "model.safetensors"),
+                     {"w": np.ones((2, 2), np.float32)})
+    (snap / "config.json").write_text("{}")
+
+    assert probe_cached_repo("org/tiny") == str(snap)
+    models = list_models()
+    assert len(models) == 1
+    m = models[0]
+    assert m.repo_id == "org/tiny" and m.complete and m.size_bytes > 0
+    assert find_model("org/tiny") is not None
+    assert resolve_model("org/tiny") == str(snap)
+
+    assert delete_model("org/tiny")
+    assert find_model("org/tiny") is None
+
+
+def test_incomplete_model_detected(tmp_path, monkeypatch):
+    monkeypatch.setenv("HF_HUB_CACHE", str(tmp_path / "hub"))
+    monkeypatch.setenv("CAKE_TPU_CACHE", str(tmp_path / "nope"))
+    snap = tmp_path / "hub" / "models--org--broken" / "snapshots" / "abc"
+    snap.mkdir(parents=True)
+    (snap / "model.safetensors").write_text("")   # zero-byte weight
+    (snap / "config.json").write_text("{}")
+    m = list_models()[0]
+    assert not m.complete
+
+
+def test_split_model(tmp_path):
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    tensors = params_to_hf_tensors(cfg, params)
+    mdir = tmp_path / "model"
+    mdir.mkdir()
+    save_safetensors(str(mdir / "model.safetensors"), tensors)
+    (mdir / "config.json").write_text(json.dumps(
+        {"architectures": ["LlamaForCausalLM"]}))
+    (mdir / "tokenizer.json").write_text("{}")
+
+    out = split_model(str(mdir), {"w0": (0, 2), "w1": (2, 4)},
+                      str(tmp_path / "out"), cfg.num_hidden_layers)
+    st0 = TensorStorage.from_model_dir(os.path.dirname(out["w0"]))
+    st1 = TensorStorage.from_model_dir(os.path.dirname(out["w1"]))
+    assert "model.layers.0.self_attn.q_proj.weight" in st0
+    assert "model.layers.1.self_attn.q_proj.weight" in st0
+    assert "model.layers.2.self_attn.q_proj.weight" not in st0
+    assert "model.layers.2.self_attn.q_proj.weight" in st1
+    # embed goes with layer 0, head/norm with the last layer
+    assert "model.embed_tokens.weight" in st0
+    assert "model.norm.weight" in st1
+    assert "lm_head.weight" in st1
+    # bundles carry config/tokenizer
+    assert os.path.exists(os.path.join(os.path.dirname(out["w0"]), "config.json"))
+    assert os.path.exists(os.path.join(os.path.dirname(out["w1"]),
+                                       "tokenizer.json"))

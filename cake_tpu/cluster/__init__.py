@@ -1,0 +1,15 @@
+"""Distributed runtime: discovery, auth, wire protocol, topology/strategy,
+weight streaming, master/worker/client (ref: cake-core/src/cake/sharding/).
+
+Pipeline-style layer sharding over the LAN — the reference's core strategy
+(SURVEY §2g) — with each node's contiguous range compiled to one XLA call.
+"""
+from .auth import AuthError, cluster_hash
+from .client import RemoteStage
+from .discovery import (WorkerAdvertiser, detect_capabilities,
+                        discover_workers)
+from .master import (DistributedTextModel, MasterSetup, Stage,
+                     master_setup, plan_assignments)
+from .strategy import DefaultStrategy, WorkerCapacity, estimate_layer_bytes
+from .topology import Node, Topology, expand_layer_specs
+from .worker import WorkerServer, run_worker

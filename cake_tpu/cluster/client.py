@@ -1,0 +1,132 @@
+"""Master-side proxy for a remote worker: implements the same
+forward_hidden(x, cache, pos0, valid_len) stage interface as LocalStage,
+over the framed TCP protocol (ref: cake-core/src/cake/sharding/client.rs:
+13-188 — forward_batch ships a contiguous layer range in one round trip;
+here every remote call is one round trip by construction).
+
+Sync sockets: the master generation loop is sequential per token (the
+pipeline is a chain), so async buys nothing on this path.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import time
+
+import numpy as np
+
+from . import proto
+from .auth import AuthError, _mac, CHALLENGE_LEN, MAC_LEN
+
+log = logging.getLogger("cake_tpu.client")
+
+CONNECT_RETRIES = 3          # ref: sharding/mod.rs:385-431 exp backoff
+CONNECT_BACKOFF = 1.0
+
+
+class RemoteStage:
+    """A connected, authenticated channel to one worker."""
+
+    def __init__(self, host: str, port: int, cluster_key: str,
+                 name: str = "?", timeout: float = 120.0):
+        self.host, self.port = host, port
+        self.cluster_key = cluster_key
+        self.name = name
+        self.timeout = timeout
+        self.sock: socket.socket | None = None
+        self.info: dict = {}
+        self._rid = 0
+
+    # -- connection --------------------------------------------------------
+
+    def connect(self):
+        last = None
+        for attempt in range(CONNECT_RETRIES):
+            try:
+                self.sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout)
+                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._auth()
+                proto.write_frame_sync(self.sock, proto.hello("master"))
+                self.info = proto.read_frame_sync(self.sock)
+                return self
+            except (OSError, AuthError) as e:
+                last = e
+                if self.sock:
+                    self.sock.close()
+                    self.sock = None
+                wait = CONNECT_BACKOFF * (2 ** attempt)
+                log.warning("connect to %s:%d failed (%s), retry in %.1fs",
+                            self.host, self.port, e, wait)
+                time.sleep(wait)
+        raise ConnectionError(
+            f"cannot reach worker {self.name} at {self.host}:{self.port}: {last}")
+
+    def _auth(self):
+        """Master side of the mutual HMAC handshake (sync mirror of
+        auth.authenticate_as_master)."""
+        import os as _os
+        cw = self._recv_exact(CHALLENGE_LEN)
+        cm = _os.urandom(CHALLENGE_LEN)
+        self.sock.sendall(_mac(self.cluster_key, cw) + cm)
+        their = self._recv_exact(MAC_LEN)
+        import hmac as _hmac
+        if not _hmac.compare_digest(their, _mac(self.cluster_key, cm)):
+            raise AuthError("worker failed authentication")
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("socket closed during auth")
+            buf += chunk
+        return buf
+
+    # -- setup -------------------------------------------------------------
+
+    def assign(self, assignment: dict) -> dict:
+        proto.write_frame_sync(self.sock, assignment)
+        return proto.read_frame_sync(self.sock)      # ack or worker_error
+
+    def push_weights(self, chunk_msgs) -> None:
+        for m in chunk_msgs:
+            proto.write_frame_sync(self.sock, m)
+        proto.write_frame_sync(self.sock, proto.model_done())
+
+    def wait_ready(self) -> dict:
+        msg = proto.read_frame_sync(self.sock)
+        if msg.get("t") != "worker_ready" or not msg.get("ok", False):
+            raise RuntimeError(
+                f"worker {self.name} setup failed: {msg.get('error', msg)}")
+        return msg
+
+    # -- inference (stage interface) ----------------------------------------
+
+    def forward_hidden(self, x, cache, pos0, valid_len):
+        """cache is managed worker-side per connection; the local `cache`
+        slot is passed through untouched (None)."""
+        self._rid += 1
+        proto.write_frame_sync(self.sock, proto.forward(
+            np.asarray(x), int(pos0),
+            None if valid_len is None else int(valid_len), self._rid))
+        msg = proto.read_frame_sync(self.sock)
+        if msg.get("t") == "worker_error":
+            raise RuntimeError(f"worker {self.name}: {msg['error']}")
+        if msg.get("rid", self._rid) != self._rid:
+            raise proto.ProtocolError("response id mismatch")
+        return proto.unpack_tensor(msg["x"]), cache
+
+    def goodbye(self):
+        try:
+            proto.write_frame_sync(self.sock, proto.goodbye())
+            proto.read_frame_sync(self.sock)
+        except OSError:
+            pass
+
+    def close(self):
+        if self.sock:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None

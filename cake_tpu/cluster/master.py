@@ -1,0 +1,322 @@
+"""Master node: cluster bring-up and distributed text generation.
+
+Bring-up (ref: cake-core/src/cake/sharding/mod.rs master_setup:162-506):
+discover workers -> estimate per-layer bytes from safetensors headers ->
+TFLOPS-proportional assignment -> connect + authenticate + assign ->
+stream the worker's layer-subset weights (zstd+CRC32, content-keyed cache)
+-> await worker_ready. The master keeps unassigned layers, the embeddings
+and the head (ref: Context VarBuilder excluding worker layers).
+
+Generation (ref: master.rs:109-171 + text_model.rs forward loop): the stage
+chain [local ranges | remote workers] runs per token; each local range is
+one jit call, each remote range one TCP round trip; embeddings, head and
+sampling stay on the master device.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.common.cache import init_cache
+from ..models.common.config import ModelConfig
+from ..models.common.layers import (embed_tokens, forward_layers,
+                                    lm_head_logits)
+from ..models.common.text_model import (PREFILL_BUCKETS, LocalStage, Token,
+                                        bucket_for)
+from ..ops.sampling import SamplingConfig, push_recent_token, sample
+from .auth import cluster_hash
+from .client import RemoteStage
+from .strategy import DefaultStrategy, WorkerCapacity, estimate_layer_bytes
+from .topology import Topology
+from . import proto, transfer
+
+log = logging.getLogger("cake_tpu.master")
+
+
+@dataclass
+class Stage:
+    kind: str                  # "local" | "remote"
+    start: int
+    end: int
+    runner: object             # LocalStage or RemoteStage
+    cache: object = None       # local KV cache (remote keeps its own)
+
+
+class DistributedTextModel:
+    """TextModel over a stage chain. Single local stage == plain TextModel
+    semantics; remote stages hop hidden states over the wire."""
+
+    def __init__(self, cfg: ModelConfig, master_params: dict,
+                 stages: list[Stage], tokenizer=None, dtype=jnp.bfloat16,
+                 max_cache_len: int = 2048, seed: int = 42):
+        self.cfg = cfg
+        self.params = master_params       # embed + head (+ local stage params)
+        self.stages = stages
+        self.tokenizer = tokenizer
+        self.dtype = dtype
+        self.max_cache_len = max_cache_len
+        self._rng = jax.random.PRNGKey(seed)
+
+        @jax.jit
+        def _embed(params, tokens):
+            return embed_tokens(cfg, params, tokens)
+
+        @jax.jit
+        def _head(params, x_last):
+            return lm_head_logits(cfg, params, x_last)[:, 0]
+
+        self._embed = _embed
+        self._head = _head
+        self._sample = jax.jit(
+            lambda l, k, rec, scfg: sample(l, k, scfg, rec),
+            static_argnames=("scfg",))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def reset(self):
+        for s in self.stages:
+            if s.kind == "local":
+                s.cache = init_cache(self.cfg, 1, self.max_cache_len,
+                                     self.dtype, (s.start, s.end))
+            else:
+                s.runner.goodbye()
+
+    # -- forward ------------------------------------------------------------
+
+    def _run_stages(self, x, pos0: int, valid_len: int | None):
+        pos = jnp.asarray(pos0, jnp.int32)
+        vl = None if valid_len is None else jnp.asarray(valid_len, jnp.int32)
+        for s in self.stages:
+            if s.kind == "local":
+                x, s.cache = s.runner.forward_hidden(
+                    jnp.asarray(x).astype(self.dtype), s.cache, pos, vl)
+            else:
+                x, _ = s.runner.forward_hidden(
+                    np.asarray(x), None, pos0, valid_len)
+        return x
+
+    def prefill_logits(self, token_ids: list[int], pos0: int = 0):
+        n = len(token_ids)
+        bkt = bucket_for(n, self.max_cache_len)
+        padded = np.zeros((1, bkt), np.int32)
+        padded[0, :n] = token_ids
+        x = self._embed(self.params, jnp.asarray(padded))
+        x = self._run_stages(x, pos0, n)
+        x = jnp.asarray(x)[:, n - 1:n]
+        return self._head(self.params, x.astype(self.dtype))
+
+    def decode_logits(self, token_id: int, pos: int):
+        x = self._embed(self.params, jnp.asarray([[token_id]], jnp.int32))
+        x = self._run_stages(x, pos, None)
+        return self._head(self.params, jnp.asarray(x)[:, -1:].astype(self.dtype))
+
+    # -- generation ---------------------------------------------------------
+
+    def generate(self, prompt_ids: list[int], max_new_tokens: int = 256,
+                 sampling: SamplingConfig | None = None, on_token=None,
+                 rng=None, **_):
+        scfg = sampling or SamplingConfig()
+        rng = self._rng if rng is None else rng
+        self.reset()
+        out: list[int] = []
+        recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
+
+        t0 = time.monotonic()
+        logits = self.prefill_logits(prompt_ids)
+        rng, sk = jax.random.split(rng)
+        tok = self._sample(logits[0], sk, recent, scfg)
+        recent = push_recent_token(recent, tok)
+        ttft = time.monotonic() - t0
+
+        pos = len(prompt_ids)
+        tid = int(tok)
+        out.append(tid)
+        if on_token:
+            on_token(self._mk_token(tid))
+
+        t1 = time.monotonic()
+        budget = self.max_cache_len - len(prompt_ids) - 1
+        max_new_tokens = min(max_new_tokens, max(budget, 1))
+        while not self.cfg.is_eos(tid) and len(out) < max_new_tokens:
+            logits = self.decode_logits(tid, pos)
+            rng, sk = jax.random.split(rng)
+            tok = self._sample(logits[0], sk, recent, scfg)
+            recent = push_recent_token(recent, tok)
+            tid = int(tok)
+            pos += 1
+            out.append(tid)
+            if on_token:
+                on_token(self._mk_token(tid))
+        dt = time.monotonic() - t1
+        stats = {"ttft_s": ttft, "decode_tokens": len(out) - 1,
+                 "decode_s": dt,
+                 "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0}
+        return out, stats
+
+    def _mk_token(self, tid: int) -> Token:
+        text = None
+        if self.tokenizer is not None:
+            try:
+                text = self.tokenizer.decode([tid])
+            except Exception:
+                pass
+        return Token(id=tid, text=text, is_end_of_stream=self.cfg.is_eos(tid))
+
+
+# ---------------------------------------------------------------------------
+# Cluster bring-up
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MasterSetup:
+    cfg: ModelConfig
+    topology: Topology
+    stages: list[Stage]
+    master_params: dict
+    clients: list[RemoteStage] = field(default_factory=list)
+
+
+def plan_assignments(cfg: ModelConfig, storage, workers: list[dict],
+                     quant_factor: float = 1.0) -> dict[str, tuple[int, int]]:
+    """TFLOPS-proportional contiguous ranges from discovery replies."""
+    caps = [WorkerCapacity(name=w["name"],
+                           memory_bytes=w["caps"]["memory_bytes"],
+                           tflops=w["caps"]["tflops"],
+                           backend=w["caps"].get("backend", "tpu"))
+            for w in workers]
+    layer_bytes = estimate_layer_bytes(storage, cfg.num_hidden_layers,
+                                       quant_factor)
+    plan = DefaultStrategy().assign_layers(
+        caps, list(range(cfg.num_hidden_layers)), layer_bytes)
+    out = {}
+    for name, layers in plan.items():
+        if layers:
+            out[name] = (min(layers), max(layers) + 1)
+    return out
+
+
+def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
+                 workers: list[dict],
+                 assignments: dict[str, tuple[int, int]] | None = None,
+                 dtype_str: str = "bf16", max_cache_len: int = 2048,
+                 push_weights: bool = True,
+                 master_device_fraction_reserved: float = 0.1) -> MasterSetup:
+    """Connect/auth/assign/push to each worker; build the stage chain.
+
+    workers: discovery replies ({"name", "host", "port", "caps"}).
+    """
+    import json
+    import os
+
+    from ..utils.loaders import load_model_params
+    from ..utils.safetensors_io import TensorStorage
+
+    storage = TensorStorage.from_model_dir(model_dir)
+    if assignments is None:
+        assignments = plan_assignments(cfg, storage, workers)
+    with open(os.path.join(model_dir, "config.json")) as f:
+        config_raw = json.load(f)
+    mhash = transfer.model_hash(model_dir)
+    ckey = transfer.cache_key(cluster_hash(cluster_key), mhash)
+
+    # workers sorted by their range start -> stage order
+    ordered = sorted(((name, rng) for name, rng in assignments.items()),
+                     key=lambda kv: kv[1][0])
+    clients: list[RemoteStage] = []
+    worker_by_name = {w["name"]: w for w in workers}
+    n = cfg.num_hidden_layers
+
+    for name, (start, end) in ordered:
+        w = worker_by_name[name]
+        client = RemoteStage(w["host"], w["port"], cluster_key, name).connect()
+        names = transfer.subset_tensor_names(storage, start, end, n,
+                                             include_embed=False,
+                                             include_head=False)
+        expected = {}
+        if push_weights:
+            total, chunks = transfer.synthesize_safetensors(storage, names)
+            expected["model.safetensors"] = total
+        assignment = proto.layer_assignment(
+            model_id=mhash, arch=cfg.arch, config=config_raw,
+            start=start, end=end, dtype=dtype_str, cache_key=ckey,
+            push_weights=push_weights)
+        assignment["max_cache_len"] = max_cache_len
+        assignment["expected_files"] = expected
+        resp = client.assign(assignment)
+        if resp.get("t") == "worker_error":
+            raise RuntimeError(f"worker {name}: {resp['error']}")
+        if push_weights and not transfer_cached(resp):
+            total, chunks = transfer.synthesize_safetensors(storage, names)
+            client.push_weights(
+                transfer.encode_chunks("model.safetensors", total, chunks))
+        client.wait_ready()
+        clients.append(client)
+        log.info("worker %s ready with layers [%d,%d)", name, start, end)
+
+    # master keeps the unassigned layers
+    assigned = set()
+    for start, end in assignments.values():
+        assigned |= set(range(start, end))
+    master_layers = [i for i in range(n) if i not in assigned]
+
+    # build the ordered stage chain
+    stages: list[Stage] = []
+    ranges: list[tuple[str, int, int, object]] = []
+    for name, (start, end) in ordered:
+        ranges.append(("remote", start, end,
+                       clients[[nm for nm, _ in ordered].index(name)]))
+    for lo, hi in _contiguous(master_layers):
+        ranges.append(("local", lo, hi, None))
+    ranges.sort(key=lambda r: r[1])
+
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32,
+             "f16": jnp.float16}.get(dtype_str, jnp.bfloat16)
+    master_params = load_model_params(cfg, model_dir, dtype,
+                                      layer_range=(0, 0),
+                                      include_embed=True, include_head=True)
+    for kind, lo, hi, runner in ranges:
+        if kind == "local":
+            p = load_model_params(cfg, model_dir, dtype, layer_range=(lo, hi),
+                                  include_embed=False, include_head=False)
+            runner = LocalStage(cfg, p, lo, hi)
+            cache = init_cache(cfg, 1, max_cache_len, dtype, (lo, hi))
+            stages.append(Stage("local", lo, hi, runner, cache))
+        else:
+            stages.append(Stage("remote", lo, hi, runner))
+
+    topo = Topology.from_dict({
+        name: {"host": f"{worker_by_name[name]['host']}:"
+                       f"{worker_by_name[name]['port']}",
+               "layers": [f"model.layers.{s}-{e - 1}"],
+               "memory_bytes": worker_by_name[name]["caps"]["memory_bytes"],
+               "tflops": worker_by_name[name]["caps"]["tflops"],
+               "backend": worker_by_name[name]["caps"].get("backend", "")}
+        for name, (s, e) in assignments.items()})
+    storage.close()
+    return MasterSetup(cfg=cfg, topology=topo, stages=stages,
+                       master_params=master_params, clients=clients)
+
+
+def transfer_cached(ack_msg: dict) -> bool:
+    return bool(ack_msg.get("cached", False))
+
+
+def _contiguous(layers: list[int]) -> list[tuple[int, int]]:
+    if not layers:
+        return []
+    out = []
+    lo = prev = layers[0]
+    for i in layers[1:]:
+        if i != prev + 1:
+            out.append((lo, prev + 1))
+            lo = i
+        prev = i
+    out.append((lo, prev + 1))
+    return out

@@ -1,0 +1,192 @@
+"""Wire protocol: length-prefixed binary framing + msgpack-encoded control
+messages + raw-buffer tensor payloads.
+
+Same three-plane shape as the reference's custom protocol (ref:
+cake-core/src/cake/sharding/proto/{mod.rs,message.rs}: u32 magic + u32 len
+framing with a 512 MB cap, speedy-serialized Message enum, RawTensor with
+dtype tag + shape) — re-designed for this stack: msgpack for the control
+fields (self-describing, zero-copy bin for tensor bytes) and the TPU dtype
+set (bf16, f8e4m3) in the tag table (utils/dtypes.py WIRE_DTYPES).
+
+Message types (parity with ref message.rs:191-247):
+  hello, worker_info          - handshake + capability report
+  layer_assignment, ack       - setup
+  model_chunk, model_done, model_resume - weight streaming (zstd + CRC32)
+  worker_ready, worker_error  - readiness / per-op failure
+  forward                     - activation shipping for a contiguous layer
+                                range in ONE round trip (subsumes the
+                                reference's SingleOp + Batch: a worker range
+                                is always one jit call here)
+  tensor                      - result tensor
+  goodbye                     - clear per-connection state
+
+The byte-level framing (pack/unpack, CRC32) also exists natively in
+csrc/cakekit.cpp; this module uses it when built.
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+import zlib
+from typing import Any
+
+import msgpack
+import numpy as np
+
+from ..utils.dtypes import WIRE_DTYPES, WIRE_TAGS, from_numpy_bytes
+
+MAGIC = 0x54504B31          # "TPK1"
+MAX_FRAME = 512 * 1024 * 1024   # ref: proto/mod.rs 512 MB cap
+_HDR = struct.Struct("<II")
+
+
+class ProtocolError(Exception):
+    pass
+
+
+# -- tensors ----------------------------------------------------------------
+
+def pack_tensor(arr) -> dict:
+    """numpy/jax array -> wire dict with dtype tag + shape + raw bytes
+    (ref: RawTensor::from_tensor, zero-copy where possible)."""
+    a = np.asarray(arr)
+    name = a.dtype.name if a.dtype.name in WIRE_TAGS else str(a.dtype)
+    if name not in WIRE_TAGS:
+        raise ProtocolError(f"unsupported wire dtype {a.dtype}")
+    return {"dt": WIRE_TAGS[name], "sh": list(a.shape),
+            "d": a.tobytes()}
+
+
+def unpack_tensor(obj: dict) -> np.ndarray:
+    dt = WIRE_DTYPES.get(obj["dt"])
+    if dt is None:
+        raise ProtocolError(f"unknown dtype tag {obj['dt']}")
+    return from_numpy_bytes(obj["d"], dt, tuple(obj["sh"]))
+
+
+# -- framing ----------------------------------------------------------------
+
+def encode_frame(msg: dict) -> bytes:
+    payload = msgpack.packb(msg, use_bin_type=True)
+    if len(payload) > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {len(payload)}")
+    return _HDR.pack(MAGIC, len(payload)) + payload
+
+
+def decode_payload(payload: bytes) -> dict:
+    return msgpack.unpackb(payload, raw=False)
+
+
+async def read_frame(reader: asyncio.StreamReader) -> dict:
+    hdr = await reader.readexactly(_HDR.size)
+    magic, length = _HDR.unpack(hdr)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic:#x}")
+    if length > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {length}")
+    payload = await reader.readexactly(length)
+    return decode_payload(payload)
+
+
+async def write_frame(writer: asyncio.StreamWriter, msg: dict):
+    writer.write(encode_frame(msg))
+    await writer.drain()
+
+
+def read_frame_sync(sock) -> dict:
+    buf = b""
+    while len(buf) < _HDR.size:
+        chunk = sock.recv(_HDR.size - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-header")
+        buf += chunk
+    magic, length = _HDR.unpack(buf)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic:#x}")
+    if length > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {length}")
+    chunks = []
+    got = 0
+    while got < length:
+        chunk = sock.recv(min(1 << 20, length - got))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return decode_payload(b"".join(chunks))
+
+
+def write_frame_sync(sock, msg: dict):
+    sock.sendall(encode_frame(msg))
+
+
+# -- message constructors ---------------------------------------------------
+
+def hello(name: str, version: str = "1") -> dict:
+    return {"t": "hello", "name": name, "v": version}
+
+
+def worker_info(name: str, layers: list[int], backend: str, device: str,
+                memory_bytes: int, tflops: float) -> dict:
+    return {"t": "worker_info", "name": name, "layers": layers,
+            "backend": backend, "device": device,
+            "memory_bytes": memory_bytes, "tflops": tflops}
+
+
+def layer_assignment(model_id: str, arch: str, config: dict,
+                     start: int, end: int, dtype: str,
+                     cache_key: str, push_weights: bool) -> dict:
+    return {"t": "layer_assignment", "model_id": model_id, "arch": arch,
+            "config": config, "start": start, "end": end, "dtype": dtype,
+            "cache_key": cache_key, "push_weights": push_weights}
+
+
+def model_chunk(file_name: str, index: int, total: int, data: bytes,
+                crc32: int, compressed: bool, offset: int) -> dict:
+    return {"t": "model_chunk", "file": file_name, "i": index, "n": total,
+            "d": data, "crc": crc32, "z": compressed, "off": offset}
+
+
+def model_done() -> dict:
+    return {"t": "model_done"}
+
+
+def model_resume(file_name: str, offset: int) -> dict:
+    """Partial-transfer resume point (ref: ModelDataResume message.rs:238-242)."""
+    return {"t": "model_resume", "file": file_name, "off": offset}
+
+
+def worker_ready(ok: bool = True, error: str | None = None) -> dict:
+    return {"t": "worker_ready", "ok": ok, "error": error}
+
+
+def worker_error(message: str) -> dict:
+    return {"t": "worker_error", "error": message}
+
+
+def forward(x, pos0: int, valid_len: int | None, request_id: int = 0) -> dict:
+    return {"t": "forward", "x": pack_tensor(x), "pos0": int(pos0),
+            "valid_len": None if valid_len is None else int(valid_len),
+            "rid": request_id}
+
+
+def tensor_result(arr, request_id: int = 0) -> dict:
+    return {"t": "tensor", "x": pack_tensor(arr), "rid": request_id}
+
+
+def goodbye() -> dict:
+    return {"t": "goodbye"}
+
+
+def ack() -> dict:
+    return {"t": "ack"}
+
+
+def crc32(data: bytes) -> int:
+    try:
+        from ..utils import cakekit
+        if cakekit.available():
+            return cakekit.crc32(data)
+    except ImportError:
+        pass
+    return zlib.crc32(data) & 0xFFFFFFFF

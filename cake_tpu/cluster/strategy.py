@@ -1,0 +1,86 @@
+"""Layer-assignment strategy: TFLOPS-proportional contiguous ranges capped by
+per-device memory, with master-overflow redistribution
+(ref: cake-core/src/cake/sharding/default.rs:10-170 DefaultStrategy +
+sharding/mod.rs:37-98 Strategy/WorkerCapacity/memory reserves).
+
+Memory reserves by backend (fraction withheld from capacity; ref values:
+5% CUDA / 28% unified / 20% CPU — TPU gets 10% for XLA scratch + compiled
+program buffers):
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+MEMORY_RESERVE = {"tpu": 0.10, "cuda": 0.05, "metal": 0.28, "cpu": 0.20}
+DEFAULT_RESERVE = 0.15
+
+
+@dataclass
+class WorkerCapacity:
+    name: str
+    memory_bytes: int
+    tflops: float
+    backend: str = "tpu"
+
+    @property
+    def usable_bytes(self) -> int:
+        r = MEMORY_RESERVE.get(self.backend, DEFAULT_RESERVE)
+        return int(self.memory_bytes * (1.0 - r))
+
+
+class Strategy:
+    """Pluggable assignment interface (ref: sharding/mod.rs:37-52)."""
+
+    def assign_layers(self, workers: list[WorkerCapacity], layers: list[int],
+                      layer_bytes: list[int]) -> dict[str, list[int]]:
+        raise NotImplementedError
+
+
+class DefaultStrategy(Strategy):
+    """Contiguous ranges proportional to TFLOPS, each capped by the worker's
+    usable memory; layers that fit nowhere stay unassigned (the master keeps
+    them — ref: default.rs master-overflow redistribution)."""
+
+    def assign_layers(self, workers, layers, layer_bytes):
+        plan: dict[str, list[int]] = {w.name: [] for w in workers}
+        if not workers or not layers:
+            return plan
+        total_tflops = sum(max(w.tflops, 1e-9) for w in workers)
+        remaining = list(layers)
+        # strongest workers first: they take their proportional share from
+        # the front so ranges stay contiguous
+        order = sorted(workers, key=lambda w: -w.tflops)
+        n_total = len(layers)
+        for idx, w in enumerate(order):
+            if not remaining:
+                break
+            share = max(w.tflops, 1e-9) / total_tflops
+            want = max(1, round(share * n_total))
+            if idx == len(order) - 1:
+                want = len(remaining)          # last worker offered the rest
+            take: list[int] = []
+            used = 0
+            budget = w.usable_bytes if w.memory_bytes else None
+            for li in remaining[:want]:
+                b = layer_bytes[li] if li < len(layer_bytes) else 0
+                if budget is not None and used + b > budget:
+                    break
+                take.append(li)
+                used += b
+            plan[w.name] = take
+            remaining = remaining[len(take):]
+        return plan
+
+
+def estimate_layer_bytes(storage, num_layers: int,
+                         quant_factor: float = 1.0) -> list[int]:
+    """Per-layer parameter bytes from safetensors headers — no tensor data
+    read (ref: default.rs:189-307 layer-size estimation; quant_factor is the
+    dequant VRAM expansion, ref: sharding/mod.rs:262-273)."""
+    from ..utils.safetensors_io import layer_of
+    sizes = [0] * num_layers
+    for name in storage.names():
+        li = layer_of(name)
+        if li is not None and li < num_layers:
+            sizes[li] += storage.nbytes(name)
+    return [int(s * quant_factor) for s in sizes]

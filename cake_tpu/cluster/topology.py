@@ -1,0 +1,138 @@
+"""Cluster topology: name -> node map with layer ranges.
+
+YAML format (ref: cake-core/src/cake/sharding/topology.rs:17-169, incl. the
+`model.layers.0-5` range syntax and auto-assignment when `layers: []`):
+
+    worker-a:
+      host: 10.0.0.2:10128
+      layers: ["model.layers.0-13"]
+      memory_bytes: 17179869184     # optional capability overrides
+      tflops: 394.0
+      backend: tpu
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import yaml
+
+_RANGE_RE = re.compile(r"^(?:model\.)?layers\.(\d+)(?:-(\d+))?$")
+
+
+@dataclass
+class Node:
+    name: str
+    host: str                      # "ip:port"
+    layers: list[int] = field(default_factory=list)
+    memory_bytes: int = 0
+    tflops: float = 0.0
+    backend: str = ""
+    hostname: str = ""
+    os: str = ""
+
+    @property
+    def layer_range(self) -> tuple[int, int] | None:
+        if not self.layers:
+            return None
+        lo, hi = min(self.layers), max(self.layers)
+        if sorted(self.layers) != list(range(lo, hi + 1)):
+            raise ValueError(f"{self.name}: non-contiguous layers {self.layers}")
+        return lo, hi + 1
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        host, _, port = self.host.partition(":")
+        return host, int(port or 10128)
+
+
+def expand_layer_specs(specs: list) -> list[int]:
+    """["model.layers.0-5", "layers.7"] -> [0,1,2,3,4,5,7]
+    (ref: topology.rs range regex :13 + from_path expansion)."""
+    out: list[int] = []
+    for s in specs:
+        if isinstance(s, int):
+            out.append(s)
+            continue
+        m = _RANGE_RE.match(str(s).strip())
+        if not m:
+            raise ValueError(f"bad layer spec {s!r}")
+        lo = int(m.group(1))
+        hi = int(m.group(2)) if m.group(2) else lo
+        if hi < lo:
+            raise ValueError(f"descending layer range {s!r}")
+        out.extend(range(lo, hi + 1))
+    return out
+
+
+class Topology:
+    def __init__(self, nodes: dict[str, Node] | None = None):
+        self.nodes: dict[str, Node] = nodes or {}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Topology":
+        nodes = {}
+        for name, spec in (d or {}).items():
+            nodes[name] = Node(
+                name=name,
+                host=str(spec.get("host", "")),
+                layers=expand_layer_specs(spec.get("layers", []) or []),
+                memory_bytes=int(spec.get("memory_bytes",
+                                          spec.get("vram_bytes", 0)) or 0),
+                tflops=float(spec.get("tflops", 0.0) or 0.0),
+                backend=str(spec.get("backend", "")),
+                hostname=str(spec.get("hostname", "")),
+                os=str(spec.get("os", "")),
+            )
+        return cls(nodes)
+
+    @classmethod
+    def from_path(cls, path: str) -> "Topology":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+    def to_dict(self) -> dict:
+        out = {}
+        for name, n in self.nodes.items():
+            lr = n.layer_range
+            out[name] = {
+                "host": n.host,
+                "layers": ([f"model.layers.{lr[0]}-{lr[1] - 1}"] if lr else []),
+                "memory_bytes": n.memory_bytes,
+                "tflops": n.tflops,
+                "backend": n.backend,
+            }
+        return out
+
+    def get_node_for_layer(self, layer: int) -> Node | None:
+        """(ref: topology.rs get_node_for_layer:184-193)"""
+        for n in self.nodes.values():
+            if layer in n.layers:
+                return n
+        return None
+
+    def assigned_layers(self) -> set[int]:
+        out: set[int] = set()
+        for n in self.nodes.values():
+            overlap = out & set(n.layers)
+            if overlap:
+                raise ValueError(f"layer(s) {sorted(overlap)} assigned twice")
+            out |= set(n.layers)
+        return out
+
+    def needs_auto_assignment(self) -> bool:
+        return any(not n.layers for n in self.nodes.values())
+
+    def auto_assign_layers(self, strategy, num_layers: int,
+                           layer_bytes: list[int]):
+        """Fill empty `layers: []` nodes via the Strategy
+        (ref: topology.rs auto_assign_layers_with_strategy:225-263)."""
+        from .strategy import WorkerCapacity
+        caps = [WorkerCapacity(name=n.name, memory_bytes=n.memory_bytes,
+                               tflops=n.tflops)
+                for n in self.nodes.values() if not n.layers]
+        taken = self.assigned_layers()
+        free = [i for i in range(num_layers) if i not in taken]
+        plan = strategy.assign_layers(caps, free, layer_bytes)
+        for name, layers in plan.items():
+            self.nodes[name].layers = layers

@@ -1,0 +1,184 @@
+"""Master->worker weight streaming with zstd compression, CRC32 integrity,
+and a content-keyed worker-side cache.
+
+Reference semantics preserved (ref: cake-core/src/cake/sharding/mod.rs):
+  * chunked streaming with per-chunk CRC32 (:697) and zstd level 1 gated by
+    a compressibility probe on the first 4 KB (:669-694);
+  * worker cache keyed {cluster_hash}-{model_hash} where model_hash =
+    sha256(config.json)[:8] (:898-907), validated before re-transfer
+    (has_valid_model_cache :768-807);
+  * resume support for partial transfers (ModelDataResume).
+
+TPU-first difference: instead of shipping whole checkpoint files, the master
+streams a *synthesized* safetensors file containing exactly the worker's
+layer subset (built from the pread index — no full-model read), so transfer
+bytes == assigned bytes.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+from typing import Iterable, Iterator
+
+import zstandard
+
+from ..utils.safetensors_io import TensorStorage, layer_of
+from . import proto
+
+CHUNK_SIZE = 8 * 1024 * 1024
+PROBE_LEN = 4096
+_INV_ST_DTYPES = None
+
+
+def model_hash(model_dir: str) -> str:
+    with open(os.path.join(model_dir, "config.json"), "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:8]
+
+
+def cache_key(cluster_key_hash: str, mhash: str) -> str:
+    return f"{cluster_key_hash}-{mhash}"
+
+
+def subset_tensor_names(storage: TensorStorage, start: int, end: int,
+                        num_layers: int, include_embed: bool | None = None,
+                        include_head: bool | None = None) -> list[str]:
+    """Names a worker holding layers [start, end) needs."""
+    if include_embed is None:
+        include_embed = start == 0
+    if include_head is None:
+        include_head = end == num_layers
+    names = []
+    for name in storage.names():
+        li = layer_of(name)
+        if li is not None:
+            if start <= li < end:
+                names.append(name)
+        elif "embed_tokens" in name:
+            if include_embed or include_head:   # tied heads read the table
+                names.append(name)
+        elif include_head:
+            names.append(name)
+    return sorted(names)
+
+
+def synthesize_safetensors(storage: TensorStorage, names: list[str],
+                           chunk_size: int = CHUNK_SIZE) -> tuple[int, Iterator[bytes]]:
+    """Build a valid safetensors byte stream for a tensor subset without
+    materializing it: (total_size, chunk iterator)."""
+    global _INV_ST_DTYPES
+    if _INV_ST_DTYPES is None:
+        from ..utils.dtypes import SAFETENSORS_DTYPES
+        _INV_ST_DTYPES = {v: k for k, v in SAFETENSORS_DTYPES.items()}
+    header: dict = {}
+    offset = 0
+    for n in names:
+        r = storage.records[n]
+        header[n] = {"dtype": _INV_ST_DTYPES[r.dtype], "shape": list(r.shape),
+                     "data_offsets": [offset, offset + r.nbytes]}
+        offset += r.nbytes
+    hjson = json.dumps(header).encode()
+    hjson += b" " * ((-len(hjson)) % 8)
+    total = 8 + len(hjson) + offset
+
+    def gen() -> Iterator[bytes]:
+        buf = struct.pack("<Q", len(hjson)) + hjson
+        for n in names:
+            buf += storage.read_bytes(n)
+            while len(buf) >= chunk_size:
+                yield buf[:chunk_size]
+                buf = buf[chunk_size:]
+        if buf:
+            yield buf
+
+    return total, gen()
+
+
+def should_compress(sample: bytes) -> bool:
+    """zstd only pays off for compressible data — probe the first 4 KB
+    (ref: sharding/mod.rs:669-694)."""
+    probe = sample[:PROBE_LEN]
+    if not probe:
+        return False
+    compressed = zstandard.ZstdCompressor(level=1).compress(probe)
+    return len(compressed) < int(len(probe) * 0.9)
+
+
+def encode_chunks(file_name: str, total: int, chunks: Iterable[bytes],
+                  start_offset: int = 0) -> Iterator[dict]:
+    """bytes chunks -> model_chunk protocol messages."""
+    cctx = zstandard.ZstdCompressor(level=1)
+    offset = start_offset
+    n_total = max(1, (total + CHUNK_SIZE - 1) // CHUNK_SIZE)
+    i = 0
+    for chunk in chunks:
+        if offset + len(chunk) <= start_offset:
+            offset += len(chunk)       # resume: skip already-sent bytes
+            continue
+        if offset < start_offset:      # partial overlap
+            chunk = chunk[start_offset - offset:]
+            offset = start_offset
+        compress = should_compress(chunk)
+        data = cctx.compress(chunk) if compress else chunk
+        yield proto.model_chunk(file_name, i, n_total, data,
+                                proto.crc32(data), compress, offset)
+        offset += len(chunk)
+        i += 1
+
+
+class ModelReceiver:
+    """Worker-side chunk sink: verifies CRC, decompresses, writes into the
+    content-keyed cache dir (ref: receive_model_data:940-1099)."""
+
+    def __init__(self, cache_root: str, key: str):
+        self.dir = os.path.join(cache_root, key)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files: dict[str, object] = {}
+        self._dctx = zstandard.ZstdDecompressor()
+
+    def path(self, file_name: str) -> str:
+        safe = os.path.basename(file_name)
+        return os.path.join(self.dir, safe)
+
+    def resume_offset(self, file_name: str) -> int:
+        """How many bytes we already have (partial-transfer resume)."""
+        p = self.path(file_name) + ".part"
+        return os.path.getsize(p) if os.path.exists(p) else 0
+
+    def on_chunk(self, msg: dict):
+        data = msg["d"]
+        if proto.crc32(data) != msg["crc"]:
+            raise proto.ProtocolError(
+                f"CRC mismatch on {msg['file']} chunk {msg['i']}")
+        if msg["z"]:
+            data = self._dctx.decompress(data, max_output_size=2 * CHUNK_SIZE)
+        p = self.path(msg["file"]) + ".part"
+        f = self._files.get(p)
+        if f is None:
+            f = open(p, "r+b" if os.path.exists(p) else "wb")
+            self._files[p] = f
+        f.seek(msg["off"])
+        f.write(data)
+
+    def finalize(self):
+        for p, f in self._files.items():
+            f.close()
+            os.replace(p, p[:-len(".part")])
+        self._files.clear()
+
+    def write_json(self, name: str, obj: dict):
+        with open(os.path.join(self.dir, name), "w") as f:
+            json.dump(obj, f)
+
+
+def has_valid_model_cache(cache_root: str, key: str,
+                          expected: dict[str, int]) -> bool:
+    """expected: file name -> exact byte size. Validated against the cached
+    files before any re-transfer (ref: has_valid_model_cache:768-807)."""
+    d = os.path.join(cache_root, key)
+    for name, size in expected.items():
+        p = os.path.join(d, os.path.basename(name))
+        if not os.path.exists(p) or os.path.getsize(p) != size:
+            return False
+    return True

@@ -1,0 +1,270 @@
+"""Worker node: advertises itself, authenticates the master, receives a layer
+assignment (+ optionally streamed weights), then serves forward requests —
+its whole contiguous layer range executing as ONE jit-compiled device call
+per request (ref: cake-core/src/cake/sharding/worker.rs; the reference's
+per-op dispatch loop :299-580 collapses into a single compiled range here).
+
+Failure semantics match the reference: a failed forward answers
+worker_error and keeps the connection loop alive (:425-431,477-516); a new
+layer_assignment on a live socket re-runs setup (master restart, :316-330);
+goodbye clears the per-connection cache (:364-384); each connection gets a
+fresh KV cache (get_client_context :60-75).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.common.cache import cache_reset, init_cache
+from ..models.common.config import config_from_hf_dict
+from ..models.common.text_model import LocalStage
+from ..utils.dtypes import parse_dtype
+from ..utils.hub import cake_cache_dir
+from . import proto
+from .auth import authenticate_as_worker, cluster_hash
+from .discovery import WorkerAdvertiser, detect_capabilities
+from .transfer import ModelReceiver, has_valid_model_cache
+
+log = logging.getLogger("cake_tpu.worker")
+
+
+class WorkerState:
+    """Model state shared by all connections after a layer assignment."""
+
+    def __init__(self):
+        self.cfg = None
+        self.stage: LocalStage | None = None
+        self.start = 0
+        self.end = 0
+        self.dtype = jnp.bfloat16
+        self.max_cache_len = 2048
+        self.model_id = ""
+
+    @property
+    def loaded(self) -> bool:
+        return self.stage is not None
+
+
+class WorkerServer:
+    def __init__(self, name: str, cluster_key: str, port: int = 10128,
+                 model_dir: str | None = None, cache_root: str | None = None,
+                 advertise: bool = True, discovery_port: int | None = None,
+                 host: str = "0.0.0.0"):
+        self.name = name
+        self.cluster_key = cluster_key
+        self.port = port
+        self.host = host
+        self.model_dir = model_dir          # pre-provisioned weights (cake split)
+        self.cache_root = cache_root or os.path.join(cake_cache_dir(), "worker")
+        self.advertise = advertise
+        self.discovery_port = discovery_port
+        self.caps = detect_capabilities()
+        self.state = WorkerState()
+        self._advertiser = None
+        self._server: asyncio.AbstractServer | None = None
+        self.stats = {"ops": 0, "tokens": 0, "fwd_s": 0.0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        if self.advertise:
+            kw = {}
+            if self.discovery_port is not None:
+                kw["discovery_port"] = self.discovery_port
+            self._advertiser = WorkerAdvertiser(
+                self.name, self.cluster_key, self.port, caps=self.caps,
+                **kw).start()
+        log.info("worker %s listening on %s:%d", self.name, self.host, self.port)
+        return self
+
+    async def serve_forever(self):
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self):
+        if self._advertiser:
+            self._advertiser.stop()
+        if self._server:
+            self._server.close()
+            # bounded: py3.12 wait_closed blocks until all live master
+            # connections drop, which may be never during teardown
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2)
+            except (TimeoutError, asyncio.TimeoutError):
+                pass
+
+    # -- connection handling -------------------------------------------------
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter):
+        peer = writer.get_extra_info("peername")
+        try:
+            await authenticate_as_worker(reader, writer, self.cluster_key)
+        except Exception as e:
+            log.warning("auth failed from %s: %s", peer, e)
+            writer.close()
+            return
+        cache = None
+        try:
+            while True:
+                msg = await proto.read_frame(reader)
+                t = msg.get("t")
+                if t == "hello":
+                    await proto.write_frame(writer, proto.worker_info(
+                        self.name,
+                        list(range(self.state.start, self.state.end)),
+                        self.caps["backend"], self.caps["device"],
+                        self.caps["memory_bytes"], self.caps["tflops"]))
+                elif t == "layer_assignment":
+                    cache = None
+                    await self._handle_assignment(msg, reader, writer)
+                elif t == "forward":
+                    if not self.state.loaded:
+                        await proto.write_frame(writer, proto.worker_error(
+                            "no layer assignment"))
+                        continue
+                    if cache is None:
+                        cache = self._fresh_cache()
+                    cache = await self._handle_forward(msg, writer, cache)
+                elif t == "goodbye":
+                    if cache is not None:
+                        cache = cache_reset(cache)
+                    await proto.write_frame(writer, proto.ack())
+                else:
+                    await proto.write_frame(writer, proto.worker_error(
+                        f"unexpected message {t!r}"))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as e:
+            log.exception("connection error from %s: %s", peer, e)
+        finally:
+            writer.close()
+
+    # -- setup ---------------------------------------------------------------
+
+    async def _handle_assignment(self, msg, reader, writer):
+        st = self.state
+        st.model_id = msg["model_id"]
+        st.start, st.end = int(msg["start"]), int(msg["end"])
+        st.dtype = parse_dtype(msg["dtype"])
+        st.max_cache_len = int(msg.get("max_cache_len", 2048))
+        cfg = config_from_hf_dict(msg["config"], msg.get("arch") or None)
+        st.cfg = cfg
+        key = msg["cache_key"]
+        expected = msg.get("expected_files", {})
+
+        # ack tells the master whether weights are already present so it can
+        # skip the push (content-keyed cache, ref: has_valid_model_cache)
+        model_dir = self.model_dir
+        if model_dir is None:
+            cached = has_valid_model_cache(self.cache_root, key, expected)
+            if not cached and msg["push_weights"]:
+                a = proto.ack()
+                a["cached"] = False
+                await proto.write_frame(writer, a)
+                model_dir = await self._receive_weights(reader, key, msg)
+            elif cached:
+                a = proto.ack()
+                a["cached"] = True
+                await proto.write_frame(writer, a)
+                model_dir = os.path.join(self.cache_root, key)
+            else:
+                await proto.write_frame(writer, proto.worker_error(
+                    "no weights: not cached and push disabled"))
+                return
+        else:
+            a = proto.ack()
+            a["cached"] = True
+            await proto.write_frame(writer, a)
+
+        try:
+            t0 = time.monotonic()
+            from ..utils.loaders import load_model_params
+            params = load_model_params(
+                cfg, model_dir, st.dtype, layer_range=(st.start, st.end),
+                include_embed=False, include_head=False)
+            st.stage = LocalStage(cfg, params, st.start, st.end)
+            # warm the decode-shape compile so the first token isn't slow
+            # (ref hard-part #7: warm during setup, not on first token)
+            cache = self._fresh_cache()
+            x = jnp.zeros((1, 1, cfg.hidden_size), st.dtype)
+            st.stage.forward_hidden(x, cache, jnp.asarray(0, jnp.int32), None)
+            log.info("worker %s loaded layers [%d,%d) in %.1fs", self.name,
+                     st.start, st.end, time.monotonic() - t0)
+            await proto.write_frame(writer, proto.worker_ready())
+        except Exception as e:
+            log.exception("assignment failed")
+            await proto.write_frame(writer, proto.worker_ready(
+                ok=False, error=str(e)))
+            st.stage = None
+
+    async def _receive_weights(self, reader, key: str, assign_msg) -> str:
+        recv = ModelReceiver(self.cache_root, key)
+        # resume partial transfers (ref: ModelDataResume)
+        while True:
+            msg = await proto.read_frame(reader)
+            if msg["t"] == "model_chunk":
+                recv.on_chunk(msg)
+            elif msg["t"] == "model_done":
+                recv.finalize()
+                recv.write_json("config.json", assign_msg["config_raw"]
+                                if "config_raw" in assign_msg
+                                else assign_msg["config"])
+                break
+            else:
+                raise proto.ProtocolError(
+                    f"unexpected {msg['t']!r} during weight transfer")
+        return recv.dir
+
+    # -- inference -----------------------------------------------------------
+
+    def _fresh_cache(self):
+        st = self.state
+        return init_cache(st.cfg, 1, st.max_cache_len, st.dtype,
+                          layer_range=(st.start, st.end))
+
+    async def _handle_forward(self, msg, writer, cache):
+        st = self.state
+        t0 = time.monotonic()
+        try:
+            x = jnp.asarray(proto.unpack_tensor(msg["x"])).astype(st.dtype)
+            pos0 = jnp.asarray(msg["pos0"], jnp.int32)
+            vl = msg.get("valid_len")
+            vl = None if vl is None else jnp.asarray(vl, jnp.int32)
+            loop = asyncio.get_running_loop()
+            y, cache = await loop.run_in_executor(
+                None, lambda: st.stage.forward_hidden(x, cache, pos0, vl))
+            await proto.write_frame(
+                writer, proto.tensor_result(np.asarray(y), msg.get("rid", 0)))
+        except Exception as e:
+            log.exception("forward failed")
+            await proto.write_frame(writer, proto.worker_error(str(e)))
+            return cache
+        dt = time.monotonic() - t0
+        self.stats["ops"] += 1
+        self.stats["fwd_s"] += dt
+        self.stats["tokens"] += int(np.prod(np.asarray(msg["x"]["sh"][:2])))
+        if self.stats["ops"] % 5 == 0:   # rolling stats (ref worker.rs:566-578)
+            log.debug("worker %s: %d ops, avg %.1f ms", self.name,
+                      self.stats["ops"],
+                      1000 * self.stats["fwd_s"] / self.stats["ops"])
+        return cache
+
+
+def run_worker(name: str, cluster_key: str, port: int = 10128,
+               model_dir: str | None = None, **kw):
+    """Blocking entry point (ref: cake-cli run_as_worker)."""
+    async def main():
+        server = WorkerServer(name, cluster_key, port, model_dir, **kw)
+        await server.start()
+        await server.serve_forever()
+    asyncio.run(main())

@@ -9,7 +9,7 @@ from .activations import (add3, add_scaled, adaln_modulate, exp_mul, gelu,
                           gelu_mul, gelu_tanh, sigmoid, silu, silu_mul,
                           softmax, stable_softplus, sub_mul)
 from .attention import (causal_sdpa, make_attention_mask,
-                        multi_head_attention, qk_norm)
+                        multi_head_attention)
 from .conv import (causal_depthwise_conv1d_update, conv1d, conv2d,
                    conv_transpose1d, depthwise_conv1d, depthwise_conv1d_silu)
 from .fp8 import dequant_fp8_blockwise, quant_fp8_blockwise

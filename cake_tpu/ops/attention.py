@@ -61,18 +61,6 @@ def multi_head_attention(q, k, v, mask=None, scale: float | None = None):
     return out.reshape(b, sq, hq, d).astype(q.dtype)
 
 
-def qk_norm(q, k, q_weight, k_weight, eps: float, pre_reshape: bool = False):
-    """QK RMS-normalization, both placements (ref: attention.rs:176-215).
-
-    post-reshape (Qwen3/Gemma3): q,k are [B,S,H,D], weights are [D].
-    pre-reshape (OLMo2): q,k are [B,S,H*D] flat, weights are [H*D].
-    The math is identical (norm over the last axis) — the distinction is which
-    axis is last at the time of application, so callers pick the call site.
-    """
-    from .norms import rms_norm
-    return rms_norm(q, q_weight, eps), rms_norm(k, k_weight, eps)
-
-
 def causal_sdpa(q, k, v, scale: float | None = None):
     """Plain causal attention for prefill without a cache (B,S,H,D)."""
     b, s = q.shape[0], q.shape[1]

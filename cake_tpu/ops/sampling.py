@@ -30,10 +30,13 @@ class SamplingConfig:
 def apply_repeat_penalty(logits, recent_tokens, penalty: float):
     """Sign-aware repeat penalty on device.
 
-    logits: [V]; recent_tokens: [N] int32 with -1 padding (dropped by the
-    scatter). logit >= 0 -> logit/penalty, logit < 0 -> logit*penalty
+    logits: [V] (unbatched — the scatter is along the vocab axis);
+    recent_tokens: [N] int32 with -1 padding (dropped by the scatter).
+    logit >= 0 -> logit/penalty, logit < 0 -> logit*penalty
     (ref: text_model.rs apply_repeat_penalty_gpu).
     """
+    if logits.ndim != 1:
+        raise ValueError("apply_repeat_penalty expects unbatched [V] logits")
     # -1 padding would wrap to the last vocab entry; remap to an out-of-bounds
     # positive index so mode="drop" discards it.
     idx = jnp.where(recent_tokens < 0, logits.shape[-1], recent_tokens)
@@ -73,8 +76,8 @@ def _top_p_mask(sorted_probs, p: float):
 
 def sample_top_p(logits, rng, p: float, temperature: float):
     lf = logits.astype(jnp.float32) / temperature
-    sorted_logits = jnp.sort(lf, axis=-1)[..., ::-1]
-    order = jnp.argsort(lf, axis=-1)[..., ::-1]
+    order = jnp.argsort(lf, axis=-1)[..., ::-1]          # one O(V log V) sort
+    sorted_logits = jnp.take_along_axis(lf, order, axis=-1)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     keep = _top_p_mask(probs, p)
     masked = jnp.where(keep, sorted_logits, -jnp.inf)
@@ -97,7 +100,8 @@ def sample_top_k_top_p(logits, rng, k: int, p: float, temperature: float):
 def sample(logits, rng, cfg: SamplingConfig, recent_tokens=None):
     """Dispatch on the static SamplingConfig (ref: create_logits_processor).
 
-    logits: [V] or [B, V]. recent_tokens: [N] int32 (-1 padded) or None.
+    logits: [V] ([B, V] allowed only when repeat_penalty is off — the
+    penalty scatter is vocab-axis only). recent_tokens: [N] int32 (-1 padded).
     """
     if cfg.repeat_penalty != 1.0 and recent_tokens is not None:
         logits = apply_repeat_penalty(logits, recent_tokens, cfg.repeat_penalty)

@@ -223,12 +223,15 @@ def gguf_to_hf_name(name: str, prefix: str = "model") -> str | None:
     return None
 
 
+# Only architectures whose full tensor set GGUF_NAME_MAP covers (standard
+# llama-layout decoders). MoE expert banks (ffn_*_exps) and sandwich/post
+# norm layouts (gemma3/olmo2/exaone4) need additional mappings — their GGUFs
+# are rejected with a clear error instead of mis-wiring norms.
 GGUF_ARCH_TO_HF = {
     "llama": "LlamaForCausalLM", "qwen2": "Qwen2ForCausalLM",
-    "qwen3": "Qwen3ForCausalLM", "qwen3moe": "Qwen3MoeForCausalLM",
+    "qwen3": "Qwen3ForCausalLM",
     "phi3": "Phi3ForCausalLM", "mistral": "MistralForCausalLM",
-    "gemma3": "Gemma3ForCausalLM", "falcon": "FalconForCausalLM",
-    "olmo2": "Olmo2ForCausalLM", "exaone4": "Exaone4ForCausalLM",
+    "falcon": "FalconForCausalLM",
 }
 
 
@@ -237,6 +240,10 @@ def gguf_config_dict(reader: GgufReader) -> dict:
     (ref: gguf.rs arch/config extraction)."""
     md = reader.metadata
     arch = md.get("general.architecture", "llama")
+    if arch not in GGUF_ARCH_TO_HF:
+        raise NotImplementedError(
+            f"GGUF architecture {arch!r} not yet supported (needs name-map "
+            f"entries beyond the llama layout)")
 
     def g(key, default=None):
         return md.get(f"{arch}.{key}", default)

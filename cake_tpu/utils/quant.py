@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-FP8_BLOCK = 128
+from ..ops.fp8 import FP8_BLOCK
 
 
 class NoQuantization:
@@ -35,11 +35,12 @@ class Fp8Quantization:
         scale_name = name.replace(".weight", ".weight_scale_inv")
         if not name.endswith(".weight") or scale_name not in storage:
             return storage.read(name)
-        w = storage.read(name).astype(np.float32)
+        from ..ops.fp8 import dequant_fp8_blockwise
+        import jax.numpy as jnp
+        w = storage.read(name)
         s = storage.read(scale_name).astype(np.float32)
-        o, i = w.shape
-        s_full = np.repeat(np.repeat(s, FP8_BLOCK, 0), FP8_BLOCK, 1)[:o, :i]
-        return w * s_full
+        return np.asarray(dequant_fp8_blockwise(
+            jnp.asarray(w), jnp.asarray(s), out_dtype=jnp.float32))
 
     def has(self, storage, name: str) -> bool:
         return name in storage
@@ -102,6 +103,10 @@ def detect_quantization(config: dict):
         method = qc.get("quant_method", "")
         if method == "gptq" or (qc.get("mode") == "affine"
                                 and qc.get("bits") == 4):
+            bits = int(qc.get("bits", 4))
+            if bits != 4:
+                raise NotImplementedError(
+                    f"GPTQ {bits}-bit not supported (4-bit only)")
             return GptqQuantization(int(qc.get("group_size", 128)))
         if method == "fp8" or qc.get("fmt") in ("e4m3", "float8_e4m3fn"):
             return Fp8Quantization()

@@ -11,10 +11,12 @@ from .safetensors_io import TensorStorage, layer_of, save_safetensors
 
 
 def split_model(model_dir: str, assignments: dict[str, tuple[int, int]],
-                out_dir: str, num_layers: int) -> dict[str, str]:
+                out_dir: str, num_layers: int,
+                tie_word_embeddings: bool = False) -> dict[str, str]:
     """assignments: worker name -> [lo, hi) layer range. Non-layer tensors
-    (embed/norm/head) go to every bundle that needs them: embed with layer 0,
-    head with the last layer. Returns worker -> bundle path."""
+    go to the bundle that needs them: embed with layer 0 (and with the last
+    layer too when the head is tied to it), final norm + head with the last
+    layer. Returns worker -> bundle path."""
     st = TensorStorage.from_model_dir(model_dir)
     out_paths: dict[str, str] = {}
     os.makedirs(out_dir, exist_ok=True)
@@ -25,7 +27,8 @@ def split_model(model_dir: str, assignments: dict[str, tuple[int, int]],
             if li is not None:
                 keep = lo <= li < hi
             elif "embed_tokens" in name:
-                keep = lo == 0          # embeddings ride with layer 0
+                # tied heads read the embedding table from the last bundle too
+                keep = lo == 0 or (tie_word_embeddings and hi == num_layers)
             elif "lm_head" in name or ".norm." in name or name.endswith("norm.weight"):
                 keep = hi == num_layers  # final norm + head with the last layer
             else:

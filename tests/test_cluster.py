@@ -1,0 +1,399 @@
+"""Cluster-plane tests without a cluster (mirrors ref tests/protocol.rs
+MockWorker + unit_tests/test_{topology,client_worker}.rs): wire round-trips,
+auth success/failure, topology parsing, strategy math, discovery on
+loopback, weight streaming, and a REAL master<->worker end-to-end
+distributed generation over localhost TCP."""
+import asyncio
+import json
+import os
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.cluster import proto
+from cake_tpu.cluster.auth import (AuthError, authenticate_as_master,
+                                   authenticate_as_worker, cluster_hash)
+from cake_tpu.cluster.discovery import WorkerAdvertiser, discover_workers
+from cake_tpu.cluster.strategy import (DefaultStrategy, WorkerCapacity,
+                                       estimate_layer_bytes)
+from cake_tpu.cluster.topology import Topology, expand_layer_specs
+from cake_tpu.cluster import transfer
+from cake_tpu.models import init_params, tiny_config
+from cake_tpu.utils.export import params_to_hf_tensors
+from cake_tpu.utils.safetensors_io import TensorStorage, save_safetensors
+
+
+# ---------------------------------------------------------------- protocol
+
+def test_tensor_roundtrip(rng):
+    for dt in (np.float32, np.float16, np.int32, np.uint8):
+        a = (rng.standard_normal((3, 5)) * 10).astype(dt)
+        b = proto.unpack_tensor(proto.pack_tensor(a))
+        np.testing.assert_array_equal(a, b)
+    bf = jnp.asarray(rng.standard_normal((2, 7)), jnp.bfloat16)
+    b = proto.unpack_tensor(proto.pack_tensor(np.asarray(bf)))
+    np.testing.assert_array_equal(np.asarray(bf), b)
+
+
+def test_frame_roundtrip():
+    msg = proto.forward(np.ones((1, 2, 4), np.float32), 5, 2, request_id=9)
+    frame = proto.encode_frame(msg)
+    # decode via the sync socket reader over a socketpair
+    a, b = socket.socketpair()
+    a.sendall(frame)
+    got = proto.read_frame_sync(b)
+    assert got["t"] == "forward" and got["pos0"] == 5 and got["rid"] == 9
+    np.testing.assert_array_equal(proto.unpack_tensor(got["x"]),
+                                  np.ones((1, 2, 4), np.float32))
+    a.close(); b.close()
+
+
+def test_frame_bad_magic():
+    a, b = socket.socketpair()
+    a.sendall(b"\x00\x00\x00\x00\x04\x00\x00\x00abcd")
+    with pytest.raises(proto.ProtocolError, match="bad magic"):
+        proto.read_frame_sync(b)
+    a.close(); b.close()
+
+
+# -------------------------------------------------------------------- auth
+
+def _run_auth(key_master, key_worker):
+    async def go():
+        server_done = asyncio.get_running_loop().create_future()
+
+        async def on_conn(r, w):
+            try:
+                await authenticate_as_worker(r, w, key_worker)
+                server_done.set_result(True)
+            except Exception as e:
+                server_done.set_result(e)
+            finally:
+                w.close()   # wait_closed below needs every transport gone
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            await authenticate_as_master(r, w, key_master)
+            client_ok = True
+        except AuthError as e:
+            client_ok = e
+        sres = await asyncio.wait_for(server_done, 5)
+        # close the client transport BEFORE wait_closed: 3.12's wait_closed
+        # blocks until every server-side transport is gone
+        w.close()
+        server.close()
+        await asyncio.wait_for(server.wait_closed(), 5)
+        return client_ok, sres
+    return asyncio.run(go())
+
+
+def test_auth_success():
+    c, s = _run_auth("secret", "secret")
+    assert c is True and s is True
+
+
+def test_auth_wrong_key():
+    c, s = _run_auth("secret", "other")
+    assert isinstance(c, AuthError) or isinstance(s, AuthError)
+
+
+def test_cluster_hash_stable():
+    assert cluster_hash("k") == cluster_hash("k")
+    assert cluster_hash("k") != cluster_hash("k2")
+    assert len(cluster_hash("k")) == 8
+
+
+# ---------------------------------------------------------------- topology
+
+def test_expand_layer_specs():
+    assert expand_layer_specs(["model.layers.0-5"]) == [0, 1, 2, 3, 4, 5]
+    assert expand_layer_specs(["layers.7", 9]) == [7, 9]
+    with pytest.raises(ValueError):
+        expand_layer_specs(["nope"])
+    with pytest.raises(ValueError):
+        expand_layer_specs(["model.layers.5-2"])
+
+
+def test_topology_yaml(tmp_path):
+    p = tmp_path / "topo.yml"
+    p.write_text("""
+w0:
+  host: 10.0.0.2:10128
+  layers: ["model.layers.0-13"]
+  tflops: 394
+w1:
+  host: 10.0.0.3:10128
+  layers: ["model.layers.14-27"]
+  memory_bytes: 17179869184
+""")
+    t = Topology.from_path(str(p))
+    assert t.nodes["w0"].layer_range == (0, 14)
+    assert t.nodes["w1"].layer_range == (14, 28)
+    assert t.get_node_for_layer(20).name == "w1"
+    assert t.get_node_for_layer(99) is None
+    assert t.assigned_layers() == set(range(28))
+    rt = Topology.from_dict(t.to_dict())
+    assert rt.nodes["w0"].layers == t.nodes["w0"].layers
+
+
+def test_topology_duplicate_layer_rejected():
+    t = Topology.from_dict({
+        "a": {"host": "x:1", "layers": ["layers.0-3"]},
+        "b": {"host": "y:1", "layers": ["layers.3-5"]},
+    })
+    with pytest.raises(ValueError, match="assigned twice"):
+        t.assigned_layers()
+
+
+# ---------------------------------------------------------------- strategy
+
+def test_strategy_proportional():
+    ws = [WorkerCapacity("fast", 0, 300.0), WorkerCapacity("slow", 0, 100.0)]
+    plan = DefaultStrategy().assign_layers(ws, list(range(16)), [0] * 16)
+    assert len(plan["fast"]) == 12 and len(plan["slow"]) == 4
+    assert plan["fast"] == list(range(12))
+    assert plan["slow"] == list(range(12, 16))
+
+
+def test_strategy_memory_cap():
+    ws = [WorkerCapacity("small", 10_000, 300.0, backend="tpu"),
+          WorkerCapacity("big", 10_000_000, 100.0, backend="tpu")]
+    layer_bytes = [4000] * 8
+    plan = DefaultStrategy().assign_layers(ws, list(range(8)), layer_bytes)
+    # small usable = 9000 -> only 2 layers fit
+    assert len(plan["small"]) == 2
+    assert len(plan["big"]) == 6          # last worker takes the rest
+
+
+def test_strategy_overflow_stays_unassigned():
+    ws = [WorkerCapacity("tiny", 5_000, 100.0)]
+    plan = DefaultStrategy().assign_layers(ws, list(range(8)), [4000] * 8)
+    assert len(plan["tiny"]) == 1         # master keeps the other 7
+
+
+def test_estimate_layer_bytes(tmp_path):
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    save_safetensors(str(tmp_path / "m.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    st = TensorStorage.from_model_dir(str(tmp_path))
+    sizes = estimate_layer_bytes(st, cfg.num_hidden_layers)
+    assert len(sizes) == 4 and all(s > 0 for s in sizes)
+    assert sizes[0] == sizes[1]
+    doubled = estimate_layer_bytes(st, 4, quant_factor=2.0)
+    assert doubled[0] == 2 * sizes[0]
+
+
+# --------------------------------------------------------------- discovery
+
+def test_discovery_loopback():
+    port = 19000 + os.getpid() % 500
+    adv = WorkerAdvertiser("w-test", "key1", 12345, discovery_port=port,
+                           caps={"backend": "tpu", "device": "TPU v5 lite",
+                                 "n_devices": 1, "memory_bytes": 16 << 30,
+                                 "tflops": 394.0}).start()
+    try:
+        found = discover_workers("key1", timeout=1.5, discovery_port=port,
+                                 expected=1)
+        assert len(found) == 1
+        w = found[0]
+        assert w["name"] == "w-test" and w["port"] == 12345
+        assert w["caps"]["backend"] == "tpu"
+        # wrong key sees nothing
+        none = discover_workers("other-key", timeout=0.5, discovery_port=port)
+        assert none == []
+    finally:
+        adv.stop()
+
+
+# ---------------------------------------------------------------- transfer
+
+def test_weight_streaming_roundtrip(tmp_path, rng):
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    mdir = tmp_path / "model"
+    mdir.mkdir()
+    save_safetensors(str(mdir / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    (mdir / "config.json").write_text(json.dumps(
+        {"architectures": ["LlamaForCausalLM"]}))
+    st = TensorStorage.from_model_dir(str(mdir))
+
+    names = transfer.subset_tensor_names(st, 1, 3, cfg.num_hidden_layers)
+    assert all(".layers.1." in n or ".layers.2." in n for n in names)
+    total, chunks = transfer.synthesize_safetensors(st, names, chunk_size=4096)
+
+    recv = transfer.ModelReceiver(str(tmp_path / "cache"), "abc-def")
+    n_chunks = 0
+    for msg in transfer.encode_chunks("model.safetensors", total, chunks):
+        recv.on_chunk(msg)
+        n_chunks += 1
+    assert n_chunks >= 2
+    recv.finalize()
+
+    out = TensorStorage.from_model_dir(recv.dir)
+    for n in names:
+        np.testing.assert_array_equal(out.read(n), st.read(n))
+    assert transfer.has_valid_model_cache(
+        str(tmp_path / "cache"), "abc-def", {"model.safetensors": total})
+    assert not transfer.has_valid_model_cache(
+        str(tmp_path / "cache"), "abc-def", {"model.safetensors": total + 1})
+
+
+def test_chunk_crc_rejected():
+    msg = proto.model_chunk("f", 0, 1, b"hello", 12345, False, 0)
+    recv = transfer.ModelReceiver("/tmp/cake-test-crc", "k")
+    with pytest.raises(proto.ProtocolError, match="CRC"):
+        recv.on_chunk(msg)
+
+
+# ----------------------------------------------- end-to-end master<->worker
+
+@pytest.fixture
+def cluster_model_dir(tmp_path):
+    cfg = tiny_config("qwen3")
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    mdir = tmp_path / "model"
+    mdir.mkdir()
+    save_safetensors(str(mdir / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    d = dict(architectures=["Qwen3ForCausalLM"], vocab_size=256,
+             hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+             num_attention_heads=4, num_key_value_heads=2, rms_norm_eps=1e-5,
+             rope_theta=10000.0, max_position_embeddings=128, eos_token_id=2)
+    (mdir / "config.json").write_text(json.dumps(d))
+    return cfg, params, str(mdir), str(tmp_path / "wcache")
+
+
+def _start_worker_thread(name, key, cache_root, ready):
+    """Run a WorkerServer on its own event loop thread; returns (thread,
+    port holder, stop fn)."""
+    from cake_tpu.cluster.worker import WorkerServer
+    holder = {}
+
+    def run():
+        async def main():
+            server = WorkerServer(name, key, port=0, cache_root=cache_root,
+                                  advertise=False)
+            await server.start()
+            holder["port"] = server.port
+            holder["server"] = server
+            ready.set()
+            try:
+                await server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+        loop = asyncio.new_event_loop()
+        holder["loop"] = loop
+        try:
+            loop.run_until_complete(main())
+        finally:
+            loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return holder, t
+
+
+def test_distributed_generation_matches_local(cluster_model_dir):
+    """Master + one real worker over localhost TCP, weights streamed, greedy
+    generation must match the fully-local model exactly."""
+    from cake_tpu.cluster.master import DistributedTextModel, master_setup
+    from cake_tpu.models import SamplingConfig, TextModel
+
+    cfg, params, mdir, wcache = cluster_model_dir
+    ready = threading.Event()
+    holder, t = _start_worker_thread("w0", "testkey", wcache, ready)
+    assert ready.wait(10)
+    port = holder["port"]
+
+    try:
+        setup = master_setup(
+            mdir, "testkey", cfg,
+            workers=[{"name": "w0", "host": "127.0.0.1", "port": port,
+                      "caps": {"backend": "cpu", "device": "cpu",
+                               "memory_bytes": 8 << 30, "tflops": 1.0}}],
+            assignments={"w0": (1, 3)},      # worker takes middle layers
+            dtype_str="f32", max_cache_len=64)
+        assert [s.kind for s in setup.stages] == ["local", "remote", "local"]
+
+        dist = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                    dtype=jnp.float32, max_cache_len=64)
+        got, stats = dist.generate([1, 2, 3, 4, 5], max_new_tokens=8,
+                                   sampling=SamplingConfig(temperature=0.0))
+
+        local = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+        want, _ = local.generate([1, 2, 3, 4, 5], max_new_tokens=8,
+                                 sampling=SamplingConfig(temperature=0.0))
+        assert got == want
+        assert stats["decode_tokens"] == len(got) - 1
+
+        # second generation on the same cluster (cache reset path)
+        got2, _ = dist.generate([1, 2, 3, 4, 5], max_new_tokens=8,
+                                sampling=SamplingConfig(temperature=0.0))
+        assert got2 == want
+
+        for c in setup.clients:
+            c.close()
+    finally:
+        loop = holder.get("loop")
+        srv = holder.get("server")
+        if loop and srv:
+            asyncio.run_coroutine_threadsafe(srv.stop(), loop)
+        t.join(timeout=5)
+
+
+def test_worker_cache_hit_skips_push(cluster_model_dir):
+    """Second master_setup against the same worker cache must not re-stream
+    (ref: content-keyed cache validation)."""
+    from cake_tpu.cluster.master import master_setup
+
+    cfg, _, mdir, wcache = cluster_model_dir
+    ready = threading.Event()
+    holder, t = _start_worker_thread("w0", "testkey", wcache, ready)
+    assert ready.wait(10)
+    port = holder["port"]
+    workers = [{"name": "w0", "host": "127.0.0.1", "port": port,
+                "caps": {"backend": "cpu", "device": "cpu",
+                         "memory_bytes": 8 << 30, "tflops": 1.0}}]
+    try:
+        s1 = master_setup(mdir, "testkey", cfg, workers,
+                          assignments={"w0": (1, 3)}, dtype_str="f32",
+                          max_cache_len=64)
+        for c in s1.clients:
+            c.close()
+        # second setup: worker should report cached=True
+        from cake_tpu.cluster.client import RemoteStage
+        from cake_tpu.cluster import proto as P, transfer as T
+        from cake_tpu.cluster.auth import cluster_hash
+        client = RemoteStage("127.0.0.1", port, "testkey", "w0").connect()
+        st = __import__("cake_tpu.utils.safetensors_io",
+                        fromlist=["TensorStorage"]).TensorStorage.from_model_dir(mdir)
+        names = T.subset_tensor_names(st, 1, 3, cfg.num_hidden_layers)
+        total, _ = T.synthesize_safetensors(st, names)
+        a = P.layer_assignment(
+            model_id=T.model_hash(mdir), arch=cfg.arch,
+            config=json.load(open(os.path.join(mdir, "config.json"))),
+            start=1, end=3, dtype="f32",
+            cache_key=T.cache_key(cluster_hash("testkey"), T.model_hash(mdir)),
+            push_weights=True)
+        a["max_cache_len"] = 64
+        a["expected_files"] = {"model.safetensors": total}
+        resp = client.assign(a)
+        assert resp.get("cached") is True
+        client.wait_ready()
+        client.close()
+    finally:
+        loop = holder.get("loop")
+        srv = holder.get("server")
+        if loop and srv:
+            asyncio.run_coroutine_threadsafe(srv.stop(), loop)
+        t.join(timeout=5)

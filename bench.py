@@ -25,6 +25,25 @@ import numpy as np
 BASELINE_TOK_S = 185.7
 
 
+def _watchdog(seconds: int):
+    """Hard-exit if the TPU grant service wedges mid-compile (observed in
+    this environment): better a clean failure JSON than a silent hang."""
+    import os
+    import threading
+
+    def boom():
+        print(json.dumps({"metric": "qwen3_0.6b_decode", "value": 0.0,
+                          "unit": "tok/s", "vs_baseline": 0.0,
+                          "error": f"watchdog: no result in {seconds}s"}),
+              flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, boom)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny model quick check")
@@ -32,7 +51,9 @@ def main():
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--chunk", type=int, default=64)
     ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--watchdog", type=int, default=1200)
     args = ap.parse_args()
+    wd = _watchdog(args.watchdog)
 
     from cake_tpu.models import (SamplingConfig, TextModel, config_from_hf_dict,
                                  tiny_config)
@@ -75,6 +96,7 @@ def main():
         "device": str(jax.devices()[0]),
         "dtype": "bfloat16",
     }
+    wd.cancel()
     print(json.dumps(result))
     print(json.dumps({"detail": extra}), file=sys.stderr)
 

@@ -1,0 +1,2 @@
+from .server import create_app, serve
+from .state import ApiState

@@ -1,0 +1,48 @@
+"""TTS endpoint: /v1/audio/speech with optional base64 voice-clone upload,
+wav/pcm response (ref: cake-core/src/cake/sharding/api/audio.rs:1-155)."""
+from __future__ import annotations
+
+import base64
+
+from aiohttp import web
+
+from .state import ApiState
+
+
+async def audio_speech(request: web.Request) -> web.Response:
+    state: ApiState = request.app["state"]
+    if state.audio_model is None:
+        return web.json_response({"error": "no audio model loaded"}, status=503)
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    text = body.get("input")
+    if not text:
+        return web.json_response({"error": "input required"}, status=400)
+    fmt = body.get("response_format", "wav")
+    if fmt not in ("wav", "pcm"):
+        return web.json_response({"error": f"unsupported format {fmt}"},
+                                 status=400)
+    voice = body.get("voice")
+    voice_wav = None
+    if body.get("voice_b64"):
+        try:
+            voice_wav = base64.b64decode(body["voice_b64"])
+        except Exception:
+            return web.json_response({"error": "invalid voice_b64"}, status=400)
+
+    async with state.lock:
+        import asyncio
+        loop = asyncio.get_running_loop()
+        audio = await loop.run_in_executor(
+            None, lambda: state.audio_model.generate_speech(
+                text, voice=voice, voice_wav=voice_wav,
+                cfg_scale=float(body.get("cfg_scale", 1.3)),
+                steps=int(body.get("steps", 10)),
+            ))
+
+    if fmt == "pcm":
+        return web.Response(body=audio.pcm_bytes(),
+                            content_type="application/octet-stream")
+    return web.Response(body=audio.wav_bytes(), content_type="audio/wav")

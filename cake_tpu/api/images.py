@@ -1,0 +1,57 @@
+"""Image generation endpoints: OpenAI /v1/images/generations + legacy
+/api/v1/image (ref: cake-core/src/cake/sharding/api/image.rs:1-240 —
+b64_json or png response)."""
+from __future__ import annotations
+
+import base64
+import io
+import time
+
+from aiohttp import web
+
+from .state import ApiState
+
+
+def _parse_size(s: str) -> tuple[int, int]:
+    try:
+        w, h = s.lower().split("x")
+        return int(w), int(h)
+    except Exception:
+        raise web.HTTPBadRequest(text="size must be WIDTHxHEIGHT")
+
+
+async def images_generations(request: web.Request) -> web.Response:
+    state: ApiState = request.app["state"]
+    if state.image_model is None:
+        return web.json_response({"error": "no image model loaded"}, status=503)
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    prompt = body.get("prompt")
+    if not prompt:
+        return web.json_response({"error": "prompt required"}, status=400)
+    w, h = _parse_size(body.get("size", "1024x1024"))
+    fmt = body.get("response_format", "b64_json")
+
+    async with state.lock:
+        import asyncio
+        loop = asyncio.get_running_loop()
+        image = await loop.run_in_executor(None, lambda: state.image_model.generate_image(
+            prompt,
+            width=w, height=h,
+            steps=int(body.get("steps", 20)),
+            guidance=float(body.get("guidance", body.get("cfg_scale", 3.5))),
+            seed=body.get("seed"),
+            negative_prompt=body.get("negative_prompt"),
+        ))
+
+    buf = io.BytesIO()
+    image.save(buf, format="PNG")
+    png = buf.getvalue()
+    if fmt == "png" or request.path.endswith("/image"):
+        return web.Response(body=png, content_type="image/png")
+    return web.json_response({
+        "created": int(time.time()),
+        "data": [{"b64_json": base64.b64encode(png).decode()}],
+    })

@@ -1,0 +1,72 @@
+"""HTTP server assembly: aiohttp app with the OpenAI-compatible + legacy
+route set (ref: cake-core/src/cake/sharding/api/mod.rs:66-117).
+
+Routes:
+  POST /v1/chat/completions     chat (JSON + SSE)
+  GET  /v1/models               model list
+  POST /v1/images/generations   image gen (b64_json)
+  POST /api/v1/image            image gen (raw png, legacy)
+  POST /v1/audio/speech         TTS (wav/pcm)
+  GET  /api/v1/topology         cluster topology JSON
+  GET  /                        embedded web UI
+"""
+from __future__ import annotations
+
+import base64
+import logging
+import time
+
+from aiohttp import web
+
+from . import audio as audio_routes
+from . import images as image_routes
+from . import text as text_routes
+from . import ui as ui_routes
+from .state import ApiState
+
+log = logging.getLogger("cake_tpu.api")
+
+
+@web.middleware
+async def basic_auth_middleware(request, handler):
+    """Optional HTTP basic auth (ref: api/ui.rs basic-auth option)."""
+    creds = request.app.get("basic_auth")
+    if creds:
+        hdr = request.headers.get("Authorization", "")
+        ok = False
+        if hdr.startswith("Basic "):
+            try:
+                user_pass = base64.b64decode(hdr[6:]).decode()
+                ok = user_pass == creds
+            except Exception:
+                ok = False
+        if not ok:
+            return web.Response(
+                status=401, headers={"WWW-Authenticate": 'Basic realm="cake"'})
+    return await handler(request)
+
+
+def create_app(state: ApiState, basic_auth: str | None = None) -> web.Application:
+    app = web.Application(middlewares=[basic_auth_middleware],
+                          client_max_size=64 * 1024 * 1024)
+    state.created = int(time.time())
+    app["state"] = state
+    if basic_auth:
+        app["basic_auth"] = basic_auth
+    app.router.add_post("/v1/chat/completions", text_routes.chat_completions)
+    app.router.add_get("/v1/models", text_routes.list_models)
+    app.router.add_post("/v1/images/generations",
+                        image_routes.images_generations)
+    app.router.add_post("/api/v1/image", image_routes.images_generations)
+    app.router.add_post("/v1/audio/speech", audio_routes.audio_speech)
+    app.router.add_get("/api/v1/topology", ui_routes.topology)
+    app.router.add_get("/", ui_routes.index)
+    return app
+
+
+def serve(state: ApiState, host: str = "0.0.0.0", port: int = 8000,
+          basic_auth: str | None = None):
+    """Blocking server entry (ref: `cake serve`)."""
+    app = create_app(state, basic_auth)
+    log.info("serving API on http://%s:%d", host, port)
+    web.run_app(app, host=host, port=port, print=None)

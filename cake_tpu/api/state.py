@@ -1,0 +1,79 @@
+"""Shared API state: one model, one inference at a time.
+
+The reference serializes requests through Arc<RwLock<Master>> (ref:
+api/mod.rs:71 — single shared master, one inference at a time); here an
+asyncio.Lock guards the generator and generation runs in a worker thread so
+the event loop keeps streaming SSE chunks while the TPU decodes.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+import queue as queue_mod
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ApiState:
+    model: Any                      # TextModel / DistributedTextModel / None
+    tokenizer: Any = None
+    model_id: str = "cake-tpu"
+    image_model: Any = None
+    audio_model: Any = None
+    topology: Any = None            # cluster Topology or None
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    created: int = 0
+
+    def owned_models(self) -> list[dict]:
+        out = []
+        for m, kind in ((self.model, "text"), (self.image_model, "image"),
+                        (self.audio_model, "audio")):
+            if m is not None:
+                out.append({"id": self.model_id, "object": "model",
+                            "created": self.created, "owned_by": "cake-tpu",
+                            "kind": kind})
+        return out
+
+
+def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):
+    """Run model generation in a thread; yield Token objects as they arrive.
+
+    Returns (async iterator, join function). Mirrors the reference's
+    mpsc-channel SSE bridge (ref: api/text.rs generate_text_stream).
+    """
+    q: queue_mod.Queue = queue_mod.Queue()
+    DONE = object()
+    result: dict = {}
+
+    def worker():
+        try:
+            if isinstance(messages_or_ids, list) and messages_or_ids and \
+                    isinstance(messages_or_ids[0], dict):
+                toks, stats = model.chat_generate(
+                    messages_or_ids, on_token=q.put, **gen_kwargs)
+            else:
+                toks, stats = model.generate(
+                    messages_or_ids, on_token=q.put, **gen_kwargs)
+            result["tokens"] = toks
+            result["stats"] = stats
+        except Exception as e:  # surfaced to the stream consumer
+            result["error"] = e
+        finally:
+            q.put(DONE)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+
+    async def aiter():
+        loop = asyncio.get_running_loop()
+        while True:
+            item = await loop.run_in_executor(None, q.get)
+            if item is DONE:
+                break
+            yield item
+        t.join(timeout=5)
+        if "error" in result:
+            raise result["error"]
+
+    return aiter(), result

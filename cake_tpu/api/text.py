@@ -1,0 +1,139 @@
+"""OpenAI-compatible chat completions: blocking JSON + SSE streaming
+(ref: cake-core/src/cake/sharding/api/text.rs:101-230 — usage accounting,
+finish_reason, stream chunks)."""
+from __future__ import annotations
+
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+from ..ops.sampling import SamplingConfig
+from .state import ApiState, run_generation_streamed
+
+
+def _sampling_from_request(body: dict) -> SamplingConfig:
+    temp = float(body.get("temperature", 0.7))
+    return SamplingConfig(
+        temperature=temp,
+        top_k=body.get("top_k"),
+        top_p=body.get("top_p"),
+        repeat_penalty=float(body.get("repetition_penalty",
+                                      body.get("repeat_penalty", 1.0))),
+    )
+
+
+def _gen_kwargs(body: dict) -> dict:
+    return {
+        "max_new_tokens": int(body.get("max_tokens",
+                                       body.get("max_completion_tokens", 256))),
+        "sampling": _sampling_from_request(body),
+    }
+
+
+def _completion_id() -> str:
+    return "chatcmpl-" + uuid.uuid4().hex[:24]
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    state: ApiState = request.app["state"]
+    if state.model is None:
+        return web.json_response({"error": "no text model loaded"}, status=503)
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        return web.json_response({"error": "messages[] required"}, status=400)
+    for m in messages:
+        if not isinstance(m, dict) or "role" not in m or "content" not in m:
+            return web.json_response(
+                {"error": "each message needs role and content"}, status=400)
+
+    if body.get("stream"):
+        return await _chat_stream(request, state, messages, body)
+    return await _chat_blocking(request, state, messages, body)
+
+
+def _prompt_token_count(state: ApiState, messages) -> int:
+    try:
+        from ..models.common.text_model import render_chat
+        enc = state.tokenizer.encode(render_chat(state.tokenizer, messages))
+        return len(enc.ids if hasattr(enc, "ids") else enc)
+    except Exception:
+        return 0
+
+
+async def _chat_blocking(request, state: ApiState, messages, body):
+    async with state.lock:                  # one inference at a time
+        aiter, result = run_generation_streamed(state.model, messages,
+                                               _gen_kwargs(body))
+        text_parts = []
+        last = None
+        async for tok in aiter:
+            last = tok
+            if tok.text and not tok.is_end_of_stream:
+                text_parts.append(tok.text)
+    stats = result.get("stats", {})
+    n_out = len(result.get("tokens", []))
+    n_in = _prompt_token_count(state, messages)
+    finish = "stop" if (last is not None and last.is_end_of_stream) else "length"
+    return web.json_response({
+        "id": _completion_id(),
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": state.model_id,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": "".join(text_parts)},
+            "finish_reason": finish,
+        }],
+        "usage": {
+            "prompt_tokens": n_in,
+            "completion_tokens": n_out,
+            "total_tokens": n_in + n_out,
+            "tokens_per_second": round(stats.get("tok_per_s", 0.0), 2),
+        },
+    })
+
+
+async def _chat_stream(request, state: ApiState, messages, body):
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        "Connection": "keep-alive",
+    })
+    await resp.prepare(request)
+    cid = _completion_id()
+    created = int(time.time())
+
+    def chunk(delta: dict, finish=None) -> bytes:
+        payload = {
+            "id": cid, "object": "chat.completion.chunk", "created": created,
+            "model": state.model_id,
+            "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+        }
+        return f"data: {json.dumps(payload)}\n\n".encode()
+
+    await resp.write(chunk({"role": "assistant"}))
+    finish = "length"
+    async with state.lock:
+        aiter, result = run_generation_streamed(state.model, messages,
+                                                _gen_kwargs(body))
+        async for tok in aiter:
+            if tok.is_end_of_stream:
+                finish = "stop"
+                break
+            if tok.text:
+                await resp.write(chunk({"content": tok.text}))
+    await resp.write(chunk({}, finish=finish))
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+    return resp
+
+
+async def list_models(request: web.Request) -> web.Response:
+    state: ApiState = request.app["state"]
+    return web.json_response({"object": "list", "data": state.owned_models()})

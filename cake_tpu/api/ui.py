@@ -1,0 +1,49 @@
+"""Topology endpoint + embedded web UI
+(ref: cake-core/src/cake/sharding/api/ui.rs:1-365 + api/index.html —
+/api/v1/topology reports nodes/layers; the single-page UI consumes it and
+the chat endpoint)."""
+from __future__ import annotations
+
+import os
+
+from aiohttp import web
+
+from .state import ApiState
+
+_HERE = os.path.dirname(__file__)
+
+
+async def topology(request: web.Request) -> web.Response:
+    state: ApiState = request.app["state"]
+    nodes = {}
+    if state.topology is not None:
+        for name, n in state.topology.nodes.items():
+            lr = n.layer_range
+            nodes[name] = {
+                "host": n.host,
+                "layers": list(n.layers),
+                "layer_range": list(lr) if lr else None,
+                "memory_bytes": n.memory_bytes,
+                "tflops": n.tflops,
+                "backend": n.backend,
+            }
+    master = {"model": state.model_id}
+    if state.model is not None:
+        cfg = state.model.cfg
+        master.update({
+            "arch": cfg.arch,
+            "num_layers": cfg.num_hidden_layers,
+            "hidden_size": cfg.hidden_size,
+            "vocab_size": cfg.vocab_size,
+        })
+        stages = getattr(state.model, "stages", None)
+        if stages:
+            master["stages"] = [
+                {"kind": s.kind, "start": s.start, "end": s.end}
+                for s in stages]
+    return web.json_response({"master": master, "nodes": nodes})
+
+
+async def index(request: web.Request) -> web.Response:
+    with open(os.path.join(_HERE, "index.html")) as f:
+        return web.Response(text=f.read(), content_type="text/html")

@@ -1,0 +1,83 @@
+"""Terminal chat client: local model or remote cake-tpu/OpenAI API with SSE
+streaming (ref: cake-cli/src/chat.rs — the reference's ratatui TUI; this is
+a line-based REPL with the same two modes: local and remote-API)."""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def chat_local(gen, model_id: str, sampling, max_tokens: int) -> int:
+    print(f"chat with {model_id} — /quit to exit, /reset to clear history")
+    history: list[dict] = []
+    while True:
+        try:
+            line = input("\n> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not line:
+            continue
+        if line in ("/quit", "/exit"):
+            return 0
+        if line == "/reset":
+            history.clear()
+            print("(history cleared)")
+            continue
+        history.append({"role": "user", "content": line})
+        parts: list[str] = []
+
+        def on_token(tok):
+            if tok.text and not tok.is_end_of_stream:
+                parts.append(tok.text)
+                print(tok.text, end="", flush=True)
+
+        _, stats = gen.chat_generate(history, max_new_tokens=max_tokens,
+                                     sampling=sampling, on_token=on_token)
+        print(f"\n[{stats['tok_per_s']:.1f} tok/s]", file=sys.stderr)
+        history.append({"role": "assistant", "content": "".join(parts)})
+
+
+def chat_remote(api_url: str, api_key: str | None = None) -> int:
+    """SSE client against any OpenAI-compatible endpoint."""
+    import requests
+
+    url = api_url.rstrip("/") + "/v1/chat/completions"
+    headers = {"Content-Type": "application/json"}
+    if api_key:
+        headers["Authorization"] = f"Bearer {api_key}"
+    print(f"chat via {url} — /quit to exit, /reset to clear history")
+    history: list[dict] = []
+    while True:
+        try:
+            line = input("\n> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not line:
+            continue
+        if line in ("/quit", "/exit"):
+            return 0
+        if line == "/reset":
+            history.clear()
+            continue
+        history.append({"role": "user", "content": line})
+        parts: list[str] = []
+        with requests.post(url, headers=headers, stream=True, timeout=600,
+                           json={"messages": history, "stream": True}) as r:
+            if r.status_code != 200:
+                print(f"error {r.status_code}: {r.text}", file=sys.stderr)
+                history.pop()
+                continue
+            for raw in r.iter_lines():
+                if not raw or not raw.startswith(b"data: "):
+                    continue
+                data = raw[6:]
+                if data == b"[DONE]":
+                    break
+                delta = json.loads(data)["choices"][0]["delta"]
+                if delta.get("content"):
+                    parts.append(delta["content"])
+                    print(delta["content"], end="", flush=True)
+        print()
+        history.append({"role": "assistant", "content": "".join(parts)})

@@ -1,0 +1,222 @@
+"""Command-line interface (ref: cake-cli/src/main.rs:23-93 — subcommands
+run | serve | pull | list | chat | rm | split | worker).
+
+    cake-tpu run Qwen/Qwen3-0.6B "hello"          one-shot generation
+    cake-tpu run MODEL --cluster-key K            distributed master
+    cake-tpu worker --name w0 --cluster-key K     worker node
+    cake-tpu serve MODEL [--port 8000]            OpenAI-compatible API + UI
+    cake-tpu chat MODEL | --api URL               terminal chat
+    cake-tpu pull/list/rm                          model cache management
+    cake-tpu split MODEL TOPOLOGY OUT             per-worker weight bundles
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+
+def _add_common_model_args(p: argparse.ArgumentParser):
+    p.add_argument("model", help="model dir or HF repo id")
+    p.add_argument("--dtype", default="bf16", help="bf16|f16|f32")
+    p.add_argument("--arch", default=None,
+                   help="force architecture (e.g. qwen3, llama3)")
+    p.add_argument("--max-cache-len", type=int, default=2048)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--cluster-key", default=os.environ.get("CAKE_CLUSTER_KEY"),
+                   help="enable distributed mode (env: CAKE_CLUSTER_KEY)")
+    p.add_argument("--topology", default=None, help="topology YAML path")
+    p.add_argument("--no-download", action="store_true")
+
+
+def _add_sampling_args(p: argparse.ArgumentParser):
+    p.add_argument("--max-tokens", type=int, default=256)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--repeat-penalty", type=float, default=1.0)
+
+
+def _sampling(args):
+    from .ops.sampling import SamplingConfig
+    return SamplingConfig(temperature=args.temperature, top_k=args.top_k,
+                          top_p=args.top_p,
+                          repeat_penalty=args.repeat_penalty)
+
+
+def _build(args):
+    from .runtime import build_text_model
+    return build_text_model(
+        args.model, dtype=args.dtype, arch=args.arch,
+        max_cache_len=args.max_cache_len, seed=args.seed,
+        cluster_key=args.cluster_key, topology_path=args.topology,
+        download=not args.no_download)
+
+
+def cmd_run(args) -> int:
+    gen, tokenizer, model_id, _ = _build(args)
+    prompt = args.prompt or "Hello"
+    if args.raw:
+        ids = tokenizer.encode(prompt)
+        _, stats = gen.generate(ids, max_new_tokens=args.max_tokens,
+                                sampling=_sampling(args),
+                                on_token=_print_token)
+    else:
+        _, stats = gen.chat_generate(
+            [{"role": "user", "content": prompt}],
+            max_new_tokens=args.max_tokens, sampling=_sampling(args),
+            on_token=_print_token)
+    print()
+    print(f"[{stats['decode_tokens']} tokens, {stats['tok_per_s']:.1f} tok/s, "
+          f"ttft {stats['ttft_s'] * 1000:.0f} ms]", file=sys.stderr)
+    return 0
+
+
+def _print_token(tok):
+    if tok.text and not tok.is_end_of_stream:
+        print(tok.text, end="", flush=True)
+
+
+def cmd_serve(args) -> int:
+    from .api import ApiState, serve
+    gen, tokenizer, model_id, topo = _build(args)
+    state = ApiState(model=gen, tokenizer=tokenizer, model_id=model_id,
+                     topology=topo)
+    serve(state, host=args.host, port=args.port, basic_auth=args.basic_auth)
+    return 0
+
+
+def cmd_worker(args) -> int:
+    from .cluster import run_worker
+    if not args.cluster_key:
+        print("error: --cluster-key (or CAKE_CLUSTER_KEY) required",
+              file=sys.stderr)
+        return 2
+    run_worker(args.name, args.cluster_key, port=args.port,
+               model_dir=args.model_dir)
+    return 0
+
+
+def cmd_pull(args) -> int:
+    from .utils.hub import pull
+    path = pull(args.repo)
+    print(path)
+    return 0
+
+
+def cmd_list(args) -> int:
+    from .utils.models import list_models
+    rows = list_models()
+    if not rows:
+        print("no cached models")
+        return 0
+    w = max(len(m.repo_id) for m in rows) + 2
+    for m in rows:
+        status = "complete" if m.complete else "PARTIAL"
+        print(f"{m.repo_id:<{w}} {m.source:<5} {m.size_bytes / 1e9:7.2f} GB  "
+              f"{status}")
+    return 0
+
+
+def cmd_rm(args) -> int:
+    from .utils.models import delete_model
+    if delete_model(args.repo):
+        print(f"removed {args.repo}")
+        return 0
+    print(f"{args.repo} not found", file=sys.stderr)
+    return 1
+
+
+def cmd_split(args) -> int:
+    from .cluster.topology import Topology
+    from .runtime import load_config_and_quant
+    from .utils.hub import resolve_model
+    from .utils.split import split_model
+    model_dir = resolve_model(args.model, download=not args.no_download)
+    cfg, _, _ = load_config_and_quant(model_dir)
+    topo = Topology.from_path(args.topology)
+    assignments = {name: n.layer_range for name, n in topo.nodes.items()
+                   if n.layer_range}
+    out = split_model(model_dir, assignments, args.out,
+                      cfg.num_hidden_layers,
+                      tie_word_embeddings=cfg.tie_word_embeddings)
+    for worker, path in out.items():
+        print(f"{worker}: {path}")
+    return 0
+
+
+def cmd_chat(args) -> int:
+    from .chat import chat_local, chat_remote
+    if args.api:
+        return chat_remote(args.api, args.api_key)
+    gen, tokenizer, model_id, _ = _build(args)
+    return chat_local(gen, model_id, _sampling(args), args.max_tokens)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cake-tpu",
+                                 description="TPU-native distributed "
+                                             "multimodal inference")
+    ap.add_argument("-v", "--verbose", action="count", default=0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="generate text for a prompt")
+    _add_common_model_args(p)
+    _add_sampling_args(p)
+    p.add_argument("prompt", nargs="?", default=None)
+    p.add_argument("--raw", action="store_true",
+                   help="no chat template, raw completion")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("serve", help="OpenAI-compatible API server")
+    _add_common_model_args(p)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--basic-auth", default=None, help="user:pass")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("worker", help="run as a cluster worker")
+    p.add_argument("--name", default=os.uname().nodename)
+    p.add_argument("--cluster-key", default=os.environ.get("CAKE_CLUSTER_KEY"))
+    p.add_argument("--port", type=int, default=10128)
+    p.add_argument("--model-dir", default=None,
+                   help="pre-provisioned weights (from `cake-tpu split`)")
+    p.set_defaults(fn=cmd_worker)
+
+    p = sub.add_parser("pull", help="download a model")
+    p.add_argument("repo")
+    p.set_defaults(fn=cmd_pull)
+
+    p = sub.add_parser("list", help="list cached models")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("rm", help="delete a cached model")
+    p.add_argument("repo")
+    p.set_defaults(fn=cmd_rm)
+
+    p = sub.add_parser("split", help="write per-worker weight bundles")
+    p.add_argument("model")
+    p.add_argument("topology")
+    p.add_argument("out")
+    p.add_argument("--no-download", action="store_true")
+    p.set_defaults(fn=cmd_split)
+
+    p = sub.add_parser("chat", help="interactive terminal chat")
+    _add_common_model_args(p)
+    _add_sampling_args(p)
+    p.add_argument("--api", default=None,
+                   help="chat against a remote cake-tpu API URL instead")
+    p.add_argument("--api-key", default=None)
+    p.set_defaults(fn=cmd_chat)
+
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=[logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)],
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

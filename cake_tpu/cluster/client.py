@@ -27,6 +27,8 @@ CONNECT_BACKOFF = 1.0
 class RemoteStage:
     """A connected, authenticated channel to one worker."""
 
+    SETUP_TIMEOUT = 1800.0   # weight load + whole-range XLA compile
+
     def __init__(self, host: str, port: int, cluster_key: str,
                  name: str = "?", timeout: float = 120.0):
         self.host, self.port = host, port
@@ -55,6 +57,8 @@ class RemoteStage:
                 if self.sock:
                     self.sock.close()
                     self.sock = None
+                if attempt == CONNECT_RETRIES - 1:
+                    break               # no dead wait after the final attempt
                 wait = CONNECT_BACKOFF * (2 ** attempt)
                 log.warning("connect to %s:%d failed (%s), retry in %.1fs",
                             self.host, self.port, e, wait)
@@ -95,7 +99,12 @@ class RemoteStage:
         proto.write_frame_sync(self.sock, proto.model_done())
 
     def wait_ready(self) -> dict:
-        msg = proto.read_frame_sync(self.sock)
+        # setup (load + compile) can far exceed the per-op forward timeout
+        self.sock.settimeout(self.SETUP_TIMEOUT)
+        try:
+            msg = proto.read_frame_sync(self.sock)
+        finally:
+            self.sock.settimeout(self.timeout)
         if msg.get("t") != "worker_ready" or not msg.get("ok", False):
             raise RuntimeError(
                 f"worker {self.name} setup failed: {msg.get('error', msg)}")

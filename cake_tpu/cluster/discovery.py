@@ -163,9 +163,10 @@ def discover_workers(cluster_key: str, timeout: float = 2.0,
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_BROADCAST, 1)
     sock.settimeout(0.25)
     found: dict[tuple, dict] = {}
+    baddrs = get_broadcast_addresses()      # once: spawns an `ip` subprocess
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        for baddr in get_broadcast_addresses():
+        for baddr in baddrs:
             try:
                 sock.sendto(query, (baddr, discovery_port))
             except OSError:

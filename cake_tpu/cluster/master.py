@@ -168,6 +168,10 @@ class DistributedTextModel:
                 pass
         return Token(id=tid, text=text, is_end_of_stream=self.cfg.is_eos(tid))
 
+    def chat_generate(self, messages: list[dict], **kw):
+        from ..models.common.text_model import chat_prompt_ids
+        return self.generate(chat_prompt_ids(self.tokenizer, messages), **kw)
+
 
 # ---------------------------------------------------------------------------
 # Cluster bring-up
@@ -239,10 +243,10 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
         names = transfer.subset_tensor_names(storage, start, end, n,
                                              include_embed=False,
                                              include_head=False)
-        expected = {}
-        if push_weights:
-            total, chunks = transfer.synthesize_safetensors(storage, names)
-            expected["model.safetensors"] = total
+        # expected sizes always sent so the worker can validate its cache
+        # even when pushing is disabled (header-only synthesis: no data read)
+        total, _ = transfer.synthesize_safetensors(storage, names)
+        expected = {"model.safetensors": total}
         assignment = proto.layer_assignment(
             model_id=mhash, arch=cfg.arch, config=config_raw,
             start=start, end=end, dtype=dtype_str, cache_key=ckey,
@@ -253,9 +257,11 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
         if resp.get("t") == "worker_error":
             raise RuntimeError(f"worker {name}: {resp['error']}")
         if push_weights and not transfer_cached(resp):
+            start_off = (resp.get("resume") or {}).get("model.safetensors", 0)
             total, chunks = transfer.synthesize_safetensors(storage, names)
             client.push_weights(
-                transfer.encode_chunks("model.safetensors", total, chunks))
+                transfer.encode_chunks("model.safetensors", total, chunks,
+                                       start_offset=start_off))
         client.wait_ready()
         clients.append(client)
         log.info("worker %s ready with layers [%d,%d)", name, start, end)

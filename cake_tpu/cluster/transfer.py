@@ -83,14 +83,21 @@ def synthesize_safetensors(storage: TensorStorage, names: list[str],
     total = 8 + len(hjson) + offset
 
     def gen() -> Iterator[bytes]:
-        buf = struct.pack("<Q", len(hjson)) + hjson
+        # O(n) streaming: accumulate into a bytearray consumed from the
+        # front via memoryview offsets (no quadratic re-slicing)
+        buf = bytearray(struct.pack("<Q", len(hjson)) + hjson)
         for n in names:
             buf += storage.read_bytes(n)
-            while len(buf) >= chunk_size:
-                yield buf[:chunk_size]
-                buf = buf[chunk_size:]
+            view = memoryview(buf)
+            off = 0
+            while len(buf) - off >= chunk_size:
+                yield bytes(view[off:off + chunk_size])
+                off += chunk_size
+            del view
+            if off:
+                buf = bytearray(buf[off:])
         if buf:
-            yield buf
+            yield bytes(buf)
 
     return total, gen()
 

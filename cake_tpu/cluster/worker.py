@@ -166,12 +166,17 @@ class WorkerServer:
         # skip the push (content-keyed cache, ref: has_valid_model_cache)
         model_dir = self.model_dir
         if model_dir is None:
-            cached = has_valid_model_cache(self.cache_root, key, expected)
+            # empty `expected` cannot validate anything -> treat as uncached
+            cached = bool(expected) and has_valid_model_cache(
+                self.cache_root, key, expected)
             if not cached and msg["push_weights"]:
                 a = proto.ack()
                 a["cached"] = False
+                # partial-transfer resume offsets (ref: ModelDataResume)
+                recv = ModelReceiver(self.cache_root, key)
+                a["resume"] = {f: recv.resume_offset(f) for f in expected}
                 await proto.write_frame(writer, a)
-                model_dir = await self._receive_weights(reader, key, msg)
+                model_dir = await self._receive_weights(reader, key, msg, recv)
             elif cached:
                 a = proto.ack()
                 a["cached"] = True
@@ -207,9 +212,8 @@ class WorkerServer:
                 ok=False, error=str(e)))
             st.stage = None
 
-    async def _receive_weights(self, reader, key: str, assign_msg) -> str:
-        recv = ModelReceiver(self.cache_root, key)
-        # resume partial transfers (ref: ModelDataResume)
+    async def _receive_weights(self, reader, key: str, assign_msg,
+                               recv: ModelReceiver) -> str:
         while True:
             msg = await proto.read_frame(reader)
             if msg["t"] == "model_chunk":

@@ -243,10 +243,20 @@ class TextModel:
     def chat_generate(self, messages: list[dict], **kw):
         """Apply the tokenizer's chat template (fallback: ChatML —
         ref: models/common/chatml_history.rs) and generate."""
-        prompt = render_chat(self.tokenizer, messages)
-        enc = self.tokenizer.encode(prompt)
-        ids = enc.ids if hasattr(enc, "ids") else enc
-        return self.generate(list(ids), **kw)
+        return self.generate(chat_prompt_ids(self.tokenizer, messages), **kw)
+
+
+def chat_prompt_ids(tokenizer, messages: list[dict]) -> list[int]:
+    """messages -> token ids via the tokenizer's chat template when it has
+    one (CakeTokenizer.apply_chat), else the ChatML fallback."""
+    if hasattr(tokenizer, "apply_chat"):
+        prompt = tokenizer.apply_chat(messages)
+        if hasattr(tokenizer, "encode_chat_prompt"):
+            return list(tokenizer.encode_chat_prompt(prompt))
+    else:
+        prompt = render_chat(tokenizer, messages)
+    enc = tokenizer.encode(prompt)
+    return list(enc.ids if hasattr(enc, "ids") else enc)
 
 
 def render_chat(tokenizer, messages: list[dict]) -> str:

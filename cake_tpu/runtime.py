@@ -1,0 +1,161 @@
+"""Runtime facade: turn a model name/dir + flags into a ready generator.
+
+This is the Python analog of the reference's Context bring-up
+(ref: cake/mod.rs Context::from_args:112-507 — device pick, HF download,
+GGUF/safetensors/quant detection, topology load + auto-shard, partial
+weight loading) without the God-object: the facade returns plain objects.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .models import TextModel, config_from_hf_dict
+from .models.common.config import detect_arch
+from .utils.dtypes import parse_dtype
+from .utils.hub import resolve_model
+
+log = logging.getLogger("cake_tpu.runtime")
+
+
+class CakeTokenizer:
+    """Thin tokenizer wrapper: encode/decode + chat templating with the
+    HF chat_template when present, ChatML fallback otherwise
+    (ref: models/common/chatml_history.rs)."""
+
+    def __init__(self, model_dir: str):
+        self._tok = None
+        self._hf = None
+        tok_json = os.path.join(model_dir, "tokenizer.json")
+        if os.path.exists(tok_json):
+            from tokenizers import Tokenizer
+            self._tok = Tokenizer.from_file(tok_json)
+        cfg_path = os.path.join(model_dir, "tokenizer_config.json")
+        self.chat_template = None
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                self.chat_template = json.load(f).get("chat_template")
+        if self.chat_template:
+            try:
+                from transformers import AutoTokenizer
+                self._hf = AutoTokenizer.from_pretrained(model_dir)
+            except Exception as e:
+                log.warning("chat template present but AutoTokenizer failed "
+                            "(%s); using ChatML fallback", e)
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        if self._tok is not None:
+            return self._tok.encode(
+                text, add_special_tokens=add_special_tokens).ids
+        if self._hf is not None:
+            return self._hf.encode(text,
+                                   add_special_tokens=add_special_tokens)
+        raise RuntimeError("no tokenizer available")
+
+    def encode_chat_prompt(self, prompt: str) -> list[int]:
+        """Templated chat strings already contain their special tokens —
+        don't let the tokenizer post-processor prepend BOS again."""
+        return self.encode(prompt,
+                           add_special_tokens=not bool(self.chat_template))
+
+    def decode(self, ids) -> str:
+        if self._tok is not None:
+            return self._tok.decode(list(ids), skip_special_tokens=False)
+        return self._hf.decode(list(ids))
+
+    def apply_chat(self, messages: list[dict]) -> str:
+        if self._hf is not None and self.chat_template:
+            return self._hf.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True)
+        from .models.common.text_model import render_chat
+        return render_chat(self, messages)
+
+
+def load_config_and_quant(model_dir: str, arch: str | None = None):
+    from .utils.quant import detect_quantization
+    gguf_files = [f for f in os.listdir(model_dir) if f.endswith(".gguf")] \
+        if os.path.isdir(model_dir) else []
+    cfg_path = os.path.join(model_dir, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            raw = json.load(f)
+        return config_from_hf_dict(raw, arch), detect_quantization(raw), raw
+    if gguf_files:
+        from .utils.gguf import GgufReader, gguf_config_dict
+        raw = gguf_config_dict(GgufReader(os.path.join(model_dir,
+                                                       gguf_files[0])))
+        from .utils.quant import NoQuantization
+        return config_from_hf_dict(raw, arch), NoQuantization(), raw
+    raise FileNotFoundError(f"no config.json or .gguf in {model_dir}")
+
+
+def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
+                     max_cache_len: int = 2048, seed: int = 42,
+                     cluster_key: str | None = None,
+                     topology_path: str | None = None,
+                     discovery_timeout: float = 3.0,
+                     download: bool = True):
+    """Returns (generator, tokenizer, model_id, topology|None).
+
+    With a cluster key: discover workers (or use the topology file), run
+    master_setup, return a DistributedTextModel. Otherwise a fully-local
+    TextModel (ref: cake-cli run_as_master / all-local fallback
+    sharding/mod.rs:209-212).
+    """
+    model_dir = resolve_model(model, download=download)
+    cfg, quant, raw = load_config_and_quant(model_dir, arch)
+    dt = parse_dtype(dtype)
+    tokenizer = CakeTokenizer(model_dir)
+    model_id = os.path.basename(model.rstrip("/"))
+
+    workers = []
+    if cluster_key:
+        from .cluster import discover_workers
+        from .cluster.topology import Topology
+        if topology_path:
+            topo = Topology.from_path(topology_path)
+            workers = [{"name": n.name, "host": n.addr[0], "port": n.addr[1],
+                        "caps": {"backend": n.backend or "cpu",
+                                 "device": n.backend or "cpu",
+                                 "memory_bytes": n.memory_bytes,
+                                 "tflops": n.tflops}}
+                       for n in topo.nodes.values()]
+        else:
+            workers = discover_workers(cluster_key, timeout=discovery_timeout)
+        if not workers:
+            log.warning("no workers found; running all-local")
+
+    if cluster_key and workers:
+        from .cluster.master import DistributedTextModel, master_setup
+        assignments = None
+        if topology_path:
+            topo = Topology.from_path(topology_path)
+            assignments = {name: n.layer_range
+                           for name, n in topo.nodes.items() if n.layer_range}
+        setup = master_setup(model_dir, cluster_key, cfg, workers,
+                             assignments=assignments, dtype_str=dtype,
+                             max_cache_len=max_cache_len)
+        gen = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                   tokenizer=tokenizer, dtype=dt,
+                                   max_cache_len=max_cache_len, seed=seed)
+        return gen, tokenizer, model_id, setup.topology
+
+    # fully local
+    gguf_files = [f for f in os.listdir(model_dir) if f.endswith(".gguf")]
+    if gguf_files and not any(f.endswith(".safetensors")
+                              for f in os.listdir(model_dir)):
+        from .utils.gguf import GgufStorage
+        from .utils.loaders import ParamLoader
+        storage = GgufStorage(os.path.join(model_dir, gguf_files[0]),
+                              cfg.model_prefix)
+        params = ParamLoader(cfg, storage, dt, quant).load()
+    else:
+        from .utils.loaders import load_model_params
+        params = load_model_params(cfg, model_dir, dt, quant=quant)
+    gen = TextModel(cfg, params, tokenizer=tokenizer, dtype=dt, seed=seed,
+                    max_cache_len=max_cache_len)
+    return gen, tokenizer, model_id, None

@@ -1,0 +1,221 @@
+"""API integration tests with mock generators — no HTTP server, no TPU
+(mirrors ref api/test_helpers.rs MockTextGenerator + integration_tests.rs)."""
+import asyncio
+import base64
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from cake_tpu.api import ApiState, create_app
+from cake_tpu.models.common.text_model import Token
+
+
+def with_client(state_or_app, fn):
+    """Run an async client scenario under asyncio.run (no pytest-asyncio in
+    the environment)."""
+    async def inner():
+        app = state_or_app if not isinstance(state_or_app, ApiState) \
+            else create_app(state_or_app)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client)
+        finally:
+            await client.close()
+    asyncio.run(inner())
+
+
+class MockTokenizer:
+    def encode(self, text):
+        return list(range(len(text.split())))
+
+    def decode(self, ids):
+        return "tok"
+
+    def apply_chat(self, messages):
+        return " ".join(m["content"] for m in messages)
+
+
+class MockTextModel:
+    """Emits 'Hello world !' one token at a time (ref: MockTextGenerator)."""
+
+    class cfg:
+        arch = "mock"
+        num_hidden_layers = 4
+        hidden_size = 64
+        vocab_size = 256
+
+    def __init__(self):
+        self.tokenizer = MockTokenizer()
+        self.calls = 0
+
+    def chat_generate(self, messages, max_new_tokens=256, sampling=None,
+                      on_token=None, **_):
+        self.calls += 1
+        words = ["Hello", " world", " !"]
+        toks = []
+        for i, w in enumerate(words[:max_new_tokens]):
+            t = Token(id=i, text=w, is_end_of_stream=False)
+            toks.append(i)
+            if on_token:
+                on_token(t)
+        if on_token:
+            on_token(Token(id=99, text=None, is_end_of_stream=True))
+        toks.append(99)
+        return toks, {"tok_per_s": 42.0, "ttft_s": 0.01,
+                      "decode_tokens": len(toks) - 1, "decode_s": 0.1}
+
+    generate = chat_generate
+
+
+class MockImageModel:
+    def generate_image(self, prompt, width=64, height=64, **kw):
+        from PIL import Image
+        return Image.new("RGB", (width, height), (128, 0, 255))
+
+
+class MockAudioModel:
+    class _Audio:
+        def wav_bytes(self):
+            return b"RIFF" + b"\x00" * 44
+
+        def pcm_bytes(self):
+            return b"\x00\x01" * 100
+
+    def generate_speech(self, text, **kw):
+        return self._Audio()
+
+
+def make_state():
+    return ApiState(model=MockTextModel(), tokenizer=MockTokenizer(),
+                    model_id="mock-model", image_model=MockImageModel(),
+                    audio_model=MockAudioModel())
+
+
+def test_models_list():
+    async def scenario(client):
+        r = await client.get("/v1/models")
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "list"
+        assert {m["kind"] for m in data["data"]} == {"text", "image", "audio"}
+    with_client(make_state(), scenario)
+
+
+def test_chat_blocking():
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}]})
+        assert r.status == 200
+        data = await r.json()
+        assert data["choices"][0]["message"]["content"] == "Hello world !"
+        assert data["choices"][0]["finish_reason"] == "stop"
+        assert data["usage"]["completion_tokens"] == 4
+        assert data["object"] == "chat.completion"
+    with_client(make_state(), scenario)
+
+
+def test_chat_stream_sse():
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}], "stream": True})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        body = (await r.read()).decode()
+        chunks = [json.loads(line[6:]) for line in body.split("\n\n")
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == "Hello world !"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert body.strip().endswith("data: [DONE]")
+    with_client(make_state(), scenario)
+
+
+def test_chat_validation():
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={})
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"bad": 1}]})
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", data=b"not json")
+        assert r.status == 400
+    with_client(make_state(), scenario)
+
+
+def test_chat_no_model():
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}]})
+        assert r.status == 503
+    with_client(ApiState(model=None), scenario)
+
+
+def test_images_b64():
+    async def scenario(client):
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "a cat", "size": "32x32"})
+        assert r.status == 200
+        data = await r.json()
+        png = base64.b64decode(data["data"][0]["b64_json"])
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    with_client(make_state(), scenario)
+
+
+def test_images_legacy_png():
+    async def scenario(client):
+        r = await client.post("/api/v1/image", json={"prompt": "a cat",
+                                                     "size": "16x16"})
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "image/png"
+        assert (await r.read())[:8] == b"\x89PNG\r\n\x1a\n"
+    with_client(make_state(), scenario)
+
+
+def test_audio_wav_and_pcm():
+    async def scenario(client):
+        r = await client.post("/v1/audio/speech", json={"input": "hello"})
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "audio/wav"
+        assert (await r.read())[:4] == b"RIFF"
+        r = await client.post("/v1/audio/speech", json={"input": "hello",
+                                                        "response_format": "pcm"})
+        assert r.headers["Content-Type"] == "application/octet-stream"
+        r = await client.post("/v1/audio/speech", json={"input": "x",
+                                                        "response_format": "mp3"})
+        assert r.status == 400
+    with_client(make_state(), scenario)
+
+
+def test_topology_endpoint():
+    async def scenario(client):
+        r = await client.get("/api/v1/topology")
+        assert r.status == 200
+        data = await r.json()
+        assert data["master"]["model"] == "mock-model"
+        assert data["master"]["num_layers"] == 4
+    with_client(make_state(), scenario)
+
+
+def test_web_ui():
+    async def scenario(client):
+        r = await client.get("/")
+        assert r.status == 200
+        html = await r.text()
+        assert "cake" in html and "chat/completions" in html
+    with_client(make_state(), scenario)
+
+
+def test_basic_auth():
+    async def scenario(client):
+        r = await client.get("/v1/models")
+        assert r.status == 401
+        cred = base64.b64encode(b"user:pw").decode()
+        r = await client.get("/v1/models",
+                             headers={"Authorization": f"Basic {cred}"})
+        assert r.status == 200
+    app = create_app(ApiState(model=MockTextModel(), model_id="m"),
+                     basic_auth="user:pw")
+    with_client(app, scenario)

@@ -290,6 +290,11 @@ def _qwen3_5_common(d, arch, **over):
         residual_rms_norm=True,
         model_prefix="model.language_model",
         linear_attn=linear,
+        # full-attention layers: per-head QK-norm + sigmoid output gate
+        # (ref: qwen3_5/full_attention.rs:22-46,155-162); the MoE variant
+        # reads the flag from text_config (ref: qwen3_5_moe/config.rs)
+        qk_norm=True,
+        attn_output_gate=bool(tc.get("attn_output_gate", True)),
         tie_word_embeddings=bool(d.get("tie_word_embeddings", False)
                                  or tc.get("tie_word_embeddings", False)),
     )
@@ -311,7 +316,6 @@ def _qwen3_5_moe(d):
         norm_topk_prob=bool(tc.get("norm_topk_prob", True)),
         shared_expert_intermediate_size=tc.get("shared_expert_intermediate_size"),
         moe_gate_act="sigmoid",
-        attn_output_gate=bool(tc.get("attn_output_gate", True)),
         decoder_sparse_step=int(tc.get("decoder_sparse_step", 1)),
         mlp_only_layers=tuple(tc.get("mlp_only_layers", ())),
     )

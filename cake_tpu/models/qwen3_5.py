@@ -1,25 +1,194 @@
 """Qwen3.5 hybrid Gated DeltaNet linear attention.
 
-Placeholder module boundary: the GDN recurrent delta-rule scan, causal-conv
-state, and gated RMS norm (ref: models/qwen3_5/linear_attention.rs,
-qwen3_5/block.rs) land here; the generic block machinery in
-models/common/layers.py already routes `LayerSpec(kind="linear")` layers to
-init_gdn_params/gdn_forward.
+Semantics follow the reference (ref: models/qwen3_5/linear_attention.rs):
+  1. fused in_proj -> [QKV(conv) | a | b | z]
+  2. causal depthwise conv1d + SiLU over QKV channels, with [B, C, K-1]
+     carry state for decode (ref: cache.rs conv states)
+  3. gates: g = -exp(A_log) * softplus(a + dt_bias), beta = sigmoid(b)
+  4. delta rule, per step:  S = S*exp(g);  r = S^T k;
+     S += outer(k, beta*(v - r));  o = S^T q     (F32 state)
+  5. output: rms_norm(o) * w * silu(z)  (non-residual weight) -> out_proj
+
+TPU formulation: the recurrence is a lax.scan over time inside the same jit
+as the rest of the block — sequential math but compiled, with the state
+carried in the cache pytree exactly like KV. Q/K are L2-normalized per head
+(q additionally scaled by 1/sqrt(Dk)), matching the reference's fused
+rms_norm trick (linear_attention.rs l2_alpha_q/k).
 """
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
+from ..ops.linear import linear
+from ..ops.norms import rms_norm_gated
+
+
+def _dims(cfg):
+    la = cfg.linear_attn
+    key_dim = la.num_key_heads * la.key_head_dim
+    value_dim = la.num_value_heads * la.value_head_dim
+    conv_dim = 2 * key_dim + value_dim
+    total = conv_dim + 2 * la.num_value_heads + value_dim
+    return la, key_dim, value_dim, conv_dim, total
+
 
 def init_gdn_params(cfg, key, dtype):
-    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")
+    la, key_dim, value_dim, conv_dim, total = _dims(cfg)
+    ks = jax.random.split(key, 4)
+    h = cfg.hidden_size
+    return {
+        "in_proj": {"weight": jax.random.normal(ks[0], (total, h), dtype) * 0.02},
+        "conv1d": {"weight": jax.random.normal(
+            ks[1], (conv_dim, 1, la.conv_kernel_dim), dtype) * 0.2},
+        "dt_bias": jnp.zeros((la.num_value_heads,), dtype),
+        "A_log": jnp.zeros((la.num_value_heads,), dtype),
+        "norm": {"weight": jnp.ones((la.value_head_dim,), dtype)},
+        "out_proj": {"weight": jax.random.normal(ks[3], (h, value_dim),
+                                                 dtype) * 0.02},
+    }
+
+
+def _l2norm(x, eps: float = 1e-6):
+    return x * jax.lax.rsqrt(jnp.sum(x * x, axis=-1, keepdims=True) + eps)
 
 
 def gdn_forward(cfg, p, x, layer_cache, pos0, valid_len=None):
-    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")
+    """x: [B, S, H]. Returns (y [B, S, H], new_layer_cache).
+
+    layer_cache: {"conv": [B, C, K-1] model-dtype, "state": [B, Hv, Dk, Dv]
+    f32} or None (stateless training path). Padded prefill steps
+    (index >= valid_len) update neither the conv state (handled by slicing)
+    nor the recurrent state (masked in the scan).
+    """
+    la, key_dim, value_dim, conv_dim, total = _dims(cfg)
+    b, s, _ = x.shape
+    hv, dk, dv = la.num_value_heads, la.key_head_dim, la.value_head_dim
+    kcs = la.conv_kernel_dim
+    in_dtype = x.dtype
+
+    proj = linear(x, p["in_proj"]["weight"]).astype(jnp.float32)
+    mixed = proj[..., :conv_dim]
+    a = proj[..., conv_dim:conv_dim + hv]
+    bg = proj[..., conv_dim + hv:conv_dim + 2 * hv]
+    z = proj[..., conv_dim + 2 * hv:]
+
+    # --- causal depthwise conv + SiLU, state-carrying --------------------
+    xt = mixed.transpose(0, 2, 1)                       # [B, C, S]
+    conv_state = (layer_cache["conv"].astype(jnp.float32)
+                  if layer_cache is not None
+                  else jnp.zeros((b, conv_dim, kcs - 1), jnp.float32))
+    padded = jnp.concatenate([conv_state, xt], axis=2)  # [B, C, S+K-1]
+    conv_w = p["conv1d"]["weight"].astype(jnp.float32)  # [C, 1, K]
+    y = jax.lax.conv_general_dilated(
+        padded, conv_w, window_strides=(1,), padding="VALID",
+        feature_group_count=conv_dim,
+        dimension_numbers=("NCH", "OIH", "NCH"))        # [B, C, S]
+    y = jax.nn.silu(y).transpose(0, 2, 1)               # [B, S, C]
+    # next conv state = last K-1 VALID inputs (see update_kv_cache analog)
+    vl = jnp.asarray(s, jnp.int32) if valid_len is None else valid_len
+    new_conv = jax.lax.dynamic_slice_in_dim(padded, vl, kcs - 1, axis=2)
+
+    # --- split + head reshape + L2 norms ---------------------------------
+    q = y[..., :key_dim].reshape(b, s, la.num_key_heads, dk)
+    k = y[..., key_dim:2 * key_dim].reshape(b, s, la.num_key_heads, dk)
+    v = y[..., 2 * key_dim:].reshape(b, s, hv, dv)
+    if la.num_key_heads < hv:
+        rep = hv // la.num_key_heads
+        q = jnp.repeat(q, rep, axis=2)
+        k = jnp.repeat(k, rep, axis=2)
+    q = _l2norm(q) / (dk ** 0.5)        # ref: l2_alpha_q includes q_scale
+    k = _l2norm(k)
+
+    # --- gates ------------------------------------------------------------
+    a_log = p["A_log"].astype(jnp.float32)
+    dt_bias = p["dt_bias"].astype(jnp.float32)
+    g = -jnp.exp(a_log) * jax.nn.softplus(a + dt_bias)  # [B, S, Hv]
+    beta = jax.nn.sigmoid(bg)                           # [B, S, Hv]
+
+    # --- delta-rule recurrence (scan over time, F32 state) ----------------
+    state0 = (layer_cache["state"] if layer_cache is not None
+              else jnp.zeros((b, hv, dk, dv), jnp.float32))
+    idx = jnp.arange(s, dtype=jnp.int32)
+    valid = idx < vl                                    # [S]
+
+    def step(state, inp):
+        q_t, k_t, v_t, g_t, beta_t, ok = inp            # [B,Hv,*] each
+        decayed = state * jnp.exp(g_t)[..., None, None]
+        retrieved = jnp.einsum("bhkv,bhk->bhv", decayed, k_t)
+        delta = (v_t - retrieved) * beta_t[..., None]
+        updated = decayed + jnp.einsum("bhk,bhv->bhkv", k_t, delta)
+        out_t = jnp.einsum("bhkv,bhk->bhv", updated, q_t)
+        state = jnp.where(ok, updated, state)           # pads don't advance
+        return state, out_t
+
+    # time-major inputs for the scan
+    tm = lambda t: jnp.moveaxis(t, 1, 0)
+    state, out = jax.lax.scan(
+        step, state0,
+        (tm(q), tm(k), tm(v), tm(g), tm(beta), valid[:, None, None]))
+    out = jnp.moveaxis(out, 0, 1)                       # [B, S, Hv, Dv]
+
+    # --- gated output norm + projection -----------------------------------
+    # weight * rms_norm(o) * silu(z) with NON-residual weight
+    # (ref: RmsNormGated — unlike the block norms, no (1+w))
+    zf = z.reshape(b, s, hv, dv)
+    o = rms_norm_gated(out, zf, p["norm"]["weight"].astype(jnp.float32),
+                       cfg.rms_norm_eps)
+    y_out = linear(o.reshape(b, s, value_dim).astype(in_dtype),
+                   p["out_proj"]["weight"])
+
+    new_cache = None
+    if layer_cache is not None:
+        new_cache = {"conv": new_conv.astype(layer_cache["conv"].dtype),
+                     "state": state}
+    return y_out, new_cache
 
 
-def load_gdn_params(loader, layer_prefix: str):
-    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")
+# -- checkpoint IO -----------------------------------------------------------
 
 
-def export_gdn_params(cfg, params, layer_prefix: str):
-    raise NotImplementedError("GDN linear attention: in progress (task: qwen3_5)")
+def load_gdn_params(loader, lp: str):
+    """lp = '<prefix>.layers.<i>'; weights under `.linear_attn.`
+    (ref: qwen3_5 weight names; fused in_proj or split
+    in_proj_qkv/a/b/z — linear_attention.rs:100-115)."""
+    import numpy as np
+    cfg = loader.cfg
+    la, key_dim, value_dim, conv_dim, total = _dims(cfg)
+    base = f"{lp}.linear_attn"
+    g = loader._get
+    if loader._has(f"{base}.in_proj.weight"):
+        in_proj = g(f"{base}.in_proj.weight")
+    else:
+        in_proj = np.concatenate([
+            g(f"{base}.in_proj_qkv.weight"), g(f"{base}.in_proj_a.weight"),
+            g(f"{base}.in_proj_b.weight"), g(f"{base}.in_proj_z.weight")],
+            axis=0)
+    conv_w = g(f"{base}.conv1d.weight")
+    if conv_w.ndim == 3 and conv_w.shape[1] != 1:       # [C, K, 1] variant
+        conv_w = conv_w.transpose(0, 2, 1)
+    from ..utils.loaders import _to_dev
+    dt = loader.dtype
+    return {
+        "in_proj": {"weight": _to_dev(in_proj, dt)},
+        "conv1d": {"weight": _to_dev(conv_w, dt)},
+        # decay-gate params stay F32: they feed exp()/softplus() applied to
+        # the recurrent state every step (ref: neg_a_exp_f32 precompute)
+        "dt_bias": _to_dev(g(f"{base}.dt_bias"), jnp.float32),
+        "A_log": _to_dev(g(f"{base}.A_log"), jnp.float32),
+        "norm": {"weight": _to_dev(g(f"{base}.norm.weight"), dt)},
+        "out_proj": {"weight": _to_dev(g(f"{base}.out_proj.weight"), dt)},
+    }
+
+
+def export_gdn_params(cfg, p, lp: str) -> dict:
+    import numpy as np
+    base = f"{lp}.linear_attn"
+    return {
+        f"{base}.in_proj.weight": np.asarray(p["in_proj"]["weight"]),
+        f"{base}.conv1d.weight": np.asarray(p["conv1d"]["weight"]),
+        f"{base}.dt_bias": np.asarray(p["dt_bias"]),
+        f"{base}.A_log": np.asarray(p["A_log"]),
+        f"{base}.norm.weight": np.asarray(p["norm"]["weight"]),
+        f"{base}.out_proj.weight": np.asarray(p["out_proj"]["weight"]),
+    }

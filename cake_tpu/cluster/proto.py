@@ -44,6 +44,27 @@ class ProtocolError(Exception):
     pass
 
 
+def _parse_header(hdr: bytes) -> int:
+    """Validate magic + length, via the native core when built."""
+    try:
+        from ..utils import cakekit
+        if cakekit.available():
+            n = cakekit.frame_parse(hdr, MAGIC, MAX_FRAME)
+            if n == -1:
+                raise ProtocolError(f"bad magic {hdr[:4].hex()}")
+            if n == -2:
+                raise ProtocolError("frame too large")
+            return n
+    except ImportError:
+        pass
+    magic, length = _HDR.unpack(hdr)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic:#x}")
+    if length > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {length}")
+    return length
+
+
 # -- tensors ----------------------------------------------------------------
 
 def pack_tensor(arr) -> dict:
@@ -79,11 +100,7 @@ def decode_payload(payload: bytes) -> dict:
 
 async def read_frame(reader: asyncio.StreamReader) -> dict:
     hdr = await reader.readexactly(_HDR.size)
-    magic, length = _HDR.unpack(hdr)
-    if magic != MAGIC:
-        raise ProtocolError(f"bad magic {magic:#x}")
-    if length > MAX_FRAME:
-        raise ProtocolError(f"frame too large: {length}")
+    length = _parse_header(hdr)
     payload = await reader.readexactly(length)
     return decode_payload(payload)
 
@@ -100,11 +117,7 @@ def read_frame_sync(sock) -> dict:
         if not chunk:
             raise ConnectionError("socket closed mid-header")
         buf += chunk
-    magic, length = _HDR.unpack(buf)
-    if magic != MAGIC:
-        raise ProtocolError(f"bad magic {magic:#x}")
-    if length > MAX_FRAME:
-        raise ProtocolError(f"frame too large: {length}")
+    length = _parse_header(buf)
     chunks = []
     got = 0
     while got < length:

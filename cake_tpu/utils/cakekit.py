@@ -1,0 +1,152 @@
+"""ctypes binding for the native cakekit core (csrc/cakekit.cpp).
+
+Builds libcakekit.so on first import if a toolchain is present; every entry
+point has a pure-Python fallback, so the package works without a compiler
+(the reference gates native code behind build features the same way).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+log = logging.getLogger("cake_tpu.cakekit")
+
+_LIB = None
+_TRIED = False
+
+
+def _csrc_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "csrc")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    so = os.path.join(_csrc_dir(), "libcakekit.so")
+    if not os.path.exists(so):
+        # build into a process-unique name then rename: concurrent importers
+        # must never CDLL a half-written ELF
+        tmp = f"{so}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["make", "-C", _csrc_dir(), f"TARGET={os.path.basename(tmp)}"],
+                capture_output=True, timeout=120, check=True)
+            os.replace(tmp, so)
+        except Exception as e:
+            log.debug("cakekit build unavailable: %s", e)
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            if not os.path.exists(so):
+                return None
+    try:
+        lib = ctypes.CDLL(so)
+        lib.ck_crc32.restype = ctypes.c_uint32
+        lib.ck_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_uint32]
+        lib.ck_pread.restype = ctypes.c_int64
+        lib.ck_pread.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_uint64, ctypes.c_void_p]
+        lib.ck_pread_fd.restype = ctypes.c_int64
+        lib.ck_pread_fd.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                    ctypes.c_uint64, ctypes.c_void_p]
+        lib.ck_preadv.restype = ctypes.c_int64
+        lib.ck_preadv.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_void_p]
+        lib.ck_frame_parse.restype = ctypes.c_int64
+        lib.ck_frame_parse.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                       ctypes.c_uint32]
+        _LIB = lib
+    except OSError as e:
+        log.debug("cakekit load failed: %s", e)
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def crc32(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        import zlib
+        return zlib.crc32(data, seed) & 0xFFFFFFFF
+    return int(lib.ck_crc32(data, len(data), seed))
+
+
+def pread_fd(fd: int, offset: int, length: int) -> bytes:
+    """Positioned read on an already-open fd (keeps TensorStorage's fd
+    cache effective on the hot path)."""
+    lib = _load()
+    if lib is None:
+        return os.pread(fd, length, offset)
+    buf = ctypes.create_string_buffer(length)
+    got = lib.ck_pread_fd(fd, offset, length, buf)
+    if got < 0:
+        raise OSError(f"ck_pread_fd({fd}, {offset}, {length}) -> {got}")
+    return buf.raw[:got]
+
+
+def pread(path: str, offset: int, length: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            return os.pread(fd, length, offset)
+        finally:
+            os.close(fd)
+    buf = ctypes.create_string_buffer(length)
+    got = lib.ck_pread(path.encode(), offset, length, buf)
+    if got < 0:
+        raise OSError(f"ck_pread({path}, {offset}, {length}) -> {got}")
+    return buf.raw[:got]
+
+
+def preadv(path: str, ranges: list[tuple[int, int]]) -> list[bytes]:
+    """Batched positioned reads: [(offset, length), ...] -> chunks."""
+    lib = _load()
+    if lib is None:
+        return [pread(path, off, ln) for off, ln in ranges]
+    n = len(ranges)
+    offsets = np.asarray([r[0] for r in ranges], np.uint64)
+    lens = np.asarray([r[1] for r in ranges], np.uint64)
+    out_offsets = np.zeros(n, np.uint64)
+    np.cumsum(lens[:-1], out=out_offsets[1:])
+    total = int(lens.sum())
+    buf = ctypes.create_string_buffer(total)
+    got_lens = np.zeros(n, np.uint64)
+    got = lib.ck_preadv(path.encode(), n,
+                        offsets.ctypes.data_as(ctypes.c_void_p),
+                        lens.ctypes.data_as(ctypes.c_void_p),
+                        buf,
+                        out_offsets.ctypes.data_as(ctypes.c_void_p),
+                        got_lens.ctypes.data_as(ctypes.c_void_p))
+    if got < 0:
+        raise OSError(f"ck_preadv({path}) -> {got}")
+    raw = buf.raw
+    # slice by ACTUAL lengths: short reads at EOF truncate, same as pread
+    return [raw[int(o):int(o + g)] for o, g in zip(out_offsets, got_lens)]
+
+
+def frame_parse(header: bytes, magic: int, max_len: int) -> int:
+    if len(header) != 8:
+        raise ValueError(f"frame header must be 8 bytes, got {len(header)}")
+    lib = _load()
+    if lib is None:
+        import struct
+        m, length = struct.unpack("<II", header)
+        if m != magic:
+            return -1
+        if length > max_len:
+            return -2
+        return length
+    return int(lib.ck_frame_parse(header, magic, max_len))

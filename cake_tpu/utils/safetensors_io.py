@@ -103,7 +103,7 @@ class TensorStorage:
     def read_bytes(self, name: str) -> bytes:
         r = self.records[name]
         if _CAKEKIT is not None:
-            return _CAKEKIT.pread(r.file, r.start, r.nbytes)
+            return _CAKEKIT.pread_fd(self._fd(r.file), r.start, r.nbytes)
         return os.pread(self._fd(r.file), r.nbytes, r.start)
 
     def read(self, name: str) -> np.ndarray:

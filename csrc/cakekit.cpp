@@ -1,0 +1,126 @@
+// cakekit: native IO/runtime core for cake-tpu.
+//
+// The reference implements its wire framing and pread tensor storage in Rust
+// (ref: cake-core/src/cake/sharding/proto/mod.rs framing;
+// utils/tensor_storage.rs pread). This is the C++ equivalent for the hot
+// host-side paths, exposed through a C ABI consumed via ctypes
+// (cake_tpu/utils/cakekit.py):
+//
+//   ck_crc32        - CRC-32 (IEEE, zlib-compatible), slice-by-8
+//   ck_pread        - positioned read without mmap (page-cache friendly)
+//   ck_preadv       - batched positioned reads (expert streaming)
+//   ck_frame_parse  - header validation returning payload length
+//
+// Build: make -C csrc   ->  csrc/libcakekit.so
+// ctypes calls release the GIL, so large preads and CRC runs overlap with
+// Python-side work (the asyncio loop keeps serving while weights stream).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32
+
+static uint32_t crc_table[8][256];
+
+static void crc_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+        for (int s = 1; s < 8; s++)
+            crc_table[s][i] =
+                crc_table[0][crc_table[s - 1][i] & 0xFF] ^
+                (crc_table[s - 1][i] >> 8);
+}
+
+// table built once at library load (thread-safe: dynamic initialization of
+// a function-local static is serialized by the C++ runtime)
+static const bool crc_ready = [] { crc_init(); return true; }();
+
+uint32_t ck_crc32(const uint8_t* data, uint64_t len, uint32_t seed) {
+    (void)crc_ready;
+    uint32_t c = ~seed;
+    // slice-by-8 over the aligned bulk
+    while (len >= 8) {
+        uint32_t lo, hi;
+        memcpy(&lo, data, 4);
+        memcpy(&hi, data + 4, 4);
+        lo ^= c;
+        c = crc_table[7][lo & 0xFF] ^ crc_table[6][(lo >> 8) & 0xFF] ^
+            crc_table[5][(lo >> 16) & 0xFF] ^ crc_table[4][lo >> 24] ^
+            crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+            crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
+        data += 8;
+        len -= 8;
+    }
+    while (len--) c = crc_table[0][(c ^ *data++) & 0xFF] ^ (c >> 8);
+    return ~c;
+}
+
+// ---------------------------------------------------------------- pread
+
+// Returns bytes read, or a negative errno.
+int64_t ck_pread_fd(int fd, uint64_t offset, uint64_t len, uint8_t* out) {
+    uint64_t got = 0;
+    while (got < len) {
+        ssize_t n = pread(fd, out + got, len - got, (off_t)(offset + got));
+        if (n < 0) return -2;
+        if (n == 0) break;                 // EOF
+        got += (uint64_t)n;
+    }
+    return (int64_t)got;
+}
+
+int64_t ck_pread(const char* path, uint64_t offset, uint64_t len,
+                 uint8_t* out) {
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+    int64_t got = ck_pread_fd(fd, offset, len, out);
+    close(fd);
+    return got;
+}
+
+// Batched reads from one file: n ranges, each (offset[i], len[i]) into
+// out + out_offsets[i]; actual bytes read per range written to got_lens
+// (short at EOF — callers must slice by these, not the request).
+// Returns total bytes read or negative errno.
+int64_t ck_preadv(const char* path, uint64_t n, const uint64_t* offsets,
+                  const uint64_t* lens, uint8_t* out,
+                  const uint64_t* out_offsets, uint64_t* got_lens) {
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+    uint64_t total = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        int64_t got = ck_pread_fd(fd, offsets[i], lens[i],
+                                  out + out_offsets[i]);
+        if (got < 0) { close(fd); return got; }
+        got_lens[i] = (uint64_t)got;
+        total += (uint64_t)got;
+    }
+    close(fd);
+    return (int64_t)total;
+}
+
+// ---------------------------------------------------------------- framing
+
+// Validate a header; returns payload length, or negative on error:
+// -1 bad magic, -2 oversized.
+int64_t ck_frame_parse(const uint8_t* hdr8, uint32_t expect_magic,
+                       uint32_t max_len) {
+    uint32_t magic, length;
+    memcpy(&magic, hdr8, 4);
+    memcpy(&length, hdr8 + 4, 4);
+    if (magic != expect_magic) return -1;
+    if (length > max_len) return -2;
+    return (int64_t)length;
+}
+
+}  // extern "C"

@@ -82,8 +82,16 @@ def _print_token(tok):
 def cmd_serve(args) -> int:
     from .api import ApiState, serve
     gen, tokenizer, model_id, topo = _build(args)
+    image_model = audio_model = None
+    if args.image_model:
+        from .runtime import build_image_model
+        image_model = build_image_model(args.image_model, dtype=args.dtype)
+    if args.audio_model:
+        from .runtime import build_audio_model
+        audio_model = build_audio_model(args.audio_model, dtype=args.dtype)
     state = ApiState(model=gen, tokenizer=tokenizer, model_id=model_id,
-                     topology=topo)
+                     topology=topo, image_model=image_model,
+                     audio_model=audio_model)
     serve(state, host=args.host, port=args.port, basic_auth=args.basic_auth)
     return 0
 
@@ -175,6 +183,10 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--basic-auth", default=None, help="user:pass")
+    p.add_argument("--image-model", default=None,
+                   help="image model dir ('demo:flux' for random weights)")
+    p.add_argument("--audio-model", default=None,
+                   help="TTS model dir ('demo:vibevoice' | 'demo:luxtts')")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("worker", help="run as a cluster worker")

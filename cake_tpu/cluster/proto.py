@@ -44,25 +44,32 @@ class ProtocolError(Exception):
     pass
 
 
-def _parse_header(hdr: bytes) -> int:
-    """Validate magic + length, via the native core when built."""
-    try:
-        from ..utils import cakekit
-        if cakekit.available():
-            n = cakekit.frame_parse(hdr, MAGIC, MAX_FRAME)
-            if n == -1:
-                raise ProtocolError(f"bad magic {hdr[:4].hex()}")
-            if n == -2:
-                raise ProtocolError("frame too large")
-            return n
-    except ImportError:
-        pass
+def _parse_header_py(hdr: bytes) -> int:
     magic, length = _HDR.unpack(hdr)
     if magic != MAGIC:
         raise ProtocolError(f"bad magic {magic:#x}")
     if length > MAX_FRAME:
         raise ProtocolError(f"frame too large: {length}")
     return length
+
+
+def _parse_header_native(hdr: bytes) -> int:
+    from ..utils import cakekit
+    n = cakekit.frame_parse(hdr, MAGIC, MAX_FRAME)
+    if n == -1:
+        raise ProtocolError(f"bad magic {hdr[:4].hex()}")
+    if n == -2:
+        raise ProtocolError("frame too large")
+    return n
+
+
+# resolve once at import: an 8-byte header parse must not pay a per-frame
+# import + availability probe
+try:
+    from ..utils import cakekit as _ck
+    _parse_header = _parse_header_native if _ck.available() else _parse_header_py
+except ImportError:
+    _parse_header = _parse_header_py
 
 
 # -- tensors ----------------------------------------------------------------

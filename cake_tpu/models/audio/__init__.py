@@ -1,0 +1,3 @@
+from .luxtts import LuxTTS, LuxTTSConfig, tiny_luxtts_config
+from .vibevoice import (AudioOutput, TTSConfig, VibeVoiceTTS,
+                        tiny_tts_config)

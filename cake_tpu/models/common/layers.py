@@ -303,8 +303,13 @@ def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
     worker.rs op-batch execution, but compiled as ONE device program)."""
     lo, hi = layer_range or (0, len(params["layers"]))
     specs = cfg.layer_specs()[lo:hi]
-    new_layers = list(cache["layers"])
     rope = params["rope"]
+    if cache is None:       # stateless (training / encoder use)
+        for j, spec in enumerate(specs):
+            x, _ = block_forward(cfg, spec, params["layers"][j], x, None,
+                                 pos0, rope, valid_len)
+        return x, None
+    new_layers = list(cache["layers"])
     for j, spec in enumerate(specs):
         x, new_layers[j] = block_forward(cfg, spec, params["layers"][j], x,
                                          cache["layers"][j], pos0, rope,
@@ -321,11 +326,7 @@ def forward_train(cfg: ModelConfig, params: dict, tokens):
     training step in parallel/train.py and by logit-parity tests.
     """
     x = embed_tokens(cfg, params, tokens)
-    specs = cfg.layer_specs()
-    rope = params["rope"]
-    pos0 = jnp.asarray(0, jnp.int32)
-    for j, spec in enumerate(specs[:len(params["layers"])]):
-        x, _ = block_forward(cfg, spec, params["layers"][j], x, None, pos0, rope)
+    x, _ = forward_layers(cfg, params, x, None, jnp.asarray(0, jnp.int32))
     h = rms_norm(x, params["norm"]["weight"], cfg.rms_norm_eps)
     w = (params["embed_tokens"]["weight"] if cfg.tie_word_embeddings
          else params["lm_head"]["weight"])

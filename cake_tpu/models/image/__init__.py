@@ -1,0 +1,5 @@
+from .flux import (COMPONENT_NAMES, DummyTextEncoder, FluxImageModel,
+                   FluxPipelineConfig, tiny_flux_config)
+from .mmdit import MMDiTConfig, init_mmdit_params, mmdit_forward
+from .vae import (VaeConfig, init_vae_decoder_params, latents_to_patches,
+                  patches_to_latents, vae_decode)

@@ -1,0 +1,267 @@
+"""MMDiT flow-matching transformer (FLUX architecture family).
+
+Structure (ref: models/flux/flux1_model.rs — 19 double-stream + 38
+single-stream MMDiT blocks; flux2_model.rs for the FLUX.2 variant):
+  * img/txt input projections; sinusoidal timestep + pooled-vector MLP
+    embedders (+ guidance embedding for -dev models)
+  * double-stream blocks: separate image/text streams with per-stream
+    AdaLN modulation (ops.adaln_modulate) and JOINT attention over the
+    concatenated sequence
+  * single-stream blocks: one stream, fused qkv||mlp projection
+  * final AdaLN + linear to patch output
+  * 2D rotary embeddings over (y, x) latent positions, text ids at 0
+
+TPU-first: one config-driven functional implementation, bf16 matmuls with
+f32 modulation, the whole denoise step jitted as a single program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import adaln_modulate, linear, rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class MMDiTConfig:
+    in_channels: int = 64            # patched latent channels (16ch * 2x2)
+    hidden_size: int = 3072
+    num_heads: int = 24
+    head_dim: int = 128
+    mlp_ratio: float = 4.0
+    depth_double: int = 19
+    depth_single: int = 38
+    txt_dim: int = 4096              # context embedding width (T5 / LLM)
+    vec_dim: int = 768               # pooled vector width (CLIP / mean-pool)
+    guidance_embed: bool = True      # FLUX.1-dev
+    axes_dims: tuple[int, ...] = (16, 56, 56)   # rope dims per axis (t,y,x)
+    theta: float = 10000.0
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal embedding, t in [0, 1] scaled by 1000 (FLUX convention)."""
+    t = t * 1000.0
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t[:, None].astype(jnp.float32) * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def rope_2d(ids, axes_dims, theta: float):
+    """ids: [B, S, n_axes] integer positions -> (cos, sin) [B, S, sum/2].
+
+    Per-axis rotary frequencies concatenated (FLUX EmbedND)."""
+    outs_c, outs_s = [], []
+    for i, d in enumerate(axes_dims):
+        pos = ids[..., i].astype(jnp.float32)
+        freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        ang = pos[..., None] * freqs
+        outs_c.append(jnp.cos(ang))
+        outs_s.append(jnp.sin(ang))
+    return jnp.concatenate(outs_c, -1), jnp.concatenate(outs_s, -1)
+
+
+def apply_rope_interleaved(x, cos, sin):
+    """x: [B, S, H, D]; cos/sin: [B, S, D/2]; FLUX uses interleaved pairs."""
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    c, s = cos[:, :, None, :], sin[:, :, None, :]
+    o1 = x1 * c - x2 * s
+    o2 = x1 * s + x2 * c
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _mlp_params(key, din, dout, dtype):
+    k1, k2 = jax.random.split(key)
+    return {"in": {"weight": jax.random.normal(k1, (dout, din), dtype) * 0.02,
+                   "bias": jnp.zeros((dout,), dtype)},
+            "out": {"weight": jax.random.normal(k2, (dout, dout), dtype) * 0.02,
+                    "bias": jnp.zeros((dout,), dtype)}}
+
+
+def _lin(key, dout, din, dtype, bias=True):
+    p = {"weight": jax.random.normal(key, (dout, din), dtype) * 0.02}
+    if bias:
+        p["bias"] = jnp.zeros((dout,), dtype)
+    return p
+
+
+def init_mmdit_params(cfg: MMDiTConfig, key, dtype=jnp.bfloat16) -> dict:
+    h = cfg.hidden_size
+    mlp = int(h * cfg.mlp_ratio)
+    keys = iter(jax.random.split(key, 16 + 12 * (cfg.depth_double
+                                                 + cfg.depth_single)))
+    p: dict = {
+        "img_in": _lin(next(keys), h, cfg.in_channels, dtype),
+        "txt_in": _lin(next(keys), h, cfg.txt_dim, dtype),
+        "time_mlp": _mlp_params(next(keys), 256, h, dtype),
+        "vec_mlp": _mlp_params(next(keys), cfg.vec_dim, h, dtype),
+        "final_mod": _lin(next(keys), 2 * h, h, dtype),
+        "final_out": _lin(next(keys), cfg.in_channels, h, dtype),
+    }
+    if cfg.guidance_embed:
+        p["guidance_mlp"] = _mlp_params(next(keys), 256, h, dtype)
+
+    def stream(ks):
+        return {
+            "mod": _lin(next(ks), 6 * h, h, dtype),
+            "qkv": _lin(next(ks), 3 * cfg.num_heads * cfg.head_dim, h, dtype),
+            "q_norm": {"weight": jnp.ones((cfg.head_dim,), dtype)},
+            "k_norm": {"weight": jnp.ones((cfg.head_dim,), dtype)},
+            "proj": _lin(next(ks), h, cfg.num_heads * cfg.head_dim, dtype),
+            "mlp_in": _lin(next(ks), mlp, h, dtype),
+            "mlp_out": _lin(next(ks), h, mlp, dtype),
+        }
+
+    p["double"] = [{"img": stream(keys), "txt": stream(keys)}
+                   for _ in range(cfg.depth_double)]
+    p["single"] = [{
+        "mod": _lin(next(keys), 3 * h, h, dtype),
+        # fused qkv + mlp-in, one matmul (FLUX single-stream design)
+        "linear1": _lin(next(keys), 3 * cfg.num_heads * cfg.head_dim + mlp,
+                        h, dtype),
+        "linear2": _lin(next(keys), h, cfg.num_heads * cfg.head_dim + mlp,
+                        dtype),
+        "q_norm": {"weight": jnp.ones((cfg.head_dim,), dtype)},
+        "k_norm": {"weight": jnp.ones((cfg.head_dim,), dtype)},
+    } for _ in range(cfg.depth_single)]
+    return p
+
+
+def _mlp_fwd(p, x):
+    return linear(jax.nn.silu(linear(x, p["in"]["weight"], p["in"]["bias"])),
+                  p["out"]["weight"], p["out"]["bias"])
+
+
+def _ln(x):
+    """Parameter-free layernorm (FLUX uses elementwise_affine=False)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mean) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype)
+
+
+def _joint_attention(cfg, q, k, v, cos, sin):
+    q = apply_rope_interleaved(q, cos, sin)
+    k = apply_rope_interleaved(k, cos, sin)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / (cfg.head_dim ** 0.5)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _qkv(cfg, p, x):
+    b, s, _ = x.shape
+    qkv = linear(x, p["qkv"]["weight"], p["qkv"]["bias"])
+    qkv = qkv.reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = rms_norm(q, p["q_norm"]["weight"], 1e-6)
+    k = rms_norm(k, p["k_norm"]["weight"], 1e-6)
+    return q, k, v
+
+
+def double_block(cfg, p, img, txt, vec, cos, sin):
+    """Separate modulated streams, joint attention (txt first in sequence)."""
+    b = img.shape[0]
+    img_mod = linear(jax.nn.silu(vec), p["img"]["mod"]["weight"],
+                     p["img"]["mod"]["bias"]).reshape(b, 1, 6, -1)
+    txt_mod = linear(jax.nn.silu(vec), p["txt"]["mod"]["weight"],
+                     p["txt"]["mod"]["bias"]).reshape(b, 1, 6, -1)
+
+    img_h = adaln_modulate(_ln(img), img_mod[:, :, 0], img_mod[:, :, 1])
+    txt_h = adaln_modulate(_ln(txt), txt_mod[:, :, 0], txt_mod[:, :, 1])
+    qi, ki, vi = _qkv(cfg, p["img"], img_h)
+    qt, kt, vt = _qkv(cfg, p["txt"], txt_h)
+    st = txt.shape[1]
+    q = jnp.concatenate([qt, qi], axis=1)
+    k = jnp.concatenate([kt, ki], axis=1)
+    v = jnp.concatenate([vt, vi], axis=1)
+    attn = _joint_attention(cfg, q, k, v, cos, sin)
+    attn = attn.reshape(b, attn.shape[1], -1)
+    attn_t, attn_i = attn[:, :st], attn[:, st:]
+
+    img = img + img_mod[:, :, 2] * linear(attn_i, p["img"]["proj"]["weight"],
+                                          p["img"]["proj"]["bias"])
+    txt = txt + txt_mod[:, :, 2] * linear(attn_t, p["txt"]["proj"]["weight"],
+                                          p["txt"]["proj"]["bias"])
+
+    img_h = adaln_modulate(_ln(img), img_mod[:, :, 3], img_mod[:, :, 4])
+    img = img + img_mod[:, :, 5] * linear(
+        jax.nn.gelu(linear(img_h, p["img"]["mlp_in"]["weight"],
+                           p["img"]["mlp_in"]["bias"]), approximate=True),
+        p["img"]["mlp_out"]["weight"], p["img"]["mlp_out"]["bias"])
+    txt_h = adaln_modulate(_ln(txt), txt_mod[:, :, 3], txt_mod[:, :, 4])
+    txt = txt + txt_mod[:, :, 5] * linear(
+        jax.nn.gelu(linear(txt_h, p["txt"]["mlp_in"]["weight"],
+                           p["txt"]["mlp_in"]["bias"]), approximate=True),
+        p["txt"]["mlp_out"]["weight"], p["txt"]["mlp_out"]["bias"])
+    return img, txt
+
+
+def single_block(cfg, p, x, vec, cos, sin):
+    b, s, h = x.shape
+    qkv_dim = 3 * cfg.num_heads * cfg.head_dim
+    mod = linear(jax.nn.silu(vec), p["mod"]["weight"],
+                 p["mod"]["bias"]).reshape(b, 1, 3, -1)
+    xh = adaln_modulate(_ln(x), mod[:, :, 0], mod[:, :, 1])
+    both = linear(xh, p["linear1"]["weight"], p["linear1"]["bias"])
+    qkv, mlp_h = both[..., :qkv_dim], both[..., qkv_dim:]
+    qkv = qkv.reshape(b, s, 3, cfg.num_heads, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = rms_norm(q, p["q_norm"]["weight"], 1e-6)
+    k = rms_norm(k, p["k_norm"]["weight"], 1e-6)
+    attn = _joint_attention(cfg, q, k, v, cos, sin).reshape(b, s, -1)
+    merged = jnp.concatenate([attn, jax.nn.gelu(mlp_h, approximate=True)],
+                             axis=-1)
+    return x + mod[:, :, 2] * linear(merged, p["linear2"]["weight"],
+                                     p["linear2"]["bias"])
+
+
+def mmdit_forward(cfg: MMDiTConfig, params: dict, img, img_ids, txt, txt_ids,
+                  t, vec, guidance=None):
+    """img: [B, S_img, in_ch] patched latents; txt: [B, S_txt, txt_dim];
+    t: [B] in [0,1]; vec: [B, vec_dim]; ids: [B, S, n_axes].
+    Returns velocity prediction [B, S_img, in_ch]."""
+    emb = _mlp_fwd(params["time_mlp"],
+                   timestep_embedding(t, 256).astype(img.dtype))
+    emb = emb + _mlp_fwd(params["vec_mlp"], vec)
+    if cfg.guidance_embed and guidance is not None:
+        emb = emb + _mlp_fwd(params["guidance_mlp"],
+                             timestep_embedding(guidance, 256).astype(img.dtype))
+    vec_emb = emb[:, None, :]
+
+    img_h = linear(img, params["img_in"]["weight"], params["img_in"]["bias"])
+    txt_h = linear(txt, params["txt_in"]["weight"], params["txt_in"]["bias"])
+
+    ids = jnp.concatenate([txt_ids, img_ids], axis=1)
+    cos, sin = rope_2d(ids, cfg.axes_dims, cfg.theta)
+
+    for blk in params["double"]:
+        img_h, txt_h = double_block(cfg, blk, img_h, txt_h, vec_emb, cos, sin)
+    x = jnp.concatenate([txt_h, img_h], axis=1)
+    for blk in params["single"]:
+        x = single_block(cfg, blk, x, vec_emb, cos, sin)
+    x = x[:, txt.shape[1]:]
+
+    mod = linear(jax.nn.silu(vec_emb), params["final_mod"]["weight"],
+                 params["final_mod"]["bias"])
+    shift, scale = jnp.split(mod, 2, axis=-1)
+    x = adaln_modulate(_ln(x), shift, scale)
+    return linear(x, params["final_out"]["weight"], params["final_out"]["bias"])
+
+
+def make_img_ids(h_patches: int, w_patches: int, batch: int = 1):
+    ys, xs = np.meshgrid(np.arange(h_patches), np.arange(w_patches),
+                         indexing="ij")
+    ids = np.stack([np.zeros_like(ys), ys, xs], axis=-1).reshape(-1, 3)
+    return jnp.asarray(np.broadcast_to(ids[None], (batch, ids.shape[0], 3)))
+
+
+def make_txt_ids(seq_len: int, batch: int = 1):
+    return jnp.zeros((batch, seq_len, 3), jnp.int32)

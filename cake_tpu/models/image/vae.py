@@ -1,0 +1,138 @@
+"""Image VAE decoder (and encoder for img2img) — SD/FLUX autoencoder family
+(ref: models/flux/vae.rs, flux2_vae.rs 32-ch variant, models/sd VAE via
+candle-transformers).
+
+Standard conv architecture: conv_in -> mid(resnet, attn, resnet) ->
+up blocks (3 resnets + nearest-2x upsample each) -> GroupNorm+SiLU+conv_out.
+Channels-first layout on TPU; XLA maps convs onto the MXU.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import conv2d, group_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class VaeConfig:
+    latent_channels: int = 16        # FLUX.1: 16, FLUX.2: 32, SD: 4
+    base_channels: int = 128
+    channel_mults: tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 3          # per decoder up block
+    out_channels: int = 3
+    scaling_factor: float = 0.3611   # FLUX.1
+    shift_factor: float = 0.1159
+
+
+def _conv_p(key, cout, cin, k, dtype):
+    return {"weight": jax.random.normal(key, (cout, cin, k, k), dtype) * 0.02,
+            "bias": jnp.zeros((cout,), dtype)}
+
+
+def _norm_p(c, dtype):
+    return {"weight": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _resnet_p(key, cin, cout, dtype):
+    ks = jax.random.split(key, 3)
+    p = {"norm1": _norm_p(cin, dtype), "conv1": _conv_p(ks[0], cout, cin, 3, dtype),
+         "norm2": _norm_p(cout, dtype), "conv2": _conv_p(ks[1], cout, cout, 3, dtype)}
+    if cin != cout:
+        p["shortcut"] = _conv_p(ks[2], cout, cin, 1, dtype)
+    return p
+
+
+def init_vae_decoder_params(cfg: VaeConfig, key, dtype=jnp.float32) -> dict:
+    chs = [cfg.base_channels * m for m in cfg.channel_mults]
+    top = chs[-1]
+    keys = iter(jax.random.split(key, 64))
+    p: dict = {
+        "conv_in": _conv_p(next(keys), top, cfg.latent_channels, 3, dtype),
+        "mid_res1": _resnet_p(next(keys), top, top, dtype),
+        "mid_attn": {
+            "norm": _norm_p(top, dtype),
+            "q": _conv_p(next(keys), top, top, 1, dtype),
+            "k": _conv_p(next(keys), top, top, 1, dtype),
+            "v": _conv_p(next(keys), top, top, 1, dtype),
+            "proj": _conv_p(next(keys), top, top, 1, dtype),
+        },
+        "mid_res2": _resnet_p(next(keys), top, top, dtype),
+        "ups": [],
+        "norm_out": _norm_p(chs[0], dtype),
+        "conv_out": _conv_p(next(keys), cfg.out_channels, chs[0], 3, dtype),
+    }
+    cin = top
+    for i, c in enumerate(reversed(chs)):
+        blk = {"res": [], "upsample": None}
+        for _ in range(cfg.num_res_blocks):
+            blk["res"].append(_resnet_p(next(keys), cin, c, dtype))
+            cin = c
+        if i < len(chs) - 1:
+            blk["upsample"] = _conv_p(next(keys), c, c, 3, dtype)
+        p["ups"].append(blk)
+    return p
+
+
+def _resnet(p, x):
+    h = jax.nn.silu(group_norm(x, p["norm1"]["weight"], p["norm1"]["bias"], 32))
+    h = conv2d(h, p["conv1"]["weight"], p["conv1"]["bias"], padding=1)
+    h = jax.nn.silu(group_norm(h, p["norm2"]["weight"], p["norm2"]["bias"], 32))
+    h = conv2d(h, p["conv2"]["weight"], p["conv2"]["bias"], padding=1)
+    if "shortcut" in p:
+        x = conv2d(x, p["shortcut"]["weight"], p["shortcut"]["bias"])
+    return x + h
+
+
+def _mid_attention(p, x):
+    b, c, hh, ww = x.shape
+    h = group_norm(x, p["norm"]["weight"], p["norm"]["bias"], 32)
+    q = conv2d(h, p["q"]["weight"], p["q"]["bias"]).reshape(b, c, -1)
+    k = conv2d(h, p["k"]["weight"], p["k"]["bias"]).reshape(b, c, -1)
+    v = conv2d(h, p["v"]["weight"], p["v"]["bias"]).reshape(b, c, -1)
+    scores = jnp.einsum("bcs,bct->bst", q, k,
+                        preferred_element_type=jnp.float32) / (c ** 0.5)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bst,bct->bcs", probs, v).reshape(b, c, hh, ww)
+    return x + conv2d(out, p["proj"]["weight"], p["proj"]["bias"])
+
+
+def _upsample2x(p, x):
+    b, c, h, w = x.shape
+    x = jax.image.resize(x, (b, c, h * 2, w * 2), method="nearest")
+    return conv2d(x, p["weight"], p["bias"], padding=1)
+
+
+def vae_decode(cfg: VaeConfig, p: dict, z):
+    """z: [B, latent_ch, H/8, W/8] -> image [B, 3, H, W] in [-1, 1]."""
+    z = z / cfg.scaling_factor + cfg.shift_factor
+    x = conv2d(z, p["conv_in"]["weight"], p["conv_in"]["bias"], padding=1)
+    x = _resnet(p["mid_res1"], x)
+    x = _mid_attention(p["mid_attn"], x)
+    x = _resnet(p["mid_res2"], x)
+    for blk in p["ups"]:
+        for r in blk["res"]:
+            x = _resnet(r, x)
+        if blk["upsample"] is not None:
+            x = _upsample2x(blk["upsample"], x)
+    x = jax.nn.silu(group_norm(x, p["norm_out"]["weight"],
+                               p["norm_out"]["bias"], 32))
+    return jnp.tanh(conv2d(x, p["conv_out"]["weight"], p["conv_out"]["bias"],
+                           padding=1))
+
+
+def latents_to_patches(z):
+    """[B, C, H, W] -> [B, H/2*W/2, C*4] 2x2 patchify (FLUX packing)."""
+    b, c, h, w = z.shape
+    z = z.reshape(b, c, h // 2, 2, w // 2, 2)
+    return z.transpose(0, 2, 4, 1, 3, 5).reshape(b, (h // 2) * (w // 2), c * 4)
+
+
+def patches_to_latents(x, h: int, w: int):
+    """Inverse of latents_to_patches; h, w are the full latent dims."""
+    b, s, cf = x.shape
+    c = cf // 4
+    x = x.reshape(b, h // 2, w // 2, c, 2, 2)
+    return x.transpose(0, 3, 1, 4, 2, 5).reshape(b, c, h, w)

@@ -28,13 +28,20 @@ def conv1d(x, weight, bias=None, stride: int = 1, padding: int = 0,
 
 
 def conv_transpose1d(x, weight, bias=None, stride: int = 1, padding: int = 0):
-    """x: [B, Cin, T], weight: [Cin, Cout, K] (torch convention)."""
+    """x: [B, Cin, T], weight: [Cin, Cout, K] (torch convention).
+
+    Torch semantics: out_len = (T-1)*stride + K - 2*padding, and torch
+    applies the kernel flipped relative to jax.lax.conv_transpose — so flip
+    the spatial axis, compute VALID, and crop `padding` from both ends.
+    """
     y = jax.lax.conv_transpose(
-        x, weight,
+        x, weight[:, :, ::-1],
         strides=(stride,),
-        padding=[(padding, padding)],
+        padding="VALID",
         dimension_numbers=("NCH", "IOH", "NCH"),
     )
+    if padding:
+        y = y[:, :, padding:y.shape[2] - padding]
     if bias is not None:
         y = y + bias[None, :, None]
     return y

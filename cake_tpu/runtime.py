@@ -93,6 +93,33 @@ def load_config_and_quant(model_dir: str, arch: str | None = None):
     raise FileNotFoundError(f"no config.json or .gguf in {model_dir}")
 
 
+def build_image_model(model: str, dtype: str = "bf16"):
+    """Image generator for the serve path. 'demo:flux' runs the full
+    pipeline on random weights (zero-egress environments); checkpoint
+    weight-name mapping for FLUX.1/2 release checkpoints is tracked for the
+    next round."""
+    from .models.image import FluxImageModel, tiny_flux_config
+    if model.startswith("demo:"):
+        return FluxImageModel(tiny_flux_config(), dtype=parse_dtype(dtype))
+    raise NotImplementedError(
+        f"image checkpoint loading for {model!r} not yet wired; use "
+        f"'demo:flux' for the random-weight pipeline")
+
+
+def build_audio_model(model: str, dtype: str = "bf16"):
+    """TTS generator for the serve path ('demo:vibevoice' | 'demo:luxtts')."""
+    from .models.audio import (LuxTTS, VibeVoiceTTS, tiny_luxtts_config,
+                               tiny_tts_config)
+    dt = parse_dtype(dtype)
+    if model == "demo:luxtts":
+        return LuxTTS(tiny_luxtts_config(), dtype=dt)
+    if model.startswith("demo"):
+        return VibeVoiceTTS(tiny_tts_config(), dtype=dt)
+    raise NotImplementedError(
+        f"audio checkpoint loading for {model!r} not yet wired; use "
+        f"'demo:vibevoice' or 'demo:luxtts'")
+
+
 def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                      max_cache_len: int = 2048, seed: int = 42,
                      cluster_key: str | None = None,

@@ -1,0 +1,55 @@
+"""16-bit PCM WAV encoding (ref: cake-core/src/utils/wav.rs)."""
+from __future__ import annotations
+
+import io
+import struct
+
+import numpy as np
+
+
+def f32_to_pcm16(samples: np.ndarray) -> bytes:
+    s = np.clip(np.asarray(samples, np.float32), -1.0, 1.0)
+    return (s * 32767.0).astype("<i2").tobytes()
+
+
+def encode_wav(samples: np.ndarray, sample_rate: int = 24000) -> bytes:
+    """Mono f32 [-1, 1] samples -> RIFF/WAVE bytes."""
+    pcm = f32_to_pcm16(samples)
+    buf = io.BytesIO()
+    buf.write(b"RIFF")
+    buf.write(struct.pack("<I", 36 + len(pcm)))
+    buf.write(b"WAVE")
+    buf.write(b"fmt ")
+    buf.write(struct.pack("<IHHIIHH", 16, 1, 1, sample_rate,
+                          sample_rate * 2, 2, 16))
+    buf.write(b"data")
+    buf.write(struct.pack("<I", len(pcm)))
+    buf.write(pcm)
+    return buf.getvalue()
+
+
+def decode_wav(data: bytes) -> tuple[np.ndarray, int]:
+    """Minimal RIFF parser -> (f32 mono samples, sample_rate)."""
+    if data[:4] != b"RIFF" or data[8:12] != b"WAVE":
+        raise ValueError("not a WAV file")
+    pos = 12
+    fmt = None
+    pcm = None
+    rate = 24000
+    channels = 1
+    while pos + 8 <= len(data):
+        cid = data[pos:pos + 4]
+        size = struct.unpack("<I", data[pos + 4:pos + 8])[0]
+        body = data[pos + 8:pos + 8 + size]
+        if cid == b"fmt ":
+            fmt = struct.unpack("<HHIIHH", body[:16])
+            channels, rate = fmt[1], fmt[2]
+        elif cid == b"data":
+            pcm = body
+        pos += 8 + size + (size & 1)
+    if pcm is None:
+        raise ValueError("no data chunk")
+    samples = np.frombuffer(pcm, "<i2").astype(np.float32) / 32767.0
+    if channels > 1:
+        samples = samples.reshape(-1, channels).mean(axis=1)
+    return samples, rate

@@ -1,0 +1,177 @@
+"""Image + audio pipeline tests on tiny configs: schedulers vs references,
+MMDiT shape/semantics, VAE decode, full generate_image/generate_speech."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.audio import (LuxTTS, VibeVoiceTTS, tiny_luxtts_config,
+                                   tiny_tts_config)
+from cake_tpu.models.image import (FluxImageModel, tiny_flux_config)
+from cake_tpu.models.image.mmdit import (init_mmdit_params, make_img_ids,
+                                         make_txt_ids, mmdit_forward,
+                                         timestep_embedding)
+from cake_tpu.models.image.vae import (latents_to_patches, patches_to_latents)
+from cake_tpu.ops.diffusion import (DpmSolverPP, cfg_combine,
+                                    flow_matching_euler_step,
+                                    flow_matching_schedule)
+from cake_tpu.utils.wav import decode_wav, encode_wav
+
+
+# ------------------------------------------------------------- schedulers
+
+def test_flow_matching_schedule():
+    ts = flow_matching_schedule(10)
+    assert ts[0] == 1.0 and ts[-1] == 0.0 and len(ts) == 11
+    assert np.all(np.diff(ts) < 0)
+    shifted = flow_matching_schedule(10, shift_mu=1.15)
+    assert shifted[0] > 0.99 and shifted[-1] == 0.0   # shift keeps endpoints
+    # mid steps pushed toward 1 (more steps at high noise)
+    assert shifted[5] > ts[5]
+
+
+def test_euler_step_integrates_linear_flow():
+    """With the exact constant velocity v = x1 - x0, Euler recovers x0."""
+    rng = np.random.default_rng(0)
+    x1 = jnp.asarray(rng.standard_normal((2, 8)))    # noise at t=1
+    x0 = jnp.asarray(rng.standard_normal((2, 8)))    # data at t=0
+    v = x1 - x0                                       # d x_t / dt for lerp path
+    ts = flow_matching_schedule(5)
+    x = x1
+    for i in range(5):
+        x = flow_matching_euler_step(x, v, ts[i], ts[i + 1])
+    np.testing.assert_allclose(np.asarray(x), np.asarray(x0), atol=1e-5)
+
+
+def test_dpm_solver_denoises_toward_x0():
+    """v-prediction with the TRUE v at each step must recover x0 closely."""
+    rng = np.random.default_rng(1)
+    x0 = jnp.asarray(rng.standard_normal((4,)), jnp.float32)
+    sch = DpmSolverPP.from_betas()
+    ts = sch.timesteps(10)
+    a0 = float(sch.alphas_cumprod[ts[0]])
+    eps = jnp.asarray(rng.standard_normal(4), jnp.float32)
+    x = (a0 ** 0.5) * x0 + ((1 - a0) ** 0.5) * eps
+    for j, t in enumerate(ts):
+        a = float(sch.alphas_cumprod[int(t)])
+        alpha_t, sigma_t = a ** 0.5, (1 - a) ** 0.5
+        # true eps for current x given x0: eps_t = (x - alpha*x0)/sigma
+        eps_t = (x - alpha_t * x0) / max(sigma_t, 1e-8)
+        v_true = alpha_t * eps_t - 0.0 * x0 + 0.0  # placeholder
+        v_true = alpha_t * eps_t - sigma_t * 0     # v = alpha*eps - sigma*x0?
+        # v-parameterization: v = alpha_t * eps - sigma_t * x0
+        v_true = alpha_t * eps_t - sigma_t * x0
+        v_true = alpha_t * eps_t - sigma_t * x0
+        t_next = int(ts[j + 1]) if j + 1 < len(ts) else 0
+        x = sch.step(v_true, int(t), t_next, x)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(x0), atol=0.05)
+
+
+def test_cfg_combine():
+    u, c = jnp.asarray([1.0]), jnp.asarray([2.0])
+    assert float(cfg_combine(u, c, 1.0)[0]) == 2.0
+    assert float(cfg_combine(u, c, 0.0)[0]) == 1.0
+    assert float(cfg_combine(u, c, 2.0)[0]) == 3.0
+
+
+# ------------------------------------------------------------------ mmdit
+
+def test_patchify_roundtrip(rng):
+    z = jnp.asarray(rng.standard_normal((2, 4, 8, 12)), jnp.float32)
+    p = latents_to_patches(z)
+    assert p.shape == (2, 4 * 6, 16)
+    back = patches_to_latents(p, 8, 12)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(z))
+
+
+def test_timestep_embedding_distinct():
+    e = timestep_embedding(jnp.asarray([0.0, 0.5, 1.0]), 64)
+    assert e.shape == (3, 64)
+    assert not np.allclose(e[0], e[1])
+
+
+def test_mmdit_forward_shapes_and_conditioning(rng):
+    cfg = tiny_flux_config().mmdit
+    params = init_mmdit_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    img = jnp.asarray(rng.standard_normal((1, 24, cfg.in_channels)), jnp.float32)
+    txt = jnp.asarray(rng.standard_normal((1, 8, cfg.txt_dim)), jnp.float32)
+    vec = jnp.asarray(rng.standard_normal((1, cfg.vec_dim)), jnp.float32)
+    img_ids = make_img_ids(4, 6)
+    txt_ids = make_txt_ids(8)
+    t = jnp.asarray([0.5], jnp.float32)
+    g = jnp.asarray([3.5], jnp.float32)
+    v1 = mmdit_forward(cfg, params, img, img_ids, txt, txt_ids, t, vec, g)
+    assert v1.shape == img.shape
+    assert bool(jnp.all(jnp.isfinite(v1)))
+    # conditioning matters: different text -> different velocity
+    # (NB: scaling txt is ~invisible — FLUX LayerNorms are affine-free and
+    # scale-invariant — so perturb direction, not magnitude)
+    txt_b = jnp.asarray(rng.standard_normal((1, 8, cfg.txt_dim)), jnp.float32)
+    v2 = mmdit_forward(cfg, params, img, img_ids, txt_b, txt_ids,
+                       t, vec, g)
+    assert not np.allclose(np.asarray(v1), np.asarray(v2), atol=1e-4)
+    # timestep matters
+    v3 = mmdit_forward(cfg, params, img, img_ids, txt, txt_ids,
+                       jnp.asarray([0.9], jnp.float32), vec, g)
+    assert not np.allclose(np.asarray(v1), np.asarray(v3), atol=1e-4)
+
+
+# --------------------------------------------------------------- pipelines
+
+def test_flux_generate_image():
+    model = FluxImageModel(tiny_flux_config(), dtype=jnp.float32)
+    steps_seen = []
+    img = model.generate_image("a tiny cake", width=64, height=64, steps=3,
+                               seed=1, on_step=lambda i, n: steps_seen.append(i))
+    assert img.size == (64, 64)
+    assert steps_seen == [1, 2, 3]
+    # determinism
+    img2 = model.generate_image("a tiny cake", width=64, height=64, steps=3,
+                                seed=1)
+    np.testing.assert_array_equal(np.asarray(img), np.asarray(img2))
+    # different prompt -> different image (text conditioning reaches output)
+    img3 = model.generate_image("a dragon", width=64, height=64, steps=3,
+                                seed=1)
+    assert not np.array_equal(np.asarray(img), np.asarray(img3))
+
+
+def test_vibevoice_generate_speech():
+    tts = VibeVoiceTTS(tiny_tts_config(), dtype=jnp.float32, max_frames=6)
+    frames = []
+    audio = tts.generate_speech("hello there", max_frames=4,
+                                on_frame=frames.append)
+    hop = 16  # 4*4 upsample
+    assert len(audio.samples) == len(frames) * hop
+    assert np.all(np.abs(audio.samples) <= 1.0)
+    wav = audio.wav_bytes()
+    assert wav[:4] == b"RIFF"
+    samples, rate = decode_wav(wav)
+    assert rate == tts.cfg.sample_rate
+    np.testing.assert_allclose(samples, audio.samples, atol=1e-3)
+    assert len(audio.pcm_bytes()) == 2 * len(audio.samples)
+
+
+def test_vibevoice_voice_prompt_changes_output():
+    tts = VibeVoiceTTS(tiny_tts_config(), dtype=jnp.float32, max_frames=4)
+    a = tts.generate_speech("hi", max_frames=3)
+    voice = encode_wav(np.sin(np.linspace(0, 100, 4000)).astype(np.float32))
+    b = tts.generate_speech("hi", voice_wav=voice, max_frames=3)
+    assert not np.allclose(a.samples, b.samples)
+
+
+def test_luxtts_generate_speech():
+    tts = LuxTTS(tiny_luxtts_config(), dtype=jnp.float32)
+    audio = tts.generate_speech("hello world")
+    assert len(audio.samples) > 0
+    assert np.all(np.abs(audio.samples) <= 1.0)
+    # deterministic per (text, seed)
+    audio2 = tts.generate_speech("hello world")
+    np.testing.assert_array_equal(audio.samples, audio2.samples)
+
+
+def test_wav_roundtrip(rng):
+    s = np.clip(rng.standard_normal(1000) * 0.3, -1, 1).astype(np.float32)
+    wav = encode_wav(s, 16000)
+    back, rate = decode_wav(wav)
+    assert rate == 16000
+    np.testing.assert_allclose(back, s, atol=1e-4)

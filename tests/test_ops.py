@@ -234,6 +234,20 @@ def test_causal_depthwise_conv_update_matches_full(rng):
     np.testing.assert_allclose(got, full, atol=1e-5)
 
 
+def test_conv_transpose1d_matches_torch(rng):
+    x = rng.standard_normal((1, 3, 5)).astype(np.float32)
+    w = rng.standard_normal((3, 4, 8)).astype(np.float32)
+    b = rng.standard_normal(4).astype(np.float32)
+    y = ops.conv_transpose1d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                             stride=4, padding=2)
+    import torch
+    want = torch.nn.functional.conv_transpose1d(
+        torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+        stride=4, padding=2).numpy()
+    assert y.shape == want.shape == (1, 4, (5 - 1) * 4 + 8 - 4)
+    np.testing.assert_allclose(y, want, atol=1e-4)
+
+
 def test_conv2d(rng):
     x = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
     w = rng.standard_normal((5, 3, 3, 3)).astype(np.float32)

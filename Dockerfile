@@ -1,0 +1,22 @@
+# cake-tpu runtime image (ref: the reference ships a CUDA multi-stage build;
+# JAX wheels bundle the accelerator runtime so a single stage suffices —
+# install the TPU extra on TPU VMs, the CPU wheel elsewhere).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY cake_tpu ./cake_tpu
+COPY csrc ./csrc
+
+# TPU VMs: pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir jax flax optax msgpack zstandard pyyaml \
+        aiohttp tokenizers safetensors huggingface_hub pillow numpy \
+    && pip install --no-cache-dir -e . --no-deps --no-build-isolation \
+    && make -C csrc
+
+EXPOSE 8000 10128 18337/udp
+ENTRYPOINT ["cake-tpu"]
+CMD ["--help"]

@@ -1,0 +1,31 @@
+# cake-tpu developer entry points (ref: the reference Makefile's build/test
+# targets; mobile app targets have no analog here — see PARITY.md §2f).
+
+.PHONY: install test bench bench-micro native clean docker
+
+install:
+	pip install -e . --no-build-isolation
+
+native:
+	$(MAKE) -C csrc
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+bench-micro:
+	python benches/bench_micro.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+docker:
+	docker compose build
+
+clean:
+	$(MAKE) -C csrc clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

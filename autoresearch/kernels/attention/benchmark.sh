@@ -1,0 +1,16 @@
+#!/bin/sh
+python - <<'PY'
+import json, time, jax, jax.numpy as jnp
+from cake_tpu.ops.flash import flash_attention
+b, s, hq, hkv, d = 1, 4096, 16, 8, 128
+k = jax.random.PRNGKey(0)
+q = jax.random.normal(k, (b, s, hq, d), jnp.bfloat16)
+kv = jax.random.normal(k, (b, s, hkv, d), jnp.bfloat16)
+f = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+f(q, kv, kv).block_until_ready()
+t0 = time.perf_counter()
+for _ in range(10):
+    f(q, kv, kv).block_until_ready()
+dt = (time.perf_counter() - t0) / 10
+print(json.dumps({"prefill_tok_per_s": round(s / dt)}))
+PY

@@ -1,0 +1,155 @@
+"""Micro-benchmark suite (ref: cake-core/benches/ — 23 divan modules).
+
+Times the hot host-side and device-side primitives; prints one JSON object
+per benchmark. Run: python benches/bench_micro.py [--filter NAME] [--cpu]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timeit(fn, warmup=3, iters=20) -> float:
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_crc32():
+    from cake_tpu.cluster.proto import crc32
+    data = np.random.default_rng(0).integers(0, 256, 8 << 20,
+                                             dtype=np.uint32).astype(np.uint8).tobytes()
+    dt = timeit(lambda: crc32(data))
+    return {"gb_per_s": round(len(data) / dt / 1e9, 2)}
+
+
+def bench_frame_roundtrip():
+    from cake_tpu.cluster import proto
+    x = np.random.default_rng(0).standard_normal((1, 64, 2048)).astype(np.float32)
+    msg = proto.forward(x, 0, None)
+
+    def run():
+        frame = proto.encode_frame(msg)
+        proto.decode_payload(frame[8:])
+    dt = timeit(run)
+    return {"ms": round(dt * 1000, 3), "mb": round(x.nbytes / 1e6, 1)}
+
+
+def bench_auth():
+    import asyncio
+
+    from cake_tpu.cluster.auth import (authenticate_as_master,
+                                       authenticate_as_worker)
+
+    async def once():
+        done = asyncio.Event()
+
+        async def on_conn(r, w):
+            await authenticate_as_worker(r, w, "k")
+            w.close()
+            done.set()
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        r, w = await asyncio.open_connection(
+            "127.0.0.1", server.sockets[0].getsockname()[1])
+        await authenticate_as_master(r, w, "k")
+        await done.wait()
+        w.close()
+        server.close()
+    dt = timeit(lambda: asyncio.run(once()), warmup=2, iters=10)
+    return {"ms": round(dt * 1000, 2)}
+
+
+def bench_pread():
+    import os
+    import tempfile
+
+    from cake_tpu.utils import cakekit
+    with tempfile.NamedTemporaryFile(delete=False) as f:
+        f.write(os.urandom(32 << 20))
+        path = f.name
+    try:
+        dt = timeit(lambda: cakekit.pread(path, 0, 32 << 20))
+        return {"gb_per_s": round((32 << 20) / dt / 1e9, 2),
+                "native": cakekit.available()}
+    finally:
+        os.unlink(path)
+
+
+def bench_decode_step():
+    import jax
+    import jax.numpy as jnp
+
+    from cake_tpu.models import TextModel, tiny_config
+    from cake_tpu.ops.sampling import SamplingConfig
+    m = TextModel(tiny_config("qwen3"), dtype=jnp.float32, max_cache_len=128)
+    m.generate([1, 2, 3], max_new_tokens=8, chunk=8,
+               sampling=SamplingConfig())          # compile
+    dt = timeit(lambda: m.generate([1, 2, 3], max_new_tokens=32, chunk=32,
+                                   sampling=SamplingConfig()),
+                warmup=1, iters=5)
+    return {"tiny_tok_per_s": round(32 / dt, 1)}
+
+
+def bench_sampling():
+    import jax
+    import jax.numpy as jnp
+
+    from cake_tpu.ops.sampling import SamplingConfig, sample
+    logits = jax.random.normal(jax.random.PRNGKey(0), (151936,))
+    cfg = SamplingConfig(temperature=0.8, top_k=40, top_p=0.9,
+                         repeat_penalty=1.1)
+    recent = jnp.full((64,), -1, jnp.int32)
+    fn = jax.jit(lambda l, k: sample(l, k, cfg, recent))
+    k = jax.random.PRNGKey(1)
+    fn(logits, k).block_until_ready()
+    dt = timeit(lambda: fn(logits, k).block_until_ready())
+    return {"us": round(dt * 1e6, 1)}
+
+
+def bench_gguf_dequant():
+    from cake_tpu.utils.gguf import dequant_q4_k
+    raw = np.random.default_rng(0).integers(
+        0, 256, 144 * 4096, dtype=np.uint32).astype(np.uint8).tobytes()
+    n = 256 * 4096
+    dt = timeit(lambda: dequant_q4_k(raw, n))
+    return {"m_weights_per_s": round(n / dt / 1e6, 1)}
+
+
+BENCHES = {
+    "crc32": bench_crc32,
+    "frame_roundtrip": bench_frame_roundtrip,
+    "auth_handshake": bench_auth,
+    "pread_32mb": bench_pread,
+    "decode_tiny": bench_decode_step,
+    "sampling_151k_vocab": bench_sampling,
+    "gguf_q4k_dequant": bench_gguf_dequant,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filter", default="")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (e.g. TPU busy)")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    for name, fn in BENCHES.items():
+        if args.filter and args.filter not in name:
+            continue
+        try:
+            out = fn()
+        except Exception as e:  # keep the suite running
+            out = {"error": str(e)[:120]}
+        print(json.dumps({"bench": name, **out}))
+
+
+if __name__ == "__main__":
+    main()

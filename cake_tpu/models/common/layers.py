@@ -162,7 +162,8 @@ def make_rope(cfg: ModelConfig) -> dict:
 
 
 def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
-                      layer_cache: dict, pos0, rope: dict, valid_len=None):
+                      layer_cache: dict, pos0, rope: dict, valid_len=None,
+                      fresh: bool = False):
     """x: [B, S, H], pos0: traced scalar (first absolute position).
     Returns (y [B, S, H], new_layer_cache)."""
     b, s, _ = x.shape
@@ -207,18 +208,31 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
     kv_pos_new = positions if valid_len is None else jnp.where(
         idx < valid_len, positions, -1)                    # pads invisible
     kv_pos_new = jnp.broadcast_to(kv_pos_new[None, :], (b, s))
-    if layer_cache is None:
+    from ...ops.flash import FLASH_MIN_SEQ, flash_attention, flash_enabled
+    use_flash = (fresh and spec.window is None and s >= FLASH_MIN_SEQ
+                 and s % 128 == 0 and flash_enabled())
+    if use_flash:
+        # fresh-cache prefill: nothing in the cache is visible yet, so
+        # causal flash over the in-pass K/V is exact (Pallas kernel; ref:
+        # flash-attn dispatch attention.rs:270-277). Inference-only — the
+        # kernel has no VJP; `fresh` is never set on the training path.
+        y = flash_attention(q, k, v, scale=cfg.attn_scale, valid_len=valid_len)
+        new_cache = (update_kv_cache(layer_cache, k, v, pos0, valid_len)
+                     if layer_cache is not None else None)
+        kv_pos = k_all = v_all = None
+    elif layer_cache is None:
         kv_pos, k_all, v_all = kv_pos_new, k, v
         new_cache = None
     else:
         kv_pos = jnp.concatenate([layer_cache["pos"], kv_pos_new], axis=1)
         k_all = jnp.concatenate([layer_cache["k"], k], axis=1)
         v_all = jnp.concatenate([layer_cache["v"], v], axis=1)
-    q_pos = jnp.broadcast_to(positions[None, :], (b, s))
-    mask = make_attention_mask(q_pos, kv_pos, window=spec.window)
-    y = multi_head_attention(q, k_all, v_all, mask, scale=cfg.attn_scale)
-    if layer_cache is not None:
-        new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
+    if not use_flash:
+        q_pos = jnp.broadcast_to(positions[None, :], (b, s))
+        mask = make_attention_mask(q_pos, kv_pos, window=spec.window)
+        y = multi_head_attention(q, k_all, v_all, mask, scale=cfg.attn_scale)
+        if layer_cache is not None:
+            new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
     y = y.reshape(b, s, sq)
     if gate is not None:
         y = y * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(y.dtype)
@@ -257,34 +271,36 @@ def _ffn(cfg, spec, p, x):
         else mlp_forward(cfg, p["mlp"], x)
 
 
-def _attn(cfg, spec, p, x, lc, pos0, rope, valid_len=None):
+def _attn(cfg, spec, p, x, lc, pos0, rope, valid_len=None,
+          fresh=False):
     if spec.kind == "linear":
         from ..qwen3_5 import gdn_forward
         return gdn_forward(cfg, p["linear_attn"], x, lc, pos0, valid_len)
     return attention_forward(cfg, spec, p["self_attn"], x, lc, pos0, rope,
-                             valid_len)
+                             valid_len, fresh)
 
 
 def block_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
-                  layer_cache: dict, pos0, rope: dict, valid_len=None):
+                  layer_cache: dict, pos0, rope: dict, valid_len=None,
+                  fresh: bool = False):
     """One decoder block; norm placement per family
     (ref: common/transformer.rs pre-norm; olmo2/block.rs post-norm;
     gemma3/block.rs sandwich)."""
     eps = cfg.rms_norm_eps
     if spec.norm_style == "pre":
         h = rms_norm(x, p["input_layernorm"]["weight"], eps)
-        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, fresh)
         x = x + attn_out
         h = rms_norm(x, p["post_attention_layernorm"]["weight"], eps)
         x = x + _ffn(cfg, spec, p, h)
     elif spec.norm_style == "post":
-        attn_out, layer_cache = _attn(cfg, spec, p, x, layer_cache, pos0, rope, valid_len)
+        attn_out, layer_cache = _attn(cfg, spec, p, x, layer_cache, pos0, rope, valid_len, fresh)
         x = x + rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
         x = x + rms_norm(_ffn(cfg, spec, p, x),
                          p["post_feedforward_layernorm"]["weight"], eps)
     elif spec.norm_style == "sandwich":
         h = rms_norm(x, p["input_layernorm"]["weight"], eps)
-        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, fresh)
         attn_out = rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
         x = x + attn_out
         h = rms_norm(x, p["pre_feedforward_layernorm"]["weight"], eps)
@@ -297,7 +313,8 @@ def block_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
 
 
 def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
-                   layer_range: tuple[int, int] | None = None, valid_len=None):
+                   layer_range: tuple[int, int] | None = None, valid_len=None,
+                   fresh: bool = False):
     """Run a contiguous range of blocks over hidden states — the jit unit for
     both local stages and remote workers (ref: Forwarder.forward_batch /
     worker.rs op-batch execution, but compiled as ONE device program)."""
@@ -313,7 +330,7 @@ def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
     for j, spec in enumerate(specs):
         x, new_layers[j] = block_forward(cfg, spec, params["layers"][j], x,
                                          cache["layers"][j], pos0, rope,
-                                         valid_len)
+                                         valid_len, fresh)
     advance = x.shape[1] if valid_len is None else valid_len
     new_cache = {"layers": new_layers, "pos": pos0 + advance}
     return x, new_cache

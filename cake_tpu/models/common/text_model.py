@@ -94,11 +94,12 @@ class TextModel:
     def _build(self):
         cfg = self.cfg
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
-        def _prefill(params, tokens, cache, pos0, valid_len):
+        @functools.partial(jax.jit, donate_argnums=(2,),
+                           static_argnames=("fresh",))
+        def _prefill(params, tokens, cache, pos0, valid_len, fresh):
             x = embed_tokens(cfg, params, tokens)
             x, cache = forward_layers(cfg, params, x, cache, pos0,
-                                      valid_len=valid_len)
+                                      valid_len=valid_len, fresh=fresh)
             # logits at the last valid position
             idx = jnp.clip(valid_len - 1, 0, x.shape[1] - 1)
             x_last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
@@ -158,7 +159,8 @@ class TextModel:
         padded[0, :n] = ids
         logits, cache = self._prefill(self.params, jnp.asarray(padded), cache,
                                       jnp.asarray(pos0, jnp.int32),
-                                      jnp.asarray(n, jnp.int32))
+                                      jnp.asarray(n, jnp.int32),
+                                      fresh=(pos0 == 0))
         return logits, cache
 
     def decode_logits(self, cache, token_id: int):

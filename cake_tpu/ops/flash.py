@@ -1,0 +1,138 @@
+"""Pallas flash attention for TPU (prefill path).
+
+The reference reaches flash-attention through candle-flash-attn on CUDA
+(ref: utils/flash_attn.rs, attention.rs:270-277). On TPU the equivalent is
+a Pallas kernel: blockwise Q x K^T with the online-softmax accumulator so
+the [S, S] score matrix never leaves VMEM tiles (same algebra as
+parallel/ring_attention.py, scheduled on one chip).
+
+Layout: q/k/v in [B, S, H, D] (the framework-wide activation layout); the
+kernel grid is (batch*q_heads, q_blocks) with the K loop inside, GQA via
+q_head -> kv_head integer division. Causal masking by absolute block
+bounds; optional valid_len clamps padded prefill tails.
+
+Dispatched from the serving prefill when the cache is FRESH (pos0 == 0 —
+a host-static property, threaded as the `fresh` flag through
+forward_layers) and seq_len >= FLASH_MIN_SEQ on TPU. The XLA einsum path
+remains the fallback (and the CPU/test path — interpret mode validates the
+kernel without hardware). Inference-only: no custom VJP is defined, so the
+differentiable training path never dispatches here.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+FLASH_MIN_SEQ = 256
+NEG_INF = -1e30
+
+
+def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_k,
+                  kv_len, causal):
+    """One (batch*head, q_block) program: loop K blocks with online softmax.
+
+    vl_ref: (1, 1) SMEM valid-length scalar (dynamic — padded prefill);
+    q_ref: [block_q, D]; k_ref/v_ref: [kv_len, D]; o_ref: [block_q, D].
+    """
+    block_q, d = q_ref.shape
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    limit = vl_ref[0, 0]
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k_start = ki * block_k
+        k_blk = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+        mask = k_pos < limit
+        if causal:
+            mask &= k_pos <= q_pos
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        acc = acc * alpha[:, None] + jnp.dot(p, v_blk,
+                                             preferred_element_type=jnp.float32)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        return acc, m_new, l
+
+    if causal:
+        # skip K blocks entirely above the causal diagonal
+        n_k = (q_start + block_q + block_k - 1) // block_k
+    else:
+        n_k = kv_len // block_k
+    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc, m, l))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
+                    valid_len=None, block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
+    """q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] (Hq multiple of Hkv).
+
+    Returns [B, S, Hq, D]. S must be a multiple of block sizes (the caller
+    pads — bucketed prefill already guarantees power-of-two lengths).
+    valid_len: int or traced scalar bounding valid keys (padded prefill
+    tails); None means all S keys are valid.
+    """
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (s, block_q, block_k)
+
+    # [B, S, H, D] -> [B*H, S, D] with GQA expansion folded into indexing
+    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+
+    vl = jnp.asarray(s if valid_len is None else valid_len,
+                     jnp.int32).reshape(1, 1)
+    kernel = functools.partial(_flash_kernel, scale=scale, block_k=block_k,
+                               kv_len=s, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * hq, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda h, i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, block_q, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, s, d), lambda h, i: (h // g, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda h, i: (h // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda h, i: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
+        interpret=interpret,
+    )(vl, qt, kt, vt)
+    return out.reshape(b, hq, s, d).transpose(0, 2, 1, 3)
+
+
+def flash_enabled() -> bool:
+    """Flash prefill opt-in: on for TPU backends unless CAKE_TPU_FLASH=0."""
+    if os.environ.get("CAKE_TPU_FLASH") == "0":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

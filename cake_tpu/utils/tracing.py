@@ -1,0 +1,68 @@
+"""Tracing / profiling utilities.
+
+Reference parity (SURVEY §5): (a) Chrome-trace export — here the JAX
+profiler, whose traces open in Perfetto/TensorBoard (ref: tracing-chrome
+behind --sd-tracing); (b) pervasive phase timing at debug level (ref:
+text_model.rs:357-365 per-token breakdown, worker.rs:533-543 per-message
+read/load/fwd/ser/write).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+log = logging.getLogger("cake_tpu.trace")
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: str | None):
+    """Wrap a region in a JAX profiler trace (xprof / Perfetto viewable).
+    No-op when log_dir is None."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", log_dir)
+
+
+class PhaseTimer:
+    """Accumulating phase timer for hot loops.
+
+        t = PhaseTimer()
+        with t("embed"): ...
+        with t("layers"): ...
+        log.debug("%s", t)          # embed=0.2ms layers=8.1ms
+    """
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+
+    def __str__(self):
+        return " ".join(f"{k}={v * 1000:.1f}ms" for k, v in self.totals.items())
+
+    def report(self) -> dict[str, dict]:
+        return {k: {"total_ms": round(v * 1000, 3),
+                    "count": self.counts[k],
+                    "avg_ms": round(v * 1000 / max(self.counts[k], 1), 3)}
+                for k, v in self.totals.items()}

@@ -1,0 +1,85 @@
+"""Pallas flash attention vs the XLA reference path (interpret mode on the
+CPU mesh — the kernel's compiled path needs real TPU hardware)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.ops.attention import causal_sdpa, make_attention_mask, \
+    multi_head_attention
+from cake_tpu.ops.flash import flash_attention
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+def test_flash_matches_xla_causal(rng, hq, hkv):
+    b, s, d = 2, 256, 32
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    want = causal_sdpa(q, k, v)
+    got = flash_attention(q, k, v, interpret=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_flash_non_causal(rng):
+    b, s, h, d = 1, 128, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    got = flash_attention(q, k, v, causal=False, interpret=True,
+                          block_q=64, block_k=64)
+    want = multi_head_attention(q, k, v, mask=None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_flash_serving_prefill_parity(rng, monkeypatch):
+    """The real serving path: fresh-cache prefill through TextModel must
+    dispatch the kernel and match the mask path, including the cache it
+    leaves behind for decode."""
+    import cake_tpu.ops.flash as fl
+    from cake_tpu.models import TextModel, tiny_config
+
+    calls = []
+    orig = fl.flash_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        k["interpret"] = True               # CPU test: interpret the kernel
+        return orig(*a, **k)
+
+    monkeypatch.setattr(fl, "flash_enabled", lambda: True)
+    monkeypatch.setattr(fl, "FLASH_MIN_SEQ", 64)
+    monkeypatch.setattr(fl, "flash_attention", spy)
+
+    cfg = tiny_config("qwen3", max_position_embeddings=256)
+    toks = list(np.random.default_rng(0).integers(0, 255, 100))  # bucket 128
+    m = TextModel(cfg, dtype=jnp.float32, max_cache_len=160)
+    l_flash, cache = m.prefill(m.new_cache(), toks)
+    assert len(calls) == cfg.num_hidden_layers
+
+    monkeypatch.setattr(fl, "flash_enabled", lambda: False)
+    m2 = TextModel(cfg, dtype=jnp.float32, max_cache_len=160)
+    l_mask, cache2 = m2.prefill(m2.new_cache(), toks)
+    np.testing.assert_allclose(np.asarray(l_flash), np.asarray(l_mask),
+                               atol=1e-5)
+    d1, _ = m.decode_logits(cache, 7)
+    d2, _ = m2.decode_logits(cache2, 7)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1e-5)
+
+
+def test_flash_valid_len_masks_padding(rng):
+    """Keys past valid_len must be invisible, like the position-mask path."""
+    b, s, h, d = 1, 128, 2, 16
+    vl = 70
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    got = flash_attention(q, k, v, valid_len=vl, interpret=True,
+                          block_q=64, block_k=64)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    kpos = jnp.where(jnp.arange(s) < vl, jnp.arange(s), -1)[None]
+    mask = make_attention_mask(pos, jnp.broadcast_to(kpos, (b, s)))
+    want = multi_head_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got)[:, :vl], np.asarray(want)[:, :vl],
+                               atol=2e-4, rtol=1e-3)

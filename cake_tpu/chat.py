@@ -38,15 +38,34 @@ def chat_local(gen, model_id: str, sampling, max_tokens: int) -> int:
         history.append({"role": "assistant", "content": "".join(parts)})
 
 
-def chat_remote(api_url: str, api_key: str | None = None) -> int:
-    """SSE client against any OpenAI-compatible endpoint."""
+def stream_chat_sse(api_url: str, messages: list[dict],
+                    api_key: str | None = None):
+    """Shared OpenAI-SSE client: yields content deltas (used by the REPL
+    and the TUI — one copy of the wire parsing)."""
     import requests
 
     url = api_url.rstrip("/") + "/v1/chat/completions"
     headers = {"Content-Type": "application/json"}
     if api_key:
         headers["Authorization"] = f"Bearer {api_key}"
-    print(f"chat via {url} — /quit to exit, /reset to clear history")
+    with requests.post(url, headers=headers, stream=True, timeout=600,
+                       json={"messages": messages, "stream": True}) as r:
+        r.raise_for_status()
+        for raw in r.iter_lines():
+            if not raw or not raw.startswith(b"data: "):
+                continue
+            data = raw[6:]
+            if data == b"[DONE]":
+                return
+            delta = json.loads(data)["choices"][0]["delta"]
+            if delta.get("content"):
+                yield delta["content"]
+
+
+def chat_remote(api_url: str, api_key: str | None = None) -> int:
+    """SSE REPL against any OpenAI-compatible endpoint."""
+    import requests
+    print(f"chat via {api_url} — /quit to exit, /reset to clear history")
     history: list[dict] = []
     while True:
         try:
@@ -63,21 +82,13 @@ def chat_remote(api_url: str, api_key: str | None = None) -> int:
             continue
         history.append({"role": "user", "content": line})
         parts: list[str] = []
-        with requests.post(url, headers=headers, stream=True, timeout=600,
-                           json={"messages": history, "stream": True}) as r:
-            if r.status_code != 200:
-                print(f"error {r.status_code}: {r.text}", file=sys.stderr)
-                history.pop()
-                continue
-            for raw in r.iter_lines():
-                if not raw or not raw.startswith(b"data: "):
-                    continue
-                data = raw[6:]
-                if data == b"[DONE]":
-                    break
-                delta = json.loads(data)["choices"][0]["delta"]
-                if delta.get("content"):
-                    parts.append(delta["content"])
-                    print(delta["content"], end="", flush=True)
+        try:
+            for piece in stream_chat_sse(api_url, history, api_key):
+                parts.append(piece)
+                print(piece, end="", flush=True)
+        except requests.HTTPError as e:
+            print(f"error: {e}", file=sys.stderr)
+            history.pop()
+            continue
         print()
         history.append({"role": "assistant", "content": "".join(parts)})

@@ -156,6 +156,16 @@ def cmd_split(args) -> int:
 
 
 def cmd_chat(args) -> int:
+    if args.tui:
+        from .tui import ChatSession, run_tui
+        if args.api:
+            session = ChatSession(api_url=args.api, api_key=args.api_key)
+        else:
+            gen, tokenizer, model_id, _ = _build(args)
+            session = ChatSession(gen=gen, sampling=_sampling(args),
+                                  max_tokens=args.max_tokens,
+                                  model_id=model_id)
+        return run_tui(session)
     from .chat import chat_local, chat_remote
     if args.api:
         return chat_remote(args.api, args.api_key)
@@ -221,6 +231,8 @@ def main(argv=None) -> int:
     p.add_argument("--api", default=None,
                    help="chat against a remote cake-tpu API URL instead")
     p.add_argument("--api-key", default=None)
+    p.add_argument("--tui", action="store_true",
+                   help="full-screen 2-tab interface (Chat + Cluster)")
     p.set_defaults(fn=cmd_chat)
 
     args = ap.parse_args(argv)

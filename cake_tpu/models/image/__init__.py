@@ -3,3 +3,5 @@ from .flux import (COMPONENT_NAMES, DummyTextEncoder, FluxImageModel,
 from .mmdit import MMDiTConfig, init_mmdit_params, mmdit_forward
 from .vae import (VaeConfig, init_vae_decoder_params, latents_to_patches,
                   patches_to_latents, vae_decode)
+from .sd import (SDImageModel, SDPipelineConfig, UNetConfig,
+                 init_unet_params, tiny_sd_config, unet_forward)

@@ -71,10 +71,12 @@ class DpmSolverPP:
         return np.linspace(self.T - 1, 0, steps).round().astype(np.int64)
 
     def _coeffs(self, t: int):
+        # python floats throughout: np.float64 scalars would promote bf16
+        # latents to f32 mid-loop (same hazard as the flux euler step)
         a = float(self.alphas_cumprod[t])
-        alpha_t = a ** 0.5
-        sigma_t = (1.0 - a) ** 0.5
-        lam = np.log(alpha_t) - np.log(sigma_t)
+        alpha_t = float(a ** 0.5)
+        sigma_t = float((1.0 - a) ** 0.5)
+        lam = float(np.log(alpha_t) - np.log(sigma_t))
         return alpha_t, sigma_t, lam
 
     def _to_x0(self, model_out, x, t: int):
@@ -94,7 +96,7 @@ class DpmSolverPP:
         else:
             alpha_t, sigma_t, lam_t = self._coeffs(t_next)
             h = lam_t - lam_s
-            r = jnp.exp(-h)
+            r = float(np.exp(-h))
             if self._last_x0 is None:
                 d = x0
             else:

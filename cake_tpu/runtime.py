@@ -98,7 +98,10 @@ def build_image_model(model: str, dtype: str = "bf16"):
     pipeline on random weights (zero-egress environments); checkpoint
     weight-name mapping for FLUX.1/2 release checkpoints is tracked for the
     next round."""
-    from .models.image import FluxImageModel, tiny_flux_config
+    from .models.image import (FluxImageModel, SDImageModel, tiny_flux_config,
+                               tiny_sd_config)
+    if model == "demo:sd":
+        return SDImageModel(tiny_sd_config(), dtype=parse_dtype(dtype))
     if model.startswith("demo:"):
         return FluxImageModel(tiny_flux_config(), dtype=parse_dtype(dtype))
     raise NotImplementedError(

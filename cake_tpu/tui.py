@@ -1,0 +1,202 @@
+"""Full-screen terminal chat client: two tabs — Chat and Cluster — over a
+local model or a remote cake-tpu API (ref: cake-cli/src/chat.rs — the
+ratatui 2-tab TUI with an SSE client and the Cluster topology view).
+
+curses-based; generation runs on a worker thread feeding a token queue so
+the UI stays responsive while the model decodes.
+"""
+from __future__ import annotations
+
+import curses
+import queue
+import threading
+
+
+class ChatSession:
+    """Transport-agnostic chat state: local generator or remote SSE API."""
+
+    def __init__(self, gen=None, api_url: str | None = None,
+                 api_key: str | None = None, sampling=None,
+                 max_tokens: int = 256, model_id: str = "model"):
+        self.gen = gen
+        self.api_url = api_url
+        self.api_key = api_key
+        self.sampling = sampling
+        self.max_tokens = max_tokens
+        self.model_id = model_id
+        self.history: list[dict] = []
+        self.tokens: queue.Queue = queue.Queue()
+        self.busy = False
+        self.last_stats: dict = {}
+
+    def send(self, text: str):
+        self.history.append({"role": "user", "content": text})
+        self.busy = True
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        parts: list[str] = []
+        try:
+            if self.api_url:
+                from .chat import stream_chat_sse
+                for piece in stream_chat_sse(self.api_url, self.history,
+                                             self.api_key):
+                    parts.append(piece)
+                    self.tokens.put(piece)
+            else:
+                def on_token(tok):
+                    if tok.text and not tok.is_end_of_stream:
+                        parts.append(tok.text)
+                        self.tokens.put(tok.text)
+                _, self.last_stats = self.gen.chat_generate(
+                    self.history, max_new_tokens=self.max_tokens,
+                    sampling=self.sampling, on_token=on_token)
+        except Exception as e:
+            # keep the error in the transcript so the redraw shows it
+            parts.append(f"[error: {e}]")
+        finally:
+            self.history.append({"role": "assistant",
+                                 "content": "".join(parts)})
+            self.tokens.put(None)        # end-of-reply sentinel
+            self.busy = False
+
+    def topology(self) -> dict:
+        if self.api_url:
+            try:
+                import requests
+                return requests.get(self.api_url.rstrip("/")
+                                    + "/api/v1/topology", timeout=5).json()
+            except Exception as e:
+                return {"error": str(e)}
+        info = {"master": {"model": self.model_id}}
+        if self.gen is not None and hasattr(self.gen, "cfg"):
+            cfg = self.gen.cfg
+            info["master"].update({"arch": cfg.arch,
+                                   "num_layers": cfg.num_hidden_layers,
+                                   "hidden_size": cfg.hidden_size})
+            stages = getattr(self.gen, "stages", None)
+            if stages:
+                info["nodes"] = {
+                    f"stage-{i}": {"kind": s.kind,
+                                   "layers": f"{s.start}-{s.end - 1}"}
+                    for i, s in enumerate(stages)}
+        return info
+
+
+def run_tui(session: ChatSession) -> int:
+    return curses.wrapper(_main, session)
+
+
+def _main(stdscr, s: ChatSession) -> int:
+    curses.curs_set(1)
+    stdscr.nodelay(True)
+    stdscr.timeout(50)
+    tab = 0                      # 0 = Chat, 1 = Cluster
+    input_buf = ""
+    stream_buf = ""
+    streaming = False
+
+    while True:
+        # drain streamed tokens
+        try:
+            while True:
+                piece = s.tokens.get_nowait()
+                if piece is None:
+                    streaming = False
+                    stream_buf = ""
+                else:
+                    streaming = True
+                    stream_buf += piece
+        except queue.Empty:
+            pass
+
+        h, w = stdscr.getmaxyx()
+        stdscr.erase()
+        tabs = "[Chat] Cluster" if tab == 0 else " Chat [Cluster]"
+        header = f" cake-tpu — {tabs}   (Tab switches, Ctrl-C quits) "
+        stdscr.addnstr(0, 0, header.ljust(w), w - 1, curses.A_REVERSE)
+
+        if tab == 0:
+            _draw_chat(stdscr, s, stream_buf, streaming, input_buf, h, w)
+        else:
+            _draw_cluster(stdscr, s, h, w)
+        stdscr.refresh()
+
+        try:
+            ch = stdscr.getch()
+        except KeyboardInterrupt:
+            return 0
+        if ch == -1:
+            continue
+        if ch == 9:                               # Tab
+            tab = 1 - tab
+        elif ch in (3, 17):                       # Ctrl-C / Ctrl-Q
+            return 0
+        elif tab == 0:
+            if ch in (10, 13):                    # Enter
+                text = input_buf.strip()
+                input_buf = ""
+                if text and not s.busy:
+                    s.send(text)
+            elif ch in (curses.KEY_BACKSPACE, 127, 8):
+                input_buf = input_buf[:-1]
+            elif 32 <= ch < 127:
+                input_buf += chr(ch)
+
+
+def _wrap(text: str, width: int) -> list[str]:
+    out = []
+    for para in text.split("\n"):
+        while len(para) > width:
+            out.append(para[:width])
+            para = para[width:]
+        out.append(para)
+    return out
+
+
+def _draw_chat(stdscr, s: ChatSession, stream_buf, streaming, input_buf, h, w):
+    lines: list[tuple[str, int]] = []
+    for m in s.history:
+        who = "you" if m["role"] == "user" else "ai"
+        attr = curses.A_BOLD if who == "you" else curses.A_NORMAL
+        for ln in _wrap(f"{who}> {m['content']}", w - 2):
+            lines.append((ln, attr))
+        lines.append(("", 0))
+    if streaming:
+        for ln in _wrap(f"ai> {stream_buf}▌", w - 2):
+            lines.append((ln, curses.A_DIM))
+    view = lines[-(h - 4):]
+    for i, (ln, attr) in enumerate(view):
+        stdscr.addnstr(1 + i, 1, ln, w - 2, attr)
+    stats = s.last_stats
+    status = (f" {stats.get('tok_per_s', 0):.1f} tok/s "
+              if stats else " ready ") if not s.busy else " generating… "
+    stdscr.addnstr(h - 2, 0, status.ljust(w), w - 1, curses.A_REVERSE)
+    prompt = f"> {input_buf}"
+    stdscr.addnstr(h - 1, 0, prompt, w - 1)
+    stdscr.move(h - 1, min(len(prompt), w - 2))
+
+
+def _draw_cluster(stdscr, s: ChatSession, h, w):
+    topo = s.topology()
+    row = 2
+    m = topo.get("master", {})
+    stdscr.addnstr(row, 2, f"master: {m.get('model', '?')}  "
+                           f"{m.get('arch', '')}  "
+                           f"layers={m.get('num_layers', '?')}", w - 4,
+                   curses.A_BOLD)
+    row += 2
+    nodes = topo.get("nodes", {})
+    if not nodes:
+        stdscr.addnstr(row, 2, "(no remote workers — all layers local)", w - 4)
+    for name, n in nodes.items():
+        desc = ", ".join(f"{k}={v}" for k, v in n.items()
+                         if k in ("kind", "layers", "layer_range", "backend",
+                                  "tflops", "host"))
+        stdscr.addnstr(row, 2, f"{name}: {desc}", w - 4)
+        row += 1
+        if row >= h - 2:
+            break
+    if "error" in topo:
+        stdscr.addnstr(row + 1, 2, f"topology error: {topo['error']}", w - 4,
+                       curses.A_DIM)

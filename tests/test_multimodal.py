@@ -57,10 +57,7 @@ def test_dpm_solver_denoises_toward_x0():
         alpha_t, sigma_t = a ** 0.5, (1 - a) ** 0.5
         # true eps for current x given x0: eps_t = (x - alpha*x0)/sigma
         eps_t = (x - alpha_t * x0) / max(sigma_t, 1e-8)
-        v_true = alpha_t * eps_t - 0.0 * x0 + 0.0  # placeholder
-        v_true = alpha_t * eps_t - sigma_t * 0     # v = alpha*eps - sigma*x0?
         # v-parameterization: v = alpha_t * eps - sigma_t * x0
-        v_true = alpha_t * eps_t - sigma_t * x0
         v_true = alpha_t * eps_t - sigma_t * x0
         t_next = int(ts[j + 1]) if j + 1 < len(ts) else 0
         x = sch.step(v_true, int(t), t_next, x)
@@ -175,3 +172,66 @@ def test_wav_roundtrip(rng):
     back, rate = decode_wav(wav)
     assert rate == 16000
     np.testing.assert_allclose(back, s, atol=1e-4)
+
+
+# ------------------------------------------------------------------- sd
+
+def test_sd_unet_shapes_and_conditioning(rng):
+    from cake_tpu.models.image.sd import (init_unet_params, tiny_sd_config,
+                                          unet_forward)
+    cfg = tiny_sd_config().unet
+    p = init_unet_params(cfg, jax.random.PRNGKey(0))
+    x = jnp.asarray(rng.standard_normal((1, 4, 16, 16)), jnp.float32)
+    ctx = jnp.asarray(rng.standard_normal((1, 8, cfg.context_dim)), jnp.float32)
+    t = jnp.asarray([0.5], jnp.float32)
+    e1 = unet_forward(cfg, p, x, t, ctx)
+    assert e1.shape == x.shape and bool(jnp.all(jnp.isfinite(e1)))
+    ctx2 = jnp.asarray(rng.standard_normal((1, 8, cfg.context_dim)), jnp.float32)
+    e2 = unet_forward(cfg, p, x, t, ctx2)
+    assert not np.allclose(np.asarray(e1), np.asarray(e2), atol=1e-5)
+    e3 = unet_forward(cfg, p, x, jnp.asarray([0.9], jnp.float32), ctx)
+    assert not np.allclose(np.asarray(e1), np.asarray(e3), atol=1e-5)
+
+
+def test_sd_generate_and_img2img():
+    from cake_tpu.models.image.sd import SDImageModel, tiny_sd_config
+    model = SDImageModel(tiny_sd_config())
+    img = model.generate_image("a fox", width=32, height=32, steps=3, seed=4)
+    assert img.size == (32, 32)
+    img_b = model.generate_image("a fox", width=32, height=32, steps=3, seed=4)
+    np.testing.assert_array_equal(np.asarray(img), np.asarray(img_b))
+    # negative prompt changes the output (CFG path)
+    img_n = model.generate_image("a fox", width=32, height=32, steps=3, seed=4,
+                                 negative_prompt="blurry")
+    assert not np.array_equal(np.asarray(img), np.asarray(img_n))
+    # img2img from a given latent differs from txt2img
+    z0 = np.random.default_rng(0).standard_normal((1, 4, 16, 16)).astype("f")
+    img_i = model.generate_image("a fox", width=32, height=32, steps=4, seed=4,
+                                 init_image=z0, strength=0.5)
+    assert not np.array_equal(np.asarray(img), np.asarray(img_i))
+
+
+def test_pipelines_run_in_bf16():
+    """serve default dtype: the whole image path must not promote to f32
+    (regression: np-scalar coefficients promoted bf16 latents)."""
+    from cake_tpu.models.image import FluxImageModel, tiny_flux_config
+    from cake_tpu.models.image.sd import SDImageModel, tiny_sd_config
+    img = FluxImageModel(tiny_flux_config(), dtype=jnp.bfloat16).generate_image(
+        "x", width=32, height=32, steps=2)
+    assert img.size == (32, 32)
+    img2 = SDImageModel(tiny_sd_config(), dtype=jnp.bfloat16).generate_image(
+        "x", width=32, height=32, steps=2)
+    assert img2.size == (32, 32)
+    # the actual promotion guard: scheduler steps must PRESERVE bf16
+    from cake_tpu.ops.diffusion import (DpmSolverPP,
+                                        flow_matching_euler_step,
+                                        flow_matching_schedule)
+    x = jnp.ones((2, 4), jnp.bfloat16)
+    sch = DpmSolverPP.from_betas(prediction_type="epsilon")
+    ts = sch.timesteps(4)
+    out = sch.step(jnp.zeros_like(x), int(ts[0]), int(ts[1]), x)
+    assert out.dtype == jnp.bfloat16
+    fm = flow_matching_schedule(4)
+    out2 = flow_matching_euler_step(x, jnp.zeros_like(x),
+                                    float(fm[0]), float(fm[1]))
+    assert out2.dtype == jnp.bfloat16

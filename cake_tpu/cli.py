@@ -29,6 +29,9 @@ def _add_common_model_args(p: argparse.ArgumentParser):
                    help="enable distributed mode (env: CAKE_CLUSTER_KEY)")
     p.add_argument("--topology", default=None, help="topology YAML path")
     p.add_argument("--no-download", action="store_true")
+    p.add_argument("--fp8-native", action="store_true",
+                   help="keep FP8 weights 1 byte/param in HBM, dequant "
+                        "per layer (FP8 checkpoints only)")
 
 
 def _add_sampling_args(p: argparse.ArgumentParser):
@@ -52,7 +55,8 @@ def _build(args):
         args.model, dtype=args.dtype, arch=args.arch,
         max_cache_len=args.max_cache_len, seed=args.seed,
         cluster_key=args.cluster_key, topology_path=args.topology,
-        download=not args.no_download)
+        download=not args.no_download,
+        fp8_native=getattr(args, "fp8_native", False))
 
 
 def cmd_run(args) -> int:

@@ -156,7 +156,7 @@ def load_gdn_params(loader, lp: str):
     cfg = loader.cfg
     la, key_dim, value_dim, conv_dim, total = _dims(cfg)
     base = f"{lp}.linear_attn"
-    g = loader._get
+    g = loader._get_dense      # concat/transpose below need dense arrays
     if loader._has(f"{base}.in_proj.weight"):
         in_proj = g(f"{base}.in_proj.weight")
     else:

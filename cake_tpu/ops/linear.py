@@ -9,8 +9,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def resolve_weight(weight, dtype=None):
+    """Materialize an fp8-native weight dict ({"fp8", "scale_inv"}) to the
+    compute dtype inside the jitted forward; plain arrays pass through.
+    XLA fuses the dequant into the consuming matmul, so HBM holds 1
+    byte/param (ref: native_dtype_backend.rs)."""
+    if isinstance(weight, dict) and "fp8" in weight:
+        from .fp8 import dequant_fp8_blockwise
+        return dequant_fp8_blockwise(weight["fp8"], weight["scale_inv"],
+                                     out_dtype=dtype or jnp.bfloat16)
+    return weight
+
+
 def linear(x, weight, bias=None):
-    """y = x @ W^T (+ b). x: [..., in], weight: [out, in]."""
+    """y = x @ W^T (+ b). x: [..., in], weight: [out, in] (or an fp8-native
+    dict, dequantized on the fly)."""
+    weight = resolve_weight(weight, x.dtype)
     y = jnp.einsum("...i,oi->...o", x, weight)
     if bias is not None:
         y = y + bias

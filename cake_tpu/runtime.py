@@ -128,7 +128,7 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                      cluster_key: str | None = None,
                      topology_path: str | None = None,
                      discovery_timeout: float = 3.0,
-                     download: bool = True):
+                     download: bool = True, fp8_native: bool = False):
     """Returns (generator, tokenizer, model_id, topology|None).
 
     With a cluster key: discover workers (or use the topology file), run
@@ -138,6 +138,12 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
     """
     model_dir = resolve_model(model, download=download)
     cfg, quant, raw = load_config_and_quant(model_dir, arch)
+    if fp8_native:
+        from .utils.quant import Fp8Quantization
+        if not isinstance(quant, Fp8Quantization):
+            raise ValueError("--fp8-native requires an FP8 checkpoint "
+                             f"(detected quantization: {quant.name})")
+        quant = Fp8Quantization(keep_native=True)
     dt = parse_dtype(dtype)
     tokenizer = CakeTokenizer(model_dir)
     model_id = os.path.basename(model.rstrip("/"))
@@ -160,6 +166,10 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
             log.warning("no workers found; running all-local")
 
     if cluster_key and workers:
+        if fp8_native:
+            raise NotImplementedError(
+                "--fp8-native is not yet plumbed through cluster weight "
+                "streaming; run without it in distributed mode")
         from .cluster.master import DistributedTextModel, master_setup
         assignments = None
         if topology_path:

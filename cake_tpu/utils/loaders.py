@@ -20,7 +20,12 @@ from .quant import NoQuantization
 from .safetensors_io import TensorStorage
 
 
-def _to_dev(arr: np.ndarray, dtype):
+def _to_dev(arr, dtype):
+    if isinstance(arr, dict) and "__fp8__" in arr:
+        # native-dtype FP8: weight stays 1 byte/param in HBM; the forward
+        # dequantizes per layer (ref: utils/native_dtype_backend.rs)
+        return {"fp8": jnp.asarray(arr["__fp8__"]),
+                "scale_inv": jnp.asarray(arr["scale_inv"])}
     return jnp.asarray(arr).astype(dtype)
 
 
@@ -35,8 +40,21 @@ class ParamLoader:
 
     # -- helpers ------------------------------------------------------------
 
-    def _get(self, name: str) -> np.ndarray:
+    def _get(self, name: str):
         return self.quant.load(self.st, name)
+
+    def _get_dense(self, name: str) -> np.ndarray:
+        """Like _get but always a dense ndarray: paths that slice, stack or
+        concatenate (fused qkv/gate_up splits, MoE expert stacking, GDN
+        in_proj concat) cannot operate on fp8-native marker dicts, so those
+        weights are dequantized even under keep_native."""
+        w = self._get(name)
+        if isinstance(w, dict) and "__fp8__" in w:
+            from ..ops.fp8 import dequant_fp8_blockwise
+            return np.asarray(dequant_fp8_blockwise(
+                jnp.asarray(w["__fp8__"]), jnp.asarray(w["scale_inv"]),
+                out_dtype=jnp.float32))
+        return w
 
     def _has(self, name: str) -> bool:
         return self.quant.has(self.st, name)
@@ -54,7 +72,7 @@ class ParamLoader:
         sq, skv = cfg.size_q, cfg.size_kv
         p: dict = {}
         if cfg.fused_qkv and self._has(f"{lp}.self_attn.qkv_proj.weight"):
-            w = self._get(f"{lp}.self_attn.qkv_proj.weight")
+            w = self._get_dense(f"{lp}.self_attn.qkv_proj.weight")
             p["q_proj"] = {"weight": _to_dev(w[:sq], self.dtype)}
             p["k_proj"] = {"weight": _to_dev(w[sq:sq + skv], self.dtype)}
             p["v_proj"] = {"weight": _to_dev(w[sq + skv:], self.dtype)}
@@ -76,7 +94,7 @@ class ParamLoader:
     def _mlp(self, mp: str) -> dict:
         cfg = self.cfg
         if cfg.fused_gate_up and self._has(f"{mp}.gate_up_proj.weight"):
-            w = self._get(f"{mp}.gate_up_proj.weight")
+            w = self._get_dense(f"{mp}.gate_up_proj.weight")
             i = w.shape[0] // 2
             return {
                 "gate_proj": {"weight": _to_dev(w[:i], self.dtype)},
@@ -96,7 +114,7 @@ class ParamLoader:
         for e in range(cfg.num_experts):
             for proj in stacked:
                 stacked[proj].append(
-                    self._get(f"{mp}.experts.{e}.{proj}.weight"))
+                    self._get_dense(f"{mp}.experts.{e}.{proj}.weight"))
         p["experts"] = {proj: _to_dev(np.stack(ws), self.dtype)
                         for proj, ws in stacked.items()}
         if cfg.shared_expert_intermediate_size:

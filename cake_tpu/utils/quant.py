@@ -26,19 +26,29 @@ class NoQuantization:
 
 class Fp8Quantization:
     """Block-wise FP8 (E4M3) with per-128x128 `weight_scale_inv`
-    (ref: utils/fp8.rs). Dequant to f32 at load; the native-dtype path
-    (keep FP8 in HBM) lives in the model loaders via keep_native."""
+    (ref: utils/fp8.rs). Default: dequant at load. keep_native=True keeps
+    weights as f8e4m3 in HBM (1 byte/param — the reference's
+    native_dtype_backend, FLUX.1 12 GB vs 24 GB) and the model dequantizes
+    per layer inside the jitted forward."""
     name = "fp8"
     vram_factor = 2.0      # f8 -> bf16 doubles bytes when dequantized
 
-    def load(self, storage, name: str) -> np.ndarray:
+    def __init__(self, keep_native: bool = False):
+        self.keep_native = keep_native
+        if keep_native:
+            self.vram_factor = 1.0
+
+    def load(self, storage, name: str):
         scale_name = name.replace(".weight", ".weight_scale_inv")
         if not name.endswith(".weight") or scale_name not in storage:
             return storage.read(name)
-        from ..ops.fp8 import dequant_fp8_blockwise
-        import jax.numpy as jnp
         w = storage.read(name)
         s = storage.read(scale_name).astype(np.float32)
+        if self.keep_native:
+            # marker dict consumed by loaders -> params keep f8 + scales
+            return {"__fp8__": w, "scale_inv": s}
+        from ..ops.fp8 import dequant_fp8_blockwise
+        import jax.numpy as jnp
         return np.asarray(dequant_fp8_blockwise(
             jnp.asarray(w), jnp.asarray(s), out_dtype=jnp.float32))
 

@@ -216,3 +216,48 @@ def test_detect_quantization():
     ).name == "gptq"
     assert detect_quantization(
         {"quantization_config": {"quant_method": "fp8"}}).name == "fp8"
+
+
+def test_fp8_native_dtype_path(tmp_path, rng):
+    """keep_native: weights stay f8e4m3 in the params pytree (1 byte/param)
+    and the jitted forward dequantizes per layer — logits must match the
+    dequant-at-load path (ref: native_dtype_backend.rs)."""
+    import json
+
+    from cake_tpu.models import TextModel, tiny_config
+    from cake_tpu.ops.fp8 import quant_fp8_blockwise
+    from cake_tpu.ops.sampling import SamplingConfig
+
+    cfg = tiny_config("llama", hidden_size=64, intermediate_size=128,
+                      num_attention_heads=4, num_key_value_heads=4)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    tensors = params_to_hf_tensors(cfg, params)
+    for name in list(tensors):
+        if ".mlp." in name and name.endswith(".weight"):
+            w = tensors.pop(name)
+            wq, si = quant_fp8_blockwise(jnp.asarray(w))
+            tensors[name] = np.asarray(wq)
+            tensors[name.replace(".weight", ".weight_scale_inv")] = np.asarray(si)
+    save_safetensors(str(tmp_path / "model.safetensors"), tensors)
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"architectures": ["LlamaForCausalLM"],
+         "quantization_config": {"quant_method": "fp8"}}))
+
+    dequant = load_model_params(cfg, str(tmp_path), jnp.float32,
+                                quant=Fp8Quantization())
+    native = load_model_params(cfg, str(tmp_path), jnp.float32,
+                               quant=Fp8Quantization(keep_native=True))
+    # native pytree holds f8 weights
+    wn = native["layers"][0]["mlp"]["gate_proj"]["weight"]
+    assert isinstance(wn, dict) and wn["fp8"].dtype == jnp.float8_e4m3fn
+    # forwards agree
+    m1 = TextModel(cfg, dequant, dtype=jnp.float32, max_cache_len=32)
+    m2 = TextModel(cfg, native, dtype=jnp.float32, max_cache_len=32)
+    l1, _ = m1.prefill(m1.new_cache(), [1, 2, 3, 4])
+    l2, _ = m2.prefill(m2.new_cache(), [1, 2, 3, 4])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=2e-3,
+                               rtol=1e-2)
+    # greedy generation runs on the native path
+    toks, _ = m2.generate([1, 2, 3], max_new_tokens=4,
+                          sampling=SamplingConfig(temperature=0.0), chunk=4)
+    assert len(toks) >= 1

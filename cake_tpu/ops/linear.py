@@ -14,10 +14,12 @@ def resolve_weight(weight, dtype=None):
     compute dtype inside the jitted forward; plain arrays pass through.
     XLA fuses the dequant into the consuming matmul, so HBM holds 1
     byte/param (ref: native_dtype_backend.rs)."""
-    if isinstance(weight, dict) and "fp8" in weight:
-        from .fp8 import dequant_fp8_blockwise
-        return dequant_fp8_blockwise(weight["fp8"], weight["scale_inv"],
-                                     out_dtype=dtype or jnp.bfloat16)
+    if isinstance(weight, dict):
+        f8 = weight.get("fp8", weight.get("__fp8__"))
+        if f8 is not None:
+            from .fp8 import dequant_fp8_blockwise
+            return dequant_fp8_blockwise(f8, weight["scale_inv"],
+                                         out_dtype=dtype or jnp.bfloat16)
     return weight
 
 

@@ -43,17 +43,29 @@ class ParamLoader:
     def _get(self, name: str):
         return self.quant.load(self.st, name)
 
+    _warned_dense_fallback = False
+
     def _get_dense(self, name: str) -> np.ndarray:
-        """Like _get but always a dense ndarray: paths that slice, stack or
-        concatenate (fused qkv/gate_up splits, MoE expert stacking, GDN
-        in_proj concat) cannot operate on fp8-native marker dicts, so those
-        weights are dequantized even under keep_native."""
+        """Like _get but always a dense ndarray: paths that slice, stack,
+        concatenate or consume weights outside linear() (fused qkv/gate_up
+        splits, MoE expert stacking + router gate, embeddings, GDN in_proj)
+        cannot keep fp8-native marker dicts. Dequantized HOST-side in numpy
+        (no device round trip) with a one-time warning that these tensors
+        lose the 1 byte/param residency."""
         w = self._get(name)
         if isinstance(w, dict) and "__fp8__" in w:
-            from ..ops.fp8 import dequant_fp8_blockwise
-            return np.asarray(dequant_fp8_blockwise(
-                jnp.asarray(w["__fp8__"]), jnp.asarray(w["scale_inv"]),
-                out_dtype=jnp.float32))
+            if not ParamLoader._warned_dense_fallback:
+                import logging
+                logging.getLogger("cake_tpu.loaders").warning(
+                    "fp8-native: %s loads dense (sliced/stacked/non-matmul "
+                    "consumer) — 1 byte/param residency applies to plain "
+                    "projections only", name)
+                ParamLoader._warned_dense_fallback = True
+            f8 = np.asarray(w["__fp8__"])
+            si = np.asarray(w["scale_inv"], dtype=np.float32)
+            o, i = f8.shape
+            full = np.repeat(np.repeat(si, 128, 0), 128, 1)[:o, :i]
+            return f8.astype(np.float32) * full
         return w
 
     def _has(self, name: str) -> bool:
@@ -108,8 +120,9 @@ class ParamLoader:
 
     def _moe(self, mp: str) -> dict:
         cfg = self.cfg
-        p: dict = {"gate": {"weight": _to_dev(self._get(f"{mp}.gate.weight"),
-                                              self.dtype)}}
+        # router gate feeds a raw einsum (ops/moe.py), not linear(): dense
+        p: dict = {"gate": {"weight": _to_dev(
+            self._get_dense(f"{mp}.gate.weight"), self.dtype)}}
         stacked = {k: [] for k in ("gate_proj", "up_proj", "down_proj")}
         for e in range(cfg.num_experts):
             for proj in stacked:
@@ -160,8 +173,10 @@ class ParamLoader:
             include_embed = True
         params: dict = {"layers": [self._layer(i) for i in range(lo, hi)]}
         if include_embed:
+            # embeddings feed jnp.take, not linear(): dense
             params["embed_tokens"] = {"weight": _to_dev(
-                self._get(f"{self.prefix}.embed_tokens.weight"), self.dtype)}
+                self._get_dense(f"{self.prefix}.embed_tokens.weight"),
+                self.dtype)}
         if include_head:
             params["norm"] = {"weight": self._norm(f"{self.prefix}.norm.weight")}
             if not cfg.tie_word_embeddings:

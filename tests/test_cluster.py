@@ -397,3 +397,54 @@ def test_worker_cache_hit_skips_push(cluster_model_dir):
         if loop and srv:
             asyncio.run_coroutine_threadsafe(srv.stop(), loop)
         t.join(timeout=5)
+
+
+def test_two_worker_auto_assignment_cluster(cluster_model_dir):
+    """Two workers with unequal TFLOPS: plan_assignments splits 3:1, both
+    ranges stream + serve, generation matches fully-local (the mixed-cluster
+    configuration from BASELINE.json, on localhost)."""
+    from cake_tpu.cluster.master import (DistributedTextModel, master_setup,
+                                         plan_assignments)
+    from cake_tpu.models import SamplingConfig, TextModel
+    from cake_tpu.utils.safetensors_io import TensorStorage
+
+    cfg, params, mdir, wcache = cluster_model_dir
+    r0, r1 = threading.Event(), threading.Event()
+    h0, t0 = _start_worker_thread("w-fast", "k2", wcache + "0", r0)
+    h1, t1 = _start_worker_thread("w-slow", "k2", wcache + "1", r1)
+    assert r0.wait(10) and r1.wait(10)
+    workers = [
+        {"name": "w-fast", "host": "127.0.0.1", "port": h0["port"],
+         "caps": {"backend": "tpu", "device": "x", "memory_bytes": 8 << 30,
+                  "tflops": 300.0}},
+        {"name": "w-slow", "host": "127.0.0.1", "port": h1["port"],
+         "caps": {"backend": "cpu", "device": "cpu", "memory_bytes": 8 << 30,
+                  "tflops": 100.0}},
+    ]
+    try:
+        st = TensorStorage.from_model_dir(mdir)
+        plan = plan_assignments(cfg, st, workers)
+        st.close()
+        assert plan == {"w-fast": (0, 3), "w-slow": (3, 4)}
+
+        setup = master_setup(mdir, "k2", cfg, workers, assignments=plan,
+                             dtype_str="f32", max_cache_len=64)
+        # all four layers remote; master keeps embed + head only
+        assert [(s.kind, s.start, s.end) for s in setup.stages] == \
+            [("remote", 0, 3), ("remote", 3, 4)]
+        dist = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                    dtype=jnp.float32, max_cache_len=64)
+        got, _ = dist.generate([1, 2, 3, 4], max_new_tokens=6,
+                               sampling=SamplingConfig(temperature=0.0))
+        local = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+        want, _ = local.generate([1, 2, 3, 4], max_new_tokens=6,
+                                 sampling=SamplingConfig(temperature=0.0))
+        assert got == want
+        for c in setup.clients:
+            c.close()
+    finally:
+        for holder, t in ((h0, t0), (h1, t1)):
+            loop, srv = holder.get("loop"), holder.get("server")
+            if loop and srv:
+                asyncio.run_coroutine_threadsafe(srv.stop(), loop)
+            t.join(timeout=5)

@@ -25,23 +25,42 @@ import numpy as np
 BASELINE_TOK_S = 185.7
 
 
-def _watchdog(seconds: int):
-    """Hard-exit if the TPU grant service wedges mid-compile (observed in
-    this environment): better a clean failure JSON than a silent hang."""
+def _deadline(seconds: int, payload: dict, exit_code: int):
+    """Daemon timer that prints a failure-JSON line and hard-exits if not
+    cancelled within `seconds` — a wedged TPU grant service makes even tiny
+    jits hang forever (observed in this environment), and a clean failure
+    JSON beats a silent hang."""
     import os
     import threading
 
     def boom():
-        print(json.dumps({"metric": "qwen3_0.6b_decode", "value": 0.0,
-                          "unit": "tok/s", "vs_baseline": 0.0,
-                          "error": f"watchdog: no result in {seconds}s"}),
-              flush=True)
-        os._exit(3)
+        print(json.dumps(payload), flush=True)
+        os._exit(exit_code)
 
     t = threading.Timer(seconds, boom)
     t.daemon = True
     t.start()
     return t
+
+
+def _fail_payload(metric: str, error: str, **extra) -> dict:
+    return {"metric": metric, "value": 0.0, "unit": "tok/s",
+            "vs_baseline": 0.0, "error": error, **extra}
+
+
+def _health_probe(seconds: int, metric: str):
+    """Fast-fail TPU health check (round-1 lesson): probe with a 64x64 jit
+    under a short deadline and emit a distinguishable "tpu-wedged" JSON line
+    instead of eating the full bench watchdog mid-model-build."""
+    t = _deadline(seconds, _fail_payload(
+        metric, "tpu-wedged",
+        detail=f"64x64 jit did not finish in {seconds}s"), exit_code=4)
+    t0 = time.time()
+    x = jnp.ones((64, 64), jnp.bfloat16)
+    jax.jit(lambda a: (a @ a).sum())(x).block_until_ready()
+    t.cancel()
+    print(f"[bench] health probe ok: {jax.devices()[0]} "
+          f"({time.time() - t0:.1f}s)", file=sys.stderr)
 
 
 def main():
@@ -52,8 +71,17 @@ def main():
     ap.add_argument("--chunk", type=int, default=64)
     ap.add_argument("--prompt-len", type=int, default=32)
     ap.add_argument("--watchdog", type=int, default=1200)
+    ap.add_argument("--probe-timeout", type=int, default=60)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform (JAX_PLATFORMS env is "
+                         "ignored when a sitecustomize pre-imports jax)")
     args = ap.parse_args()
-    wd = _watchdog(args.watchdog)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    metric = "smoke_decode" if args.smoke else "qwen3_0.6b_decode"
+    _health_probe(args.probe_timeout, metric)
+    wd = _deadline(args.watchdog, _fail_payload(
+        metric, f"watchdog: no result in {args.watchdog}s"), exit_code=3)
 
     from cake_tpu.models import (SamplingConfig, TextModel, config_from_hf_dict,
                                  tiny_config)
@@ -85,7 +113,7 @@ def main():
 
     value = float(np.mean(rates))
     result = {
-        "metric": "qwen3_0.6b_decode" if not args.smoke else "smoke_decode",
+        "metric": metric,
         "value": round(value, 2),
         "unit": "tok/s",
         "vs_baseline": round(value / BASELINE_TOK_S, 3),

@@ -28,7 +28,7 @@ from ..models.common.config import ModelConfig
 from ..models.common.layers import (embed_tokens, forward_layers,
                                     lm_head_logits)
 from ..models.common.text_model import (PREFILL_BUCKETS, LocalStage, Token,
-                                        bucket_for)
+                                        bucket_for, check_prefill_bounds)
 from ..ops.sampling import SamplingConfig, push_recent_token, sample
 from .auth import cluster_hash
 from .client import RemoteStage
@@ -103,7 +103,9 @@ class DistributedTextModel:
 
     def prefill_logits(self, token_ids: list[int], pos0: int = 0):
         n = len(token_ids)
-        bkt = bucket_for(n, self.max_cache_len)
+        # stage caches are all allocated at max_cache_len (no growth
+        # bucketing on the distributed path), so capacity == max_cache_len
+        bkt = check_prefill_bounds(n, pos0, None, self.max_cache_len)
         padded = np.zeros((1, bkt), np.int32)
         padded[0, :n] = token_ids
         x = self._embed(self.params, jnp.asarray(padded))

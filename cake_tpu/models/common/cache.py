@@ -95,6 +95,68 @@ def update_kv_cache(layer_cache: dict, k_new, v_new, pos, valid_len=None):
     return {"k": k, "v": v, "pos": p}
 
 
+def kv_capacity(cfg: ModelConfig, cache: dict,
+                layer_range: tuple[int, int] | None = None) -> int | None:
+    """Smallest full-attention buffer length in the cache — positions past
+    it would silently wrap. None when the range has only ring (SWA) or
+    linear-attention layers, which wrap/forget by design."""
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    caps = [lc["k"].shape[1]
+            for i, lc in zip(range(lo, hi), cache["layers"])
+            if cfg.layer_spec(i).kind != "linear"
+            and cfg.layer_spec(i).window is None]
+    return min(caps) if caps else None
+
+
+def grow_layer_kv(lc: dict, new_size: int) -> dict:
+    """Re-home a KV layer cache into a larger buffer.
+
+    Entries are re-scattered at slot = pos % new_size, so this is correct
+    for both full-attention buffers (identity prefix copy) and
+    sliding-window rings (remap). Empty slots (pos == -1) are dropped via
+    the OOB-scatter trick used by update_kv_cache.
+    """
+    old_size = lc["k"].shape[1]
+    if new_size <= old_size:
+        return lc
+    b = lc["k"].shape[0]
+    pos = lc["pos"]                                        # [B, old]
+    slots = jnp.where(pos >= 0, pos % new_size, new_size)  # OOB -> dropped
+    bidx = jnp.arange(b, dtype=jnp.int32)[:, None]
+    k = jnp.zeros((b, new_size) + lc["k"].shape[2:], lc["k"].dtype)
+    v = jnp.zeros((b, new_size) + lc["v"].shape[2:], lc["v"].dtype)
+    p = jnp.full((b, new_size), -1, jnp.int32)
+    return {
+        "k": k.at[bidx, slots].set(lc["k"], mode="drop"),
+        "v": v.at[bidx, slots].set(lc["v"], mode="drop"),
+        "pos": p.at[bidx, slots].set(pos, mode="drop"),
+    }
+
+
+def grow_cache(cfg: ModelConfig, cache: dict, new_len: int,
+               layer_range: tuple[int, int] | None = None) -> dict:
+    """Grow every KV buffer to min(new_len, its window) slots.
+
+    Cache-length bucketing (the single-chip decode perf lever): decode
+    attends over the allocated buffer, so short generations keep a small
+    buffer and grow it bucket-by-bucket instead of always paying
+    max_cache_len worth of attention bandwidth per token (the reference
+    trims to actual length per step instead — cache.rs:163-210; under XLA
+    we recompile per bucket, which happens O(log max_len) times).
+    Linear-attention state is O(1) and passes through untouched.
+    """
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    new_layers = []
+    for i, lc in zip(range(lo, hi), cache["layers"]):
+        spec = cfg.layer_spec(i)
+        if spec.kind == "linear":
+            new_layers.append(lc)
+            continue
+        target = new_len if spec.window is None else min(spec.window, new_len)
+        new_layers.append(grow_layer_kv(lc, target))
+    return {"layers": new_layers, "pos": cache["pos"]}
+
+
 def cache_reset(cache: dict) -> dict:
     """Clear all state (ref: cache clear on Goodbye, worker.rs:364-384)."""
     def zero_layer(lc):

@@ -223,7 +223,16 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
     elif layer_cache is None:
         kv_pos, k_all, v_all = kv_pos_new, k, v
         new_cache = None
+    elif s == 1:
+        # decode fast path: scatter the new entry first, attend over the
+        # cache buffer directly — no [cache ; new] concat copy per layer
+        # per token. Safe for SWA rings at s==1: the slot overwritten
+        # (position p - W) is exactly the one the window mask excludes.
+        new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
+        kv_pos, k_all, v_all = (new_cache["pos"], new_cache["k"],
+                                new_cache["v"])
     else:
+        new_cache = None
         kv_pos = jnp.concatenate([layer_cache["pos"], kv_pos_new], axis=1)
         k_all = jnp.concatenate([layer_cache["k"], k], axis=1)
         v_all = jnp.concatenate([layer_cache["v"], v], axis=1)
@@ -231,7 +240,7 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
         q_pos = jnp.broadcast_to(positions[None, :], (b, s))
         mask = make_attention_mask(q_pos, kv_pos, window=spec.window)
         y = multi_head_attention(q, k_all, v_all, mask, scale=cfg.attn_scale)
-        if layer_cache is not None:
+        if layer_cache is not None and new_cache is None:
             new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
     y = y.reshape(b, s, sq)
     if gate is not None:

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.sampling import SamplingConfig, push_recent_token, sample
-from .cache import init_cache
+from .cache import grow_cache, init_cache, kv_capacity
 from .config import ModelConfig
 from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
 
@@ -44,6 +44,22 @@ def bucket_for(n: int, max_len: int) -> int:
         if n <= b:
             return min(b, max_len)
     return max_len
+
+
+def check_prefill_bounds(n: int, pos0: int, capacity: int | None,
+                         max_len: int) -> int:
+    """Validate a prefill request against the cache; returns the prompt
+    bucket. capacity = actual full-attention buffer length (kv_capacity),
+    which may be a smaller growth bucket than max_len."""
+    bkt = bucket_for(n, max_len)
+    if n > bkt:
+        raise ValueError(f"prompt length {n} exceeds cache {bkt}")
+    limit = max_len if capacity is None else min(capacity, max_len)
+    if pos0 + n > limit:
+        raise ValueError(
+            f"prefill past cache end: pos0={pos0} + {n} tokens > "
+            f"cache capacity {limit}")
+    return bkt
 
 
 @dataclass
@@ -134,27 +150,32 @@ class TextModel:
             logits = lm_head_logits(cfg, params, x)[:, -1]
             return logits, cache
 
+        # no donation: grown shapes differ, so donated buffers can't be
+        # reused anyway and the warning is just noise
+        @functools.partial(jax.jit, static_argnames=("new_len",))
+        def _grow(cache, new_len):
+            return grow_cache(cfg, cache, new_len)
+
         self._prefill = _prefill
         self._decode_chunk = _decode_chunk
         self._decode_step = _decode_step
+        self._grow = _grow
 
     # -- cache / state ------------------------------------------------------
 
-    def new_cache(self, batch: int = 1):
-        return init_cache(self.cfg, batch, self.max_cache_len, self.dtype)
+    def new_cache(self, batch: int = 1, kv_len: int | None = None):
+        """kv_len bounds the KV buffers (cache-length bucket); defaults to
+        the full max_cache_len (distributed master / parity-test paths)."""
+        return init_cache(self.cfg, batch, kv_len or self.max_cache_len,
+                          self.dtype)
 
     # -- inference ----------------------------------------------------------
 
     def prefill(self, cache, token_ids: Iterable[int], pos0: int = 0):
         ids = list(token_ids)
         n = len(ids)
-        bkt = bucket_for(n, self.max_cache_len)
-        if n > bkt:
-            raise ValueError(f"prompt length {n} exceeds cache {bkt}")
-        if pos0 + n > self.max_cache_len:
-            raise ValueError(
-                f"prefill past cache end: pos0={pos0} + {n} tokens > "
-                f"max_cache_len={self.max_cache_len}")
+        bkt = check_prefill_bounds(n, pos0, kv_capacity(self.cfg, cache),
+                                   self.max_cache_len)
         padded = np.zeros((1, bkt), np.int32)
         padded[0, :n] = ids
         logits, cache = self._prefill(self.params, jnp.asarray(padded), cache,
@@ -181,7 +202,10 @@ class TextModel:
         cfg = self.cfg
         scfg = sampling or SamplingConfig()
         rng = self._rng if rng is None else rng
-        cache = self.new_cache(1)
+        # smallest bucket covering prompt + first decode chunk; grown
+        # bucket-by-bucket below so decode never attends over unused slots
+        kv_len = bucket_for(len(prompt_ids) + 1 + chunk, self.max_cache_len)
+        cache = self.new_cache(1, kv_len=kv_len)
 
         t0 = time.monotonic()
         logits, cache = self._prefill_start(prompt_ids, cache)
@@ -203,12 +227,17 @@ class TextModel:
         # never decode past the cache (full-attn buffers are not rings)
         budget = self.max_cache_len - len(prompt_ids) - 1 - chunk
         max_new_tokens = min(max_new_tokens, max(budget, 1))
+        pos = len(prompt_ids)            # next write position (first token)
         while not done and len(out) < max_new_tokens:
+            if pos + chunk > kv_len:
+                kv_len = bucket_for(pos + chunk, self.max_cache_len)
+                cache = self._grow(cache, new_len=kv_len)
             # Always run the full chunk (one compiled program for all calls);
             # overshoot past EOS/max_new is discarded on the host — wasted
             # FLOPs bounded by chunk-1, zero recompiles.
             toks, cache, rng, recent = self._decode_chunk(
                 self.params, tok_arr, cache, rng, recent, scfg, chunk)
+            pos += chunk
             toks_np = np.asarray(toks)
             for t in toks_np:
                 tid = int(t)

@@ -1,0 +1,97 @@
+"""Cache-length bucketing: decode must attend only over the allocated
+bucket, growing it bucket-by-bucket with bit-identical results to a
+full-length cache (the single-chip perf lever from round-1 review; the
+reference instead trims the cache to actual length per step,
+ref: models/common/cache.rs:163-210).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models import TextModel, tiny_config
+from cake_tpu.models.common.cache import (grow_cache, grow_layer_kv,
+                                          init_cache, init_layer_cache,
+                                          update_kv_cache)
+from cake_tpu.ops.sampling import SamplingConfig
+
+
+def _greedy_ref(model, prompt, n_new):
+    """Reference decode over a FULL-length cache, one token at a time."""
+    cache = model.new_cache()        # full max_cache_len buffers
+    logits, cache = model.prefill(cache, prompt)
+    toks = [int(np.argmax(np.asarray(logits[0])))]
+    while len(toks) < n_new:
+        logits, cache = model.decode_logits(cache, toks[-1])
+        toks.append(int(np.argmax(np.asarray(logits[0]))))
+    return toks
+
+
+@pytest.mark.parametrize("fam", ["llama", "gemma3", "qwen3_5"])
+def test_generate_growth_parity(fam):
+    """Greedy generate (bucketed, growing cache) == full-cache decode."""
+    cfg = tiny_config(fam, eos_token_id=255)   # improbable EOS under argmax
+    model = TextModel(cfg, dtype=jnp.float32, max_cache_len=64)
+    prompt = list(np.random.default_rng(3).integers(0, 200, size=5))
+    # chunk=8: initial bucket 32, grows to 64 mid-generation
+    out, _ = model.generate(prompt, max_new_tokens=24,
+                            sampling=SamplingConfig(temperature=0.0), chunk=8)
+    ref = _greedy_ref(model, prompt, len(out))
+    assert out == ref
+
+
+def test_generate_growth_swa():
+    """SWA ring smaller than the bucket: growth leaves the ring alone."""
+    cfg = tiny_config("mistral", sliding_window=8, eos_token_id=255)
+    model = TextModel(cfg, dtype=jnp.float32, max_cache_len=64)
+    prompt = [1, 2, 3, 4, 5]
+    out, _ = model.generate(prompt, max_new_tokens=24,
+                            sampling=SamplingConfig(temperature=0.0), chunk=8)
+    ref = _greedy_ref(model, prompt, len(out))
+    assert out == ref
+
+
+def test_grow_layer_kv_ring_remap():
+    """Growing a wrapped ring re-homes entries at pos % new_size."""
+    cfg = tiny_config("mistral", sliding_window=48)
+    spec = cfg.layer_spec(0)
+    rng = np.random.default_rng(0)
+    k_all = jnp.asarray(rng.standard_normal((1, 40, 2, 16)), jnp.float32)
+
+    # write positions 0..39 into a 32-slot ring (wraps), then grow to 48
+    small = init_layer_cache(cfg, spec, 1, 32, jnp.float32)
+    for p in range(40):
+        small = update_kv_cache(small, k_all[:, p:p + 1], k_all[:, p:p + 1],
+                                jnp.asarray(p, jnp.int32))
+    grown = grow_layer_kv(small, 48)
+
+    # reference: same writes straight into a 48-slot ring
+    big = init_layer_cache(cfg, spec, 1, 48, jnp.float32)
+    for p in range(40):
+        big = update_kv_cache(big, k_all[:, p:p + 1], k_all[:, p:p + 1],
+                              jnp.asarray(p, jnp.int32))
+
+    # a 32-slot ring only retains the last 32 positions; those must land in
+    # their % 48 slots, all other grown slots must be empty
+    pos_g, pos_b = np.asarray(grown["pos"])[0], np.asarray(big["pos"])[0]
+    for p in range(8, 40):                       # survivors of the 32-ring
+        assert pos_g[p % 48] == p
+        np.testing.assert_array_equal(np.asarray(grown["k"])[0, p % 48],
+                                      np.asarray(big["k"])[0, p % 48])
+    assert (pos_g >= 0).sum() == 32
+    assert grown["k"].shape[1] == 48
+
+
+def test_grow_cache_full_and_linear_layers():
+    cfg = tiny_config("qwen3_5")                 # 3 linear : 1 full hybrid
+    cache = init_cache(cfg, 1, 32, jnp.float32)
+    grown = grow_cache(cfg, cache, 64)
+    for i in range(cfg.num_hidden_layers):
+        lc = grown["layers"][i]
+        if cfg.layer_spec(i).kind == "linear":
+            assert "state" in lc and lc["conv"].shape == \
+                cache["layers"][i]["conv"].shape
+        else:
+            assert lc["k"].shape[1] == 64
+    # growth is idempotent at the same size
+    again = grow_cache(cfg, grown, 64)
+    assert again["layers"][-1]["k"].shape[1] == 64

@@ -5,3 +5,7 @@ from .vae import (VaeConfig, init_vae_decoder_params, latents_to_patches,
                   patches_to_latents, vae_decode)
 from .sd import (SDImageModel, SDPipelineConfig, UNetConfig,
                  init_unet_params, tiny_sd_config, unet_forward)
+from .flux_loader import (Flux1TextEncoder, detect_flux_checkpoint,
+                          infer_flux_configs, load_flux_image_model,
+                          load_flux_params, mmdit_mapping,
+                          vae_decoder_mapping)

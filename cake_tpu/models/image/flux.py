@@ -46,9 +46,11 @@ class FluxPipelineConfig:
 def tiny_flux_config() -> FluxPipelineConfig:
     """Test-scale config (mirrors the tiny text fixtures)."""
     return FluxPipelineConfig(
+        # txt_dim/vec_dim line up with tiny_t5_config.d_model and
+        # tiny_clip_config.hidden_size so the tiny encoder stack plugs in
         mmdit=MMDiTConfig(in_channels=16, hidden_size=64, num_heads=4,
                           head_dim=16, depth_double=2, depth_single=2,
-                          txt_dim=32, vec_dim=16,
+                          txt_dim=32, vec_dim=32,
                           axes_dims=(4, 6, 6)),
         vae=VaeConfig(latent_channels=4, base_channels=32,
                       channel_mults=(1, 2), num_res_blocks=1),

@@ -1,0 +1,456 @@
+"""FLUX.1 release-checkpoint loading.
+
+Supported layouts (ref: flux/config.rs Flux1ModelFile + flux1_prefixes):
+  * ComfyUI-style single bundle (the reference's checkpoint format —
+    e.g. flux1-dev-fp8.safetensors): transformer under
+    `model.diffusion_model.`, CLIP-L under `text_encoders.clip_l.
+    transformer.`, T5-XXL under `text_encoders.t5xxl.transformer.`,
+    autoencoder under `vae.`; FP8 tensors dequantized at load
+    (utils/mapping._dequant_read).
+  * BFL split layout: a transformer file with bare `double_blocks.*`
+    names plus `ae.safetensors` (bare `decoder.*`), with CLIP/T5 in
+    HF-layout subdirectories `clip/` and `t5/`.
+
+Tensor names follow the published BFL checkpoint format (the same names
+the reference wires up in models/flux/flux1_model.rs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.mapping import (coverage_report, load_mapped_params)
+from ...utils.safetensors_io import TensorStorage, index_file
+from ..text_encoders import (CLIPTextConfig, T5Config, clip_mapping,
+                             clip_text_forward, init_clip_params,
+                             init_t5_params, t5_encode, t5_mapping)
+from .mmdit import MMDiTConfig, init_mmdit_params
+from .vae import VaeConfig, init_vae_decoder_params
+
+log = logging.getLogger("cake_tpu.flux_loader")
+
+TRANSFORMER_PREFIX = "model.diffusion_model."
+CLIP_PREFIX = "text_encoders.clip_l.transformer."
+T5_PREFIX = "text_encoders.t5xxl.transformer."
+VAE_PREFIX = "vae."
+
+
+def flux1_dev_configs():
+    """Release FLUX.1-dev component configs (BFL published dims)."""
+    return dict(
+        mmdit=MMDiTConfig(),                       # 3072h/24H/19+38, defaults
+        vae=VaeConfig(),                           # 16ch f8 KL autoencoder
+        clip=CLIPTextConfig(),                     # CLIP-L/14
+        t5=T5Config(),                             # T5-XXL encoder
+    )
+
+
+def mmdit_mapping(cfg: MMDiTConfig, prefix: str = "") -> dict[str, str]:
+    """pytree path -> BFL FLUX transformer tensor name."""
+    m: dict[str, str] = {}
+    for pt, ck in (("img_in", "img_in"), ("txt_in", "txt_in"),
+                   ("final_out", "final_layer.linear"),
+                   ("final_mod", "final_layer.adaLN_modulation.1")):
+        m[f"{pt}.weight"] = f"{prefix}{ck}.weight"
+        m[f"{pt}.bias"] = f"{prefix}{ck}.bias"
+    embedders = [("time_mlp", "time_in"), ("vec_mlp", "vector_in")]
+    if cfg.guidance_embed:
+        embedders.append(("guidance_mlp", "guidance_in"))
+    for pt, ck in embedders:
+        for ours, theirs in (("in", "in_layer"), ("out", "out_layer")):
+            m[f"{pt}.{ours}.weight"] = f"{prefix}{ck}.{theirs}.weight"
+            m[f"{pt}.{ours}.bias"] = f"{prefix}{ck}.{theirs}.bias"
+    for i in range(cfg.depth_double):
+        for s in ("img", "txt"):
+            src = f"{prefix}double_blocks.{i}.{s}_"
+            dst = f"double.{i}.{s}."
+            for pt, ck in (("mod", f"mod.lin"), ("qkv", "attn.qkv"),
+                           ("proj", "attn.proj"), ("mlp_in", "mlp.0"),
+                           ("mlp_out", "mlp.2")):
+                m[f"{dst}{pt}.weight"] = f"{src}{ck}.weight"
+                m[f"{dst}{pt}.bias"] = f"{src}{ck}.bias"
+            m[f"{dst}q_norm.weight"] = f"{src}attn.norm.query_norm.scale"
+            m[f"{dst}k_norm.weight"] = f"{src}attn.norm.key_norm.scale"
+    for i in range(cfg.depth_single):
+        src = f"{prefix}single_blocks.{i}."
+        dst = f"single.{i}."
+        for pt, ck in (("mod", "modulation.lin"), ("linear1", "linear1"),
+                       ("linear2", "linear2")):
+            m[f"{dst}{pt}.weight"] = f"{src}{ck}.weight"
+            m[f"{dst}{pt}.bias"] = f"{src}{ck}.bias"
+        m[f"{dst}q_norm.weight"] = f"{src}norm.query_norm.scale"
+        m[f"{dst}k_norm.weight"] = f"{src}norm.key_norm.scale"
+    return m
+
+
+def vae_decoder_mapping(cfg: VaeConfig, prefix: str = "") -> dict[str, str]:
+    """pytree path -> CompVis/BFL autoencoder decoder tensor name.
+
+    Checkpoint `up.{lvl}` indexes low-resolution-last (lvl 3 runs first in
+    decode); our `ups` list is in processing order, so ups[k] <-> up.{L-1-k}.
+    """
+    def conv(dst, src):
+        return {f"{dst}.weight": f"{src}.weight", f"{dst}.bias": f"{src}.bias"}
+
+    def resnet(dst, src, has_shortcut):
+        m = {}
+        for ours, theirs in (("norm1", "norm1"), ("conv1", "conv1"),
+                             ("norm2", "norm2"), ("conv2", "conv2")):
+            m.update(conv(f"{dst}.{ours}", f"{src}.{theirs}"))
+        if has_shortcut:
+            m.update(conv(f"{dst}.shortcut", f"{src}.nin_shortcut"))
+        return m
+
+    d = f"{prefix}decoder."
+    n_lv = len(cfg.channel_mults)
+    chs = [cfg.base_channels * mlt for mlt in cfg.channel_mults]
+    m: dict[str, str] = {}
+    m.update(conv("conv_in", f"{d}conv_in"))
+    m.update(resnet("mid_res1", f"{d}mid.block_1", False))
+    m.update(resnet("mid_res2", f"{d}mid.block_2", False))
+    for ours, theirs in (("norm", "norm"), ("q", "q"), ("k", "k"),
+                         ("v", "v"), ("proj", "proj_out")):
+        m.update(conv(f"mid_attn.{ours}", f"{d}mid.attn_1.{theirs}"))
+    cin = chs[-1]
+    for k in range(n_lv):
+        lvl = n_lv - 1 - k
+        c = list(reversed(chs))[k]
+        for j in range(cfg.num_res_blocks):
+            m.update(resnet(f"ups.{k}.res.{j}", f"{d}up.{lvl}.block.{j}",
+                            has_shortcut=(cin != c)))
+            cin = c
+        if k < n_lv - 1:
+            m.update(conv(f"ups.{k}.upsample", f"{d}up.{lvl}.upsample.conv"))
+    m.update(conv("norm_out", f"{d}norm_out"))
+    m.update(conv("conv_out", f"{d}conv_out"))
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint detection + loading
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FluxCheckpoint:
+    kind: str                       # "bundle" | "split"
+    transformer: TensorStorage
+    transformer_prefix: str
+    vae: TensorStorage
+    vae_prefix: str
+    clip: TensorStorage | None
+    clip_prefix: str
+    t5: TensorStorage | None
+    t5_prefix: str
+    model_dir: str
+
+
+def detect_flux_checkpoint(path: str) -> FluxCheckpoint | None:
+    """Sniff safetensors headers for FLUX layouts; None if not FLUX."""
+    if os.path.isfile(path) and path.endswith(".safetensors"):
+        files = [path]
+        model_dir = os.path.dirname(path) or "."
+    elif os.path.isdir(path):
+        files = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                 if f.endswith(".safetensors")]
+        model_dir = path
+    else:
+        return None
+    bundle = transformer = ae = None
+    for f in files:
+        names = index_file(f).keys()
+        if any(n.startswith(TRANSFORMER_PREFIX + "double_blocks.")
+               for n in names):
+            bundle = f
+        elif any(n.startswith("double_blocks.") for n in names):
+            transformer = f
+        elif any(n.startswith("decoder.conv_in.") for n in names):
+            ae = f
+    if bundle:
+        st = TensorStorage(index_file(bundle))
+        has_clip = any(n.startswith(CLIP_PREFIX) for n in st.names())
+        has_t5 = any(n.startswith(T5_PREFIX) for n in st.names())
+        return FluxCheckpoint(
+            kind="bundle", transformer=st,
+            transformer_prefix=TRANSFORMER_PREFIX,
+            vae=st, vae_prefix=VAE_PREFIX,
+            clip=st if has_clip else None,
+            clip_prefix=CLIP_PREFIX + "text_model.",
+            t5=st if has_t5 else None, t5_prefix=T5_PREFIX,
+            model_dir=model_dir)
+    if transformer and ae:
+        def subdir_storage(sub):
+            p = os.path.join(model_dir, sub)
+            try:
+                return TensorStorage.from_model_dir(p) \
+                    if os.path.isdir(p) else None
+            except FileNotFoundError:
+                return None
+        return FluxCheckpoint(
+            kind="split", transformer=TensorStorage(index_file(transformer)),
+            transformer_prefix="",
+            vae=TensorStorage(index_file(ae)), vae_prefix="",
+            clip=subdir_storage("clip"), clip_prefix="text_model.",
+            t5=subdir_storage("t5"), t5_prefix="",
+            model_dir=model_dir)
+    return None
+
+
+def _shapes(init_fn):
+    return jax.eval_shape(init_fn)
+
+
+def load_flux_params(ckpt: FluxCheckpoint, cfgs: dict, dtype=jnp.bfloat16):
+    """Load transformer + VAE decoder (+ CLIP/T5 when present) pytrees with
+    full shape validation and coverage reporting."""
+    mm_cfg, vae_cfg = cfgs["mmdit"], cfgs["vae"]
+    mm_map = mmdit_mapping(mm_cfg, ckpt.transformer_prefix)
+    params = {
+        "transformer": load_mapped_params(
+            ckpt.transformer, mm_map,
+            _shapes(lambda: init_mmdit_params(mm_cfg, jax.random.PRNGKey(0),
+                                              dtype)), dtype),
+    }
+    coverage_report(ckpt.transformer, mm_map, ckpt.transformer_prefix)
+    # VAE decode runs in f32 (small, quality-sensitive — the reference also
+    # keeps SD/FLUX VAE in full precision)
+    vae_map = vae_decoder_mapping(vae_cfg, ckpt.vae_prefix)
+    params["vae"] = load_mapped_params(
+        ckpt.vae, vae_map,
+        _shapes(lambda: init_vae_decoder_params(vae_cfg, jax.random.PRNGKey(0),
+                                                jnp.float32)), jnp.float32)
+    coverage_report(ckpt.vae, vae_map, ckpt.vae_prefix,
+                    ignore=(ckpt.vae_prefix + "encoder.",))
+    if ckpt.clip is not None:
+        cmap = clip_mapping(cfgs["clip"], ckpt.clip_prefix)
+        params["clip"] = load_mapped_params(
+            ckpt.clip, cmap,
+            _shapes(lambda: init_clip_params(cfgs["clip"],
+                                             jax.random.PRNGKey(0), dtype)),
+            dtype)
+        coverage_report(ckpt.clip, cmap, ckpt.clip_prefix,
+                        ignore=(ckpt.clip_prefix + "embeddings.position_ids",))
+    if ckpt.t5 is not None:
+        tmap = t5_mapping(cfgs["t5"], ckpt.t5_prefix)
+        params["t5"] = load_mapped_params(
+            ckpt.t5, tmap,
+            _shapes(lambda: init_t5_params(cfgs["t5"], jax.random.PRNGKey(0),
+                                           dtype)), dtype)
+        coverage_report(ckpt.t5, tmap, ckpt.t5_prefix)
+    return params
+
+
+def infer_flux_configs(ckpt: FluxCheckpoint) -> dict:
+    """Component configs from checkpoint tensor shapes.
+
+    Everything shape-derivable is inferred (hidden sizes, depths, head_dim
+    via the q_norm scale, VAE channel ladder); the few free parameters
+    (CLIP head count, T5 bucket distance, rope axes split) default to the
+    published FLUX.1-dev values and can be overridden by an optional
+    `flux_config.json` sidecar — {"mmdit": {...}, "vae": {...}, ...} with
+    dataclass field names — for non-standard checkpoints (and tiny test
+    fixtures).
+    """
+    import json
+
+    def count(storage, fmt):
+        i = 0
+        while fmt.format(i) in storage:
+            i += 1
+        return i
+
+    over: dict = {}
+    sidecar = os.path.join(ckpt.model_dir, "flux_config.json")
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            over = json.load(f)
+
+    st, tp = ckpt.transformer, ckpt.transformer_prefix
+    rec = st.records
+    hidden, in_ch = rec[f"{tp}img_in.weight"].shape
+    head_dim = rec[f"{tp}double_blocks.0.img_attn.norm.query_norm.scale"].shape[0]
+    qkv_out = rec[f"{tp}double_blocks.0.img_attn.qkv.weight"].shape[0]
+    mlp_dim = rec[f"{tp}double_blocks.0.img_mlp.0.weight"].shape[0]
+    # default rope axes split follows the dev ratio (16,56,56)/128
+    s_ax = (head_dim * 7 // 16) // 2 * 2
+    mm = dict(
+        in_channels=in_ch, hidden_size=hidden,
+        num_heads=qkv_out // 3 // head_dim, head_dim=head_dim,
+        mlp_ratio=mlp_dim / hidden,
+        depth_double=count(st, tp + "double_blocks.{}.img_mod.lin.weight"),
+        depth_single=count(st, tp + "single_blocks.{}.linear1.weight"),
+        txt_dim=rec[f"{tp}txt_in.weight"].shape[1],
+        vec_dim=rec[f"{tp}vector_in.in_layer.weight"].shape[1],
+        guidance_embed=f"{tp}guidance_in.in_layer.weight" in st,
+        axes_dims=(head_dim - 2 * s_ax, s_ax, s_ax),
+    )
+    mm.update(over.get("mmdit", {}))
+    mm["axes_dims"] = tuple(mm["axes_dims"])
+
+    sv, vp = ckpt.vae, ckpt.vae_prefix
+    vrec = sv.records
+    n_lv = count(sv, vp + "decoder.up.{}.block.0.conv1.weight")
+    base = vrec[f"{vp}decoder.conv_out.weight"].shape[1]
+    mults = tuple(
+        vrec[f"{vp}decoder.up.{lvl}.block.0.conv1.weight"].shape[0] // base
+        for lvl in range(n_lv))
+    vae = dict(
+        latent_channels=vrec[f"{vp}decoder.conv_in.weight"].shape[1],
+        base_channels=base, channel_mults=mults,
+        num_res_blocks=count(sv, vp + "decoder.up.0.block.{}.conv1.weight"),
+    )
+    vae.update(over.get("vae", {}))
+    vae["channel_mults"] = tuple(vae["channel_mults"])
+
+    cfgs = {"mmdit": MMDiTConfig(**mm), "vae": VaeConfig(**vae)}
+
+    if ckpt.clip is not None:
+        cp = ckpt.clip_prefix
+        crec = ckpt.clip.records
+        ch = crec[f"{cp}embeddings.token_embedding.weight"].shape
+        clip = dict(
+            vocab_size=ch[0], hidden_size=ch[1],
+            num_layers=count(ckpt.clip,
+                             cp + "encoder.layers.{}.self_attn.q_proj.weight"),
+            num_heads=max(1, ch[1] // 64),      # CLIP convention: 64-d heads
+            intermediate_size=crec[f"{cp}encoder.layers.0.mlp.fc1.weight"].shape[0],
+            max_positions=crec[f"{cp}embeddings.position_embedding.weight"].shape[0],
+            eot_token_id=ch[0] - 1,
+        )
+        clip.update(over.get("clip", {}))
+        cfgs["clip"] = CLIPTextConfig(**clip)
+
+    if ckpt.t5 is not None:
+        t5p = ckpt.t5_prefix
+        trec = ckpt.t5.records
+        rel = trec[f"{t5p}encoder.block.0.layer.0.SelfAttention."
+                   f"relative_attention_bias.weight"].shape
+        q_out = trec[f"{t5p}encoder.block.0.layer.0.SelfAttention.q.weight"].shape[0]
+        t5 = dict(
+            vocab_size=trec[f"{t5p}shared.weight"].shape[0],
+            d_model=trec[f"{t5p}shared.weight"].shape[1],
+            num_layers=count(ckpt.t5, t5p + "encoder.block.{}.layer.0."
+                                            "SelfAttention.q.weight"),
+            num_heads=rel[1], d_kv=q_out // rel[1],
+            d_ff=trec[f"{t5p}encoder.block.0.layer.1.DenseReluDense."
+                      f"wi_0.weight"].shape[0],
+            relative_buckets=rel[0],
+        )
+        t5.update(over.get("t5", {}))
+        cfgs["t5"] = T5Config(**t5)
+    return cfgs
+
+
+# ---------------------------------------------------------------------------
+# Text encoding (CLIP pooled + T5 sequence)
+# ---------------------------------------------------------------------------
+
+
+class Flux1TextEncoder:
+    """prompt -> (t5 sequence embeddings, clip pooled vector).
+
+    Tokenizers: `clip_tokenizer.json` / `t5_tokenizer.json` in the model
+    dir (tokenizers-format; the T5 spiece.model is also accepted when the
+    sentencepiece package is importable)."""
+
+    def __init__(self, cfgs: dict, params: dict, model_dir: str,
+                 t5_seq_len: int = 512, dtype=jnp.bfloat16):
+        self.cfgs, self.params, self.dtype = cfgs, params, dtype
+        self.t5_seq_len = t5_seq_len
+        self.clip_tok = self._load_tokenizer(
+            model_dir, ("clip_tokenizer.json", "tokenizer.json"))
+        self.t5_tok = self._load_tokenizer(
+            model_dir, ("t5_tokenizer.json",), spiece="spiece.model")
+        clip_cfg, t5_cfg = cfgs["clip"], cfgs["t5"]
+
+        @jax.jit
+        def _encode(clip_p, t5_p, clip_ids, t5_ids):
+            _, pooled = clip_text_forward(clip_cfg, clip_p, clip_ids)
+            txt = t5_encode(t5_cfg, t5_p, t5_ids)
+            return txt, pooled
+
+        self._encode = _encode
+
+    @staticmethod
+    def _load_tokenizer(model_dir, names, spiece=None):
+        for n in names:
+            p = os.path.join(model_dir, n)
+            if os.path.exists(p):
+                from tokenizers import Tokenizer
+                return Tokenizer.from_file(p)
+        if spiece and os.path.exists(os.path.join(model_dir, spiece)):
+            try:
+                import sentencepiece as sp
+                proc = sp.SentencePieceProcessor()
+                proc.Load(os.path.join(model_dir, spiece))
+                return proc
+            except ImportError:
+                pass
+        raise FileNotFoundError(
+            f"no tokenizer found in {model_dir} (looked for {names}"
+            + (f" or {spiece}" if spiece else "") + ")")
+
+    def _ids(self, tok, text, length, pad_id, end_id=None):
+        if hasattr(tok, "encode") and not hasattr(tok, "EncodeAsIds"):
+            ids = tok.encode(text).ids
+        else:                                   # sentencepiece
+            ids = list(tok.EncodeAsIds(text)) + [1]     # append </s>
+        if len(ids) > length:
+            ids = ids[:length]
+            if end_id is not None:
+                # keep the end-of-text token on truncation: CLIP pooling
+                # reads the hidden state at the first EOT position
+                ids[-1] = end_id
+        ids = ids + [pad_id] * (length - len(ids))
+        return np.asarray([ids], np.int32)
+
+    def __call__(self, prompt: str):
+        clip_cfg = self.cfgs["clip"]
+        clip_ids = self._ids(self.clip_tok, prompt, clip_cfg.max_positions,
+                             clip_cfg.eot_token_id,
+                             end_id=clip_cfg.eot_token_id)
+        t5_ids = self._ids(self.t5_tok, prompt, self.t5_seq_len, 0, end_id=1)
+        txt, pooled = self._encode(self.params["clip"], self.params["t5"],
+                                   jnp.asarray(clip_ids),
+                                   jnp.asarray(t5_ids))
+        return txt.astype(self.dtype), pooled.astype(self.dtype)
+
+
+def load_flux_image_model(path: str, dtype=jnp.bfloat16, t5_seq_len: int = 512):
+    """Release-checkpoint FLUX.1 pipeline: detect layout, infer configs,
+    load + validate every component, return a ready FluxImageModel
+    (replaces the round-1 `demo:` escape hatch — ref: flux1.rs load path)."""
+    from .flux import FluxImageModel, FluxPipelineConfig
+
+    ckpt = detect_flux_checkpoint(path)
+    if ckpt is None:
+        raise ValueError(
+            f"{path!r} is not a recognizable FLUX checkpoint (expected a "
+            "ComfyUI-style bundle with model.diffusion_model.* tensors, or "
+            "a transformer .safetensors with bare double_blocks.* names "
+            "next to ae.safetensors)")
+    missing = [n for n, s in (("CLIP-L", ckpt.clip), ("T5", ckpt.t5))
+               if s is None]
+    if missing:
+        raise ValueError(
+            f"FLUX checkpoint at {path!r} is missing text encoders: "
+            f"{missing}. Bundle them (text_encoders.* prefixes) or provide "
+            f"clip/ and t5/ subdirectories in HF layout.")
+    cfgs = infer_flux_configs(ckpt)
+    params = load_flux_params(ckpt, cfgs, dtype)
+    encoder = Flux1TextEncoder(cfgs, params, ckpt.model_dir,
+                               t5_seq_len=t5_seq_len, dtype=dtype)
+    pipe_cfg = FluxPipelineConfig(mmdit=cfgs["mmdit"], vae=cfgs["vae"])
+    model = FluxImageModel(pipe_cfg,
+                           params={"transformer": params["transformer"],
+                                   "vae": params["vae"]},
+                           text_encoder=encoder, dtype=dtype)
+    log.info("loaded FLUX checkpoint (%s layout): %d double + %d single "
+             "blocks, hidden %d", ckpt.kind, cfgs["mmdit"].depth_double,
+             cfgs["mmdit"].depth_single, cfgs["mmdit"].hidden_size)
+    return model

@@ -106,7 +106,9 @@ def _upsample2x(p, x):
 
 
 def vae_decode(cfg: VaeConfig, p: dict, z):
-    """z: [B, latent_ch, H/8, W/8] -> image [B, 3, H, W] in [-1, 1]."""
+    """z: [B, latent_ch, H/8, W/8] -> image [B, 3, H, W], nominally in
+    [-1, 1] but unbounded (no output activation, matching the real
+    decoder) — consumers must clamp when converting to pixels."""
     z = z / cfg.scaling_factor + cfg.shift_factor
     x = conv2d(z, p["conv_in"]["weight"], p["conv_in"]["bias"], padding=1)
     x = _resnet(p["mid_res1"], x)
@@ -115,12 +117,14 @@ def vae_decode(cfg: VaeConfig, p: dict, z):
     for blk in p["ups"]:
         for r in blk["res"]:
             x = _resnet(r, x)
-        if blk["upsample"] is not None:
+        if blk.get("upsample") is not None:
             x = _upsample2x(blk["upsample"], x)
     x = jax.nn.silu(group_norm(x, p["norm_out"]["weight"],
                                p["norm_out"]["bias"], 32))
-    return jnp.tanh(conv2d(x, p["conv_out"]["weight"], p["conv_out"]["bias"],
-                           padding=1))
+    # no output activation — the real decoder ends at conv_out (consumers
+    # clamp to [-1, 1] when converting to pixels)
+    return conv2d(x, p["conv_out"]["weight"], p["conv_out"]["bias"],
+                  padding=1)
 
 
 def latents_to_patches(z):

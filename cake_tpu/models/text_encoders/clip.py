@@ -1,0 +1,149 @@
+"""CLIP text encoder (CLIP-L/14 for FLUX.1 pooled conditioning and SD
+cross-attention context; ref: models/flux/clip_encoder.rs, models/sd CLIP
+via candle-transformers).
+
+HF CLIPTextModel semantics: learned token + position embeddings, pre-LN
+transformer with causal mask and quick-gelu MLPs, final layer norm; the
+pooled output is the final hidden state at the first end-of-text token
+(HF takes argmax of the input ids — EOT has the highest id in the CLIP
+vocab).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import linear
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_positions: int = 77
+    layer_norm_eps: float = 1e-5
+    eot_token_id: int = 49407
+
+
+def tiny_clip_config() -> CLIPTextConfig:
+    return CLIPTextConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64, max_positions=16,
+                          eot_token_id=95)
+
+
+def _lin(key, dout, din, dtype):
+    return {"weight": jax.random.normal(key, (dout, din), dtype) * 0.02,
+            "bias": jnp.zeros((dout,), dtype)}
+
+
+def _ln(c, dtype):
+    return {"weight": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def init_clip_params(cfg: CLIPTextConfig, key, dtype=jnp.float32) -> dict:
+    h = cfg.hidden_size
+    keys = iter(jax.random.split(key, 2 + 6 * cfg.num_layers))
+    p: dict = {
+        "token_embedding": {
+            "weight": jax.random.normal(next(keys), (cfg.vocab_size, h),
+                                        dtype) * 0.02},
+        "position_embedding": {
+            "weight": jax.random.normal(next(keys), (cfg.max_positions, h),
+                                        dtype) * 0.02},
+        "layers": [],
+        "final_layer_norm": _ln(h, dtype),
+    }
+    for _ in range(cfg.num_layers):
+        p["layers"].append({
+            "layer_norm1": _ln(h, dtype),
+            "q_proj": _lin(next(keys), h, h, dtype),
+            "k_proj": _lin(next(keys), h, h, dtype),
+            "v_proj": _lin(next(keys), h, h, dtype),
+            "out_proj": _lin(next(keys), h, h, dtype),
+            "layer_norm2": _ln(h, dtype),
+            "fc1": _lin(next(keys), cfg.intermediate_size, h, dtype),
+            "fc2": _lin(next(keys), h, cfg.intermediate_size, dtype),
+        })
+    return p
+
+
+def _layer_norm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["weight"].astype(jnp.float32)
+            + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _attn(cfg: CLIPTextConfig, p, x, mask):
+    b, s, h = x.shape
+    d = h // cfg.num_heads
+    q = linear(x, p["q_proj"]["weight"], p["q_proj"]["bias"])
+    k = linear(x, p["k_proj"]["weight"], p["k_proj"]["bias"])
+    v = linear(x, p["v_proj"]["weight"], p["v_proj"]["bias"])
+    q = q.reshape(b, s, cfg.num_heads, d)
+    k = k.reshape(b, s, cfg.num_heads, d)
+    v = v.reshape(b, s, cfg.num_heads, d)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32) / (d ** 0.5)
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, h)
+    return linear(out, p["out_proj"]["weight"], p["out_proj"]["bias"])
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def clip_text_forward(cfg: CLIPTextConfig, params: dict, ids):
+    """ids: [B, S] int32 (S <= max_positions).
+    Returns (hidden [B, S, H], pooled [B, H])."""
+    b, s = ids.shape
+    x = params["token_embedding"]["weight"][ids]
+    x = x + params["position_embedding"]["weight"][:s][None]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    for lp in params["layers"]:
+        h = _layer_norm(x, lp["layer_norm1"], cfg.layer_norm_eps)
+        x = x + _attn(cfg, lp, h, mask)
+        h = _layer_norm(x, lp["layer_norm2"], cfg.layer_norm_eps)
+        h = quick_gelu(linear(h, lp["fc1"]["weight"], lp["fc1"]["bias"]))
+        x = x + linear(h, lp["fc2"]["weight"], lp["fc2"]["bias"])
+    x = _layer_norm(x, params["final_layer_norm"], cfg.layer_norm_eps)
+    # pooled = hidden at the first EOT position (HF: argmax of ids)
+    eot = jnp.argmax(jnp.where(ids == cfg.eot_token_id,
+                               jnp.arange(s, 0, -1, dtype=jnp.int32), 0),
+                     axis=1)
+    pooled = x[jnp.arange(b), eot]
+    return x, pooled
+
+
+def clip_mapping(cfg: CLIPTextConfig, prefix: str = "text_model.") -> dict:
+    """pytree path -> HF CLIPTextModel tensor name."""
+    m = {
+        "token_embedding.weight":
+            f"{prefix}embeddings.token_embedding.weight",
+        "position_embedding.weight":
+            f"{prefix}embeddings.position_embedding.weight",
+        "final_layer_norm.weight": f"{prefix}final_layer_norm.weight",
+        "final_layer_norm.bias": f"{prefix}final_layer_norm.bias",
+    }
+    for i in range(cfg.num_layers):
+        src = f"{prefix}encoder.layers.{i}."
+        dst = f"layers.{i}."
+        for ln in ("layer_norm1", "layer_norm2"):
+            m[f"{dst}{ln}.weight"] = f"{src}{ln}.weight"
+            m[f"{dst}{ln}.bias"] = f"{src}{ln}.bias"
+        for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            m[f"{dst}{proj}.weight"] = f"{src}self_attn.{proj}.weight"
+            m[f"{dst}{proj}.bias"] = f"{src}self_attn.{proj}.bias"
+        for fc in ("fc1", "fc2"):
+            m[f"{dst}{fc}.weight"] = f"{src}mlp.{fc}.weight"
+            m[f"{dst}{fc}.bias"] = f"{src}mlp.{fc}.bias"
+    return m

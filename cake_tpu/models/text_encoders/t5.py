@@ -1,0 +1,140 @@
+"""T5 encoder (T5-XXL for FLUX.1 sequence conditioning;
+ref: models/flux/t5_encoder.rs).
+
+HF T5EncoderModel semantics: shared token embedding, pre-RMSNorm blocks,
+relative-position-bucket attention bias (learned in block 0, shared by all
+blocks), UNscaled attention scores (T5 folds 1/sqrt(d) into init), gated
+GELU feed-forward (wi_0 * gelu -> wi_1 -> wo), final RMSNorm.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import linear, rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 4096
+    num_layers: int = 24
+    num_heads: int = 64
+    d_kv: int = 64
+    d_ff: int = 10240
+    relative_buckets: int = 32
+    relative_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+
+
+def tiny_t5_config() -> T5Config:
+    return T5Config(vocab_size=96, d_model=32, num_layers=2, num_heads=2,
+                    d_kv=8, d_ff=64, relative_buckets=8,
+                    relative_max_distance=16)
+
+
+def _w(key, dout, din, dtype):
+    return {"weight": jax.random.normal(key, (dout, din), dtype) * 0.02}
+
+
+def init_t5_params(cfg: T5Config, key, dtype=jnp.float32) -> dict:
+    h, inner = cfg.d_model, cfg.num_heads * cfg.d_kv
+    keys = iter(jax.random.split(key, 2 + 7 * cfg.num_layers))
+    p: dict = {
+        "shared": {"weight": jax.random.normal(
+            next(keys), (cfg.vocab_size, h), dtype) * 0.02},
+        "rel_bias": {"weight": jax.random.normal(
+            next(keys), (cfg.relative_buckets, cfg.num_heads), dtype) * 0.02},
+        "blocks": [],
+        "final_layer_norm": {"weight": jnp.ones((h,), dtype)},
+    }
+    for _ in range(cfg.num_layers):
+        p["blocks"].append({
+            "attn_norm": {"weight": jnp.ones((h,), dtype)},
+            "q": _w(next(keys), inner, h, dtype),
+            "k": _w(next(keys), inner, h, dtype),
+            "v": _w(next(keys), inner, h, dtype),
+            "o": _w(next(keys), h, inner, dtype),
+            "ffn_norm": {"weight": jnp.ones((h,), dtype)},
+            "wi_0": _w(next(keys), cfg.d_ff, h, dtype),
+            "wi_1": _w(next(keys), cfg.d_ff, h, dtype),
+            "wo": _w(next(keys), h, cfg.d_ff, dtype),
+        })
+    return p
+
+
+def relative_position_buckets(q_len: int, k_len: int, num_buckets: int,
+                              max_distance: int) -> np.ndarray:
+    """T5 bidirectional relative-position bucketing (host-side, static)."""
+    ctx = np.arange(q_len)[:, None]
+    mem = np.arange(k_len)[None, :]
+    rel = mem - ctx                                  # [q, k]
+    half = num_buckets // 2
+    out = np.where(rel > 0, half, 0)
+    n = np.abs(rel)
+    max_exact = half // 2
+    is_small = n < max_exact
+    log_big = max_exact + (
+        np.log(np.maximum(n, 1) / max_exact)
+        / np.log(max_distance / max_exact) * (half - max_exact)
+    ).astype(np.int64)
+    log_big = np.minimum(log_big, half - 1)
+    return out + np.where(is_small, n, log_big)
+
+
+def _attn(cfg: T5Config, p, x, bias):
+    b, s, _ = x.shape
+    hd, dk = cfg.num_heads, cfg.d_kv
+    q = linear(x, p["q"]["weight"]).reshape(b, s, hd, dk)
+    k = linear(x, p["k"]["weight"]).reshape(b, s, hd, dk)
+    v = linear(x, p["v"]["weight"]).reshape(b, s, hd, dk)
+    # NO 1/sqrt(d) scale: T5 folds it into the weight init
+    scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, hd * dk)
+    return linear(out, p["o"]["weight"])
+
+
+def t5_encode(cfg: T5Config, params: dict, ids):
+    """ids: [B, S] int32 -> hidden states [B, S, d_model]."""
+    s = ids.shape[1]
+    x = params["shared"]["weight"][ids]
+    buckets = jnp.asarray(relative_position_buckets(
+        s, s, cfg.relative_buckets, cfg.relative_max_distance))
+    # [q, k, H] -> [1, H, q, k], f32 to match the score accumulator
+    bias = params["rel_bias"]["weight"][buckets].astype(jnp.float32)
+    bias = bias.transpose(2, 0, 1)[None]
+    eps = cfg.layer_norm_eps
+    for bp in params["blocks"]:
+        h = rms_norm(x, bp["attn_norm"]["weight"], eps)
+        x = x + _attn(cfg, bp, h, bias)
+        h = rms_norm(x, bp["ffn_norm"]["weight"], eps)
+        h = jax.nn.gelu(linear(h, bp["wi_0"]["weight"]), approximate=True) \
+            * linear(h, bp["wi_1"]["weight"])
+        x = x + linear(h, bp["wo"]["weight"])
+    return rms_norm(x, params["final_layer_norm"]["weight"], eps)
+
+
+def t5_mapping(cfg: T5Config, prefix: str = "") -> dict:
+    """pytree path -> HF T5EncoderModel tensor name."""
+    m = {
+        "shared.weight": f"{prefix}shared.weight",
+        "rel_bias.weight": f"{prefix}encoder.block.0.layer.0.SelfAttention."
+                           f"relative_attention_bias.weight",
+        "final_layer_norm.weight": f"{prefix}encoder.final_layer_norm.weight",
+    }
+    for i in range(cfg.num_layers):
+        src = f"{prefix}encoder.block.{i}.layer."
+        dst = f"blocks.{i}."
+        m[f"{dst}attn_norm.weight"] = f"{src}0.layer_norm.weight"
+        for proj in ("q", "k", "v", "o"):
+            m[f"{dst}{proj}.weight"] = f"{src}0.SelfAttention.{proj}.weight"
+        m[f"{dst}ffn_norm.weight"] = f"{src}1.layer_norm.weight"
+        for fc in ("wi_0", "wi_1", "wo"):
+            m[f"{dst}{fc}.weight"] = f"{src}1.DenseReluDense.{fc}.weight"
+    return m

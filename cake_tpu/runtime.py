@@ -94,19 +94,23 @@ def load_config_and_quant(model_dir: str, arch: str | None = None):
 
 
 def build_image_model(model: str, dtype: str = "bf16"):
-    """Image generator for the serve path. 'demo:flux' runs the full
-    pipeline on random weights (zero-egress environments); checkpoint
-    weight-name mapping for FLUX.1/2 release checkpoints is tracked for the
-    next round."""
-    from .models.image import (FluxImageModel, SDImageModel, tiny_flux_config,
+    """Image generator for the serve path: 'demo:flux' / 'demo:sd' run the
+    full pipelines on random weights (zero-egress environments); any other
+    value is a release-checkpoint path (FLUX.1 ComfyUI bundle / BFL split
+    layout — see models/image/flux_loader; ref: flux1.rs load path)."""
+    from .models.image import (FluxImageModel, SDImageModel,
+                               load_flux_image_model, tiny_flux_config,
                                tiny_sd_config)
     if model == "demo:sd":
         return SDImageModel(tiny_sd_config(), dtype=parse_dtype(dtype))
     if model.startswith("demo:"):
         return FluxImageModel(tiny_flux_config(), dtype=parse_dtype(dtype))
-    raise NotImplementedError(
-        f"image checkpoint loading for {model!r} not yet wired; use "
-        f"'demo:flux' for the random-weight pipeline")
+    # local path (dir or single bundle file) passes through; otherwise
+    # resolve like text models (hub id -> cached snapshot)
+    path = os.path.expanduser(model)
+    if not os.path.exists(path):
+        path = resolve_model(model)
+    return load_flux_image_model(path, dtype=parse_dtype(dtype))
 
 
 def build_audio_model(model: str, dtype: str = "bf16"):

@@ -1,0 +1,192 @@
+"""FLUX.1 release-checkpoint loading: synthesize a tiny on-disk bundle
+with the REAL tensor names (ComfyUI layout the reference loads —
+ref: flux/config.rs flux1_prefixes, flux1_model.rs name wiring), then
+load it through the public path and generate an image.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.image import (load_flux_image_model, mmdit_mapping,
+                                   vae_decoder_mapping)
+from cake_tpu.models.image.flux import tiny_flux_config
+from cake_tpu.models.image.flux_loader import (CLIP_PREFIX, T5_PREFIX,
+                                               TRANSFORMER_PREFIX, VAE_PREFIX,
+                                               detect_flux_checkpoint)
+from cake_tpu.models.image.mmdit import init_mmdit_params
+from cake_tpu.models.image.vae import init_vae_decoder_params
+from cake_tpu.models.text_encoders import (clip_mapping, init_clip_params,
+                                           init_t5_params, t5_mapping,
+                                           tiny_clip_config, tiny_t5_config)
+from cake_tpu.utils.mapping import flatten_tree
+from cake_tpu.utils.safetensors_io import save_safetensors
+
+
+def _word_level_tokenizer_json(path, vocab_size):
+    """Minimal tokenizers-format file: whitespace word-level."""
+    vocab = {f"w{i}": i for i in range(vocab_size - 2)}
+    vocab["<unk>"] = vocab_size - 2
+    vocab["<eot>"] = vocab_size - 1
+    tok = {
+        "version": "1.0", "truncation": None, "padding": None,
+        "added_tokens": [], "normalizer": None,
+        "pre_tokenizer": {"type": "Whitespace"},
+        "post_processor": None, "decoder": None,
+        "model": {"type": "WordLevel", "vocab": vocab, "unk_token": "<unk>"},
+    }
+    with open(path, "w") as f:
+        json.dump(tok, f)
+
+
+def synth_bundle(tmp_path, fp8_transformer=False):
+    """Write a tiny ComfyUI-style FLUX bundle + tokenizers + sidecar."""
+    pipe = tiny_flux_config()
+    clip_cfg, t5_cfg = tiny_clip_config(), tiny_t5_config()
+    rng = jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 4)
+    comp = {
+        TRANSFORMER_PREFIX: (
+            mmdit_mapping(pipe.mmdit),
+            init_mmdit_params(pipe.mmdit, ks[0], jnp.float32)),
+        VAE_PREFIX: (
+            vae_decoder_mapping(pipe.vae, "") ,
+            init_vae_decoder_params(pipe.vae, ks[1], jnp.float32)),
+        CLIP_PREFIX + "text_model.": (
+            clip_mapping(clip_cfg, ""),
+            init_clip_params(clip_cfg, ks[2], jnp.float32)),
+        T5_PREFIX: (
+            t5_mapping(t5_cfg, ""),
+            init_t5_params(t5_cfg, ks[3], jnp.float32)),
+    }
+    tensors = {}
+    for prefix, (mapping, params) in comp.items():
+        flat = flatten_tree(params)
+        for path, name in mapping.items():
+            arr = np.asarray(flat[path], np.float32)
+            if fp8_transformer and prefix == TRANSFORMER_PREFIX \
+                    and name.endswith(".weight") and arr.ndim == 2:
+                arr = arr.astype(jnp.float8_e4m3fn)
+            tensors[prefix + name] = arr
+    save_safetensors(str(tmp_path / "model.safetensors"), tensors)
+    # non-shape-derivable dims for the tiny fixtures
+    with open(tmp_path / "flux_config.json", "w") as f:
+        json.dump({"clip": {"num_heads": clip_cfg.num_heads,
+                            "eot_token_id": clip_cfg.eot_token_id},
+                   "t5": {"relative_max_distance":
+                          t5_cfg.relative_max_distance}}, f)
+    _word_level_tokenizer_json(tmp_path / "clip_tokenizer.json",
+                               clip_cfg.vocab_size)
+    _word_level_tokenizer_json(tmp_path / "t5_tokenizer.json",
+                               t5_cfg.vocab_size)
+    return pipe, clip_cfg, t5_cfg
+
+
+# literal spot-checks: one name per pattern family, written out verbatim so
+# a systematic mapping bug cannot hide behind synthesize-with-the-same-map
+EXPECTED_NAMES = [
+    "model.diffusion_model.img_in.weight",
+    "model.diffusion_model.time_in.in_layer.bias",
+    "model.diffusion_model.vector_in.out_layer.weight",
+    "model.diffusion_model.guidance_in.in_layer.weight",
+    "model.diffusion_model.double_blocks.0.img_mod.lin.weight",
+    "model.diffusion_model.double_blocks.1.txt_attn.qkv.bias",
+    "model.diffusion_model.double_blocks.0.img_attn.norm.query_norm.scale",
+    "model.diffusion_model.double_blocks.0.txt_mlp.2.weight",
+    "model.diffusion_model.single_blocks.1.modulation.lin.bias",
+    "model.diffusion_model.single_blocks.0.linear1.weight",
+    "model.diffusion_model.single_blocks.0.norm.key_norm.scale",
+    "model.diffusion_model.final_layer.adaLN_modulation.1.weight",
+    "model.diffusion_model.final_layer.linear.bias",
+    "vae.decoder.conv_in.weight",
+    "vae.decoder.mid.block_1.norm1.weight",
+    "vae.decoder.mid.attn_1.proj_out.bias",
+    "vae.decoder.up.1.block.0.conv1.weight",
+    "vae.decoder.up.1.upsample.conv.weight",
+    "vae.decoder.norm_out.weight",
+    "text_encoders.clip_l.transformer.text_model.embeddings."
+    "token_embedding.weight",
+    "text_encoders.clip_l.transformer.text_model.encoder.layers.0."
+    "self_attn.q_proj.weight",
+    "text_encoders.clip_l.transformer.text_model.encoder.layers.1."
+    "mlp.fc1.bias",
+    "text_encoders.clip_l.transformer.text_model.final_layer_norm.weight",
+    "text_encoders.t5xxl.transformer.shared.weight",
+    "text_encoders.t5xxl.transformer.encoder.block.0.layer.0."
+    "SelfAttention.relative_attention_bias.weight",
+    "text_encoders.t5xxl.transformer.encoder.block.1.layer.1."
+    "DenseReluDense.wi_0.weight",
+    "text_encoders.t5xxl.transformer.encoder.final_layer_norm.weight",
+]
+
+
+def test_bundle_names_and_detection(tmp_path):
+    synth_bundle(tmp_path)
+    from cake_tpu.utils.safetensors_io import index_file
+    names = set(index_file(str(tmp_path / "model.safetensors")).keys())
+    missing = [n for n in EXPECTED_NAMES if n not in names]
+    assert not missing, f"missing checkpoint names: {missing}"
+    ckpt = detect_flux_checkpoint(str(tmp_path))
+    assert ckpt is not None and ckpt.kind == "bundle"
+    assert ckpt.clip is not None and ckpt.t5 is not None
+
+
+def test_load_and_generate(tmp_path):
+    synth_bundle(tmp_path)
+    model = load_flux_image_model(str(tmp_path), dtype=jnp.float32)
+    img = model.generate_image("a tiny test w1 w2", width=32, height=32,
+                               steps=2, seed=0)
+    assert img.size == (32, 32)
+    arr = np.asarray(img)
+    assert arr.shape == (32, 32, 3) and np.isfinite(arr).all()
+
+
+def test_load_fp8_transformer(tmp_path):
+    synth_bundle(tmp_path, fp8_transformer=True)
+    model = load_flux_image_model(str(tmp_path), dtype=jnp.float32)
+    img = model.generate_image("w3 w4", width=16, height=16, steps=1, seed=1)
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_missing_tensor_is_reported(tmp_path):
+    synth_bundle(tmp_path)
+    from cake_tpu.utils.safetensors_io import index_file
+    tensors = {n: np.zeros(r.shape, np.float32) for n, r in
+               index_file(str(tmp_path / "model.safetensors")).items()}
+    victim = "model.diffusion_model.double_blocks.1.img_attn.qkv.weight"
+    del tensors[victim]
+    save_safetensors(str(tmp_path / "model.safetensors"), tensors)
+    with pytest.raises(ValueError, match="img_attn.qkv"):
+        load_flux_image_model(str(tmp_path), dtype=jnp.float32)
+
+
+def test_shape_mismatch_is_reported(tmp_path):
+    synth_bundle(tmp_path)
+    from cake_tpu.utils.safetensors_io import index_file
+    tensors = {n: np.zeros(r.shape, np.float32) for n, r in
+               index_file(str(tmp_path / "model.safetensors")).items()}
+    victim = "model.diffusion_model.txt_in.weight"
+    tensors[victim] = np.zeros((3, 3), np.float32)
+    save_safetensors(str(tmp_path / "model.safetensors"), tensors)
+    with pytest.raises(ValueError, match="txt_in"):
+        load_flux_image_model(str(tmp_path), dtype=jnp.float32)
+
+
+def test_missing_encoders_clear_error(tmp_path):
+    """Transformer+VAE-only bundle must name the missing encoders."""
+    pipe = tiny_flux_config()
+    rng = jax.random.PRNGKey(0)
+    tensors = {}
+    flat = flatten_tree(init_mmdit_params(pipe.mmdit, rng, jnp.float32))
+    for path, name in mmdit_mapping(pipe.mmdit).items():
+        tensors[TRANSFORMER_PREFIX + name] = np.asarray(flat[path],
+                                                        np.float32)
+    flatv = flatten_tree(init_vae_decoder_params(pipe.vae, rng, jnp.float32))
+    for path, name in vae_decoder_mapping(pipe.vae).items():
+        tensors[VAE_PREFIX + name] = np.asarray(flatv[path], np.float32)
+    save_safetensors(str(tmp_path / "model.safetensors"), tensors)
+    with pytest.raises(ValueError, match="text encoders"):
+        load_flux_image_model(str(tmp_path))

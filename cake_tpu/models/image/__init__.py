@@ -9,3 +9,6 @@ from .flux_loader import (Flux1TextEncoder, detect_flux_checkpoint,
                           infer_flux_configs, load_flux_image_model,
                           load_flux_params, mmdit_mapping,
                           vae_decoder_mapping)
+from .sd_loader import (detect_sd_checkpoint, load_sd_image_model,
+                        sd_configs_from_dir, sd_unet_mapping,
+                        sd_vae_decoder_mapping)

@@ -92,22 +92,28 @@ def _resnet_p(ks, cin, cout, tdim, dtype):
     }
 
 
+def _w_only(key, o, i, dtype):
+    return {"weight": jax.random.normal(key, (o, i), dtype) / (i ** 0.5)}
+
+
 def _xattn_p(ks, c, ctx, dtype):
+    # q/k/v carry no bias and the feed-forward is GEGLU (value+gate fused
+    # in one 8c projection) — the real SD transformer-block layout
     return {
         "norm": _norm_p(c, dtype),
         "proj_in": _lin_p(next(ks), c, c, dtype),
         "norm1": _norm_p(c, dtype),
-        "self_q": _lin_p(next(ks), c, c, dtype),
-        "self_k": _lin_p(next(ks), c, c, dtype),
-        "self_v": _lin_p(next(ks), c, c, dtype),
+        "self_q": _w_only(next(ks), c, c, dtype),
+        "self_k": _w_only(next(ks), c, c, dtype),
+        "self_v": _w_only(next(ks), c, c, dtype),
         "self_o": _lin_p(next(ks), c, c, dtype),
         "norm2": _norm_p(c, dtype),
-        "cross_q": _lin_p(next(ks), c, c, dtype),
-        "cross_k": _lin_p(next(ks), c, ctx, dtype),
-        "cross_v": _lin_p(next(ks), c, ctx, dtype),
+        "cross_q": _w_only(next(ks), c, c, dtype),
+        "cross_k": _w_only(next(ks), c, ctx, dtype),
+        "cross_v": _w_only(next(ks), c, ctx, dtype),
         "cross_o": _lin_p(next(ks), c, c, dtype),
         "norm3": _norm_p(c, dtype),
-        "ff1": _lin_p(next(ks), 4 * c, c, dtype),
+        "ff1": _lin_p(next(ks), 8 * c, c, dtype),
         "ff2": _lin_p(next(ks), c, 4 * c, dtype),
         "proj_out": _lin_p(next(ks), c, c, dtype),
     }
@@ -199,20 +205,22 @@ def _xattn(p, x, ctx, heads):
         return layer_norm(t, np_["weight"], np_["bias"], 1e-5)
 
     hn = ln(h, p["norm1"])
-    h = h + linear(_mha(linear(hn, p["self_q"]["weight"], p["self_q"]["bias"]),
-                        linear(hn, p["self_k"]["weight"], p["self_k"]["bias"]),
-                        linear(hn, p["self_v"]["weight"], p["self_v"]["bias"]),
+    h = h + linear(_mha(linear(hn, p["self_q"]["weight"]),
+                        linear(hn, p["self_k"]["weight"]),
+                        linear(hn, p["self_v"]["weight"]),
                         heads),
                    p["self_o"]["weight"], p["self_o"]["bias"])
     hn = ln(h, p["norm2"])
-    h = h + linear(_mha(linear(hn, p["cross_q"]["weight"], p["cross_q"]["bias"]),
-                        linear(ctx, p["cross_k"]["weight"], p["cross_k"]["bias"]),
-                        linear(ctx, p["cross_v"]["weight"], p["cross_v"]["bias"]),
+    h = h + linear(_mha(linear(hn, p["cross_q"]["weight"]),
+                        linear(ctx, p["cross_k"]["weight"]),
+                        linear(ctx, p["cross_v"]["weight"]),
                         heads),
                    p["cross_o"]["weight"], p["cross_o"]["bias"])
     hn = ln(h, p["norm3"])
-    h = h + linear(jax.nn.gelu(linear(hn, p["ff1"]["weight"], p["ff1"]["bias"]),
-                               approximate=True),
+    # GEGLU: one projection yields [value ; gate], output = value * gelu(gate)
+    vg = linear(hn, p["ff1"]["weight"], p["ff1"]["bias"])
+    val, gate = jnp.split(vg, 2, axis=-1)
+    h = h + linear(val * jax.nn.gelu(gate, approximate=True),
                    p["ff2"]["weight"], p["ff2"]["bias"])
     h = linear(h, p["proj_out"]["weight"], p["proj_out"]["bias"])
     return resid_sp + h.transpose(0, 2, 1).reshape(b, c, hh, ww)
@@ -230,12 +238,15 @@ def unet_forward(cfg: UNetConfig, p: dict, x, t, ctx):
     h = conv2d(x, p["conv_in"]["weight"], p["conv_in"]["bias"], padding=1)
     skips = [h]
     for blk in p["down"]:
-        for r, a in zip(blk["res"], blk["attn"]):
+        # mapped loads drop structural Nones entirely — treat a missing
+        # "attn"/"down" the same as an explicit None
+        attns = blk.get("attn") or [None] * len(blk["res"])
+        for r, a in zip(blk["res"], attns):
             h = _resnet(r, h, temb)
             if a is not None:
                 h = _xattn(a, h, ctx, cfg.num_heads)
             skips.append(h)
-        if blk["down"] is not None:
+        if blk.get("down") is not None:
             h = conv2d(h, blk["down"]["weight"], blk["down"]["bias"],
                        stride=2, padding=1)
             skips.append(h)
@@ -243,12 +254,13 @@ def unet_forward(cfg: UNetConfig, p: dict, x, t, ctx):
     h = _xattn(p["mid_attn"], h, ctx, cfg.num_heads)
     h = _resnet(p["mid_res2"], h, temb)
     for blk in p["up"]:
-        for r, a in zip(blk["res"], blk["attn"]):
+        attns = blk.get("attn") or [None] * len(blk["res"])
+        for r, a in zip(blk["res"], attns):
             h = jnp.concatenate([h, skips.pop()], axis=1)
             h = _resnet(r, h, temb)
             if a is not None:
                 h = _xattn(a, h, ctx, cfg.num_heads)
-        if blk["up"] is not None:
+        if blk.get("up") is not None:
             b, c, hh, ww = h.shape
             h = jax.image.resize(h, (b, c, hh * 2, ww * 2), "nearest")
             h = conv2d(h, blk["up"]["weight"], blk["up"]["bias"], padding=1)

@@ -1,0 +1,313 @@
+"""Stable Diffusion release-checkpoint loading (diffusers directory layout —
+the format the reference downloads per component, ref: models/sd/sd.rs
+ModelFile::{Clip,Unet,Vae} + subdir() names).
+
+Expected layout (a standard `diffusers` dump of SD v1.5/2.1-class models):
+    model_dir/
+      unet/config.json + diffusion_pytorch_model.safetensors
+      vae/config.json + diffusion_pytorch_model.safetensors
+      text_encoder/model.safetensors          (HF CLIPTextModel)
+      tokenizer/tokenizer.json | vocab.json+merges.txt
+
+Component configs come from the diffusers config.json files; tensor names
+cover both VAE attention-name generations (to_q/... and query/...).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.mapping import coverage_report, load_mapped_params
+from ...utils.safetensors_io import TensorStorage
+from ..text_encoders import CLIPTextConfig, clip_mapping, clip_text_forward, \
+    init_clip_params
+from .sd import SDPipelineConfig, UNetConfig, init_unet_params
+from .vae import VaeConfig, init_vae_decoder_params
+
+log = logging.getLogger("cake_tpu.sd_loader")
+
+
+def _squeeze_conv(arr: np.ndarray) -> np.ndarray:
+    """[C, C', 1, 1] conv kernel -> [C, C'] linear weight (SD1.x stores the
+    spatial-transformer proj_in/out as 1x1 convs)."""
+    return arr.reshape(arr.shape[0], arr.shape[1]) if arr.ndim == 4 else arr
+
+
+def _expand_conv(arr: np.ndarray) -> np.ndarray:
+    """[C, C'] linear weight -> [C, C', 1, 1] conv kernel (newer diffusers
+    VAE attention stores linears; our mid-attention uses 1x1 convs)."""
+    return arr.reshape(*arr.shape, 1, 1) if arr.ndim == 2 else arr
+
+
+def sd_unet_mapping(cfg: UNetConfig) -> tuple[dict, dict]:
+    """(mapping, transforms): pytree path -> diffusers UNet tensor name."""
+    m: dict[str, str] = {}
+    tr: dict[str, object] = {}
+
+    def conv(dst, src):
+        m[f"{dst}.weight"] = f"{src}.weight"
+        m[f"{dst}.bias"] = f"{src}.bias"
+
+    def resnet(dst, src, has_shortcut):
+        for ours, theirs in (("norm1", "norm1"), ("conv1", "conv1"),
+                             ("time", "time_emb_proj"), ("norm2", "norm2"),
+                             ("conv2", "conv2")):
+            conv(f"{dst}.{ours}", f"{src}.{theirs}")
+        if has_shortcut:
+            conv(f"{dst}.shortcut", f"{src}.conv_shortcut")
+
+    def xattn(dst, src):
+        conv(f"{dst}.norm", f"{src}.norm")
+        for pj in ("proj_in", "proj_out"):
+            conv(f"{dst}.{pj}", f"{src}.{pj}")
+            tr[f"{dst}.{pj}.weight"] = _squeeze_conv
+        t = f"{src}.transformer_blocks.0"
+        for ours, theirs in (("norm1", "norm1"), ("norm2", "norm2"),
+                             ("norm3", "norm3")):
+            conv(f"{dst}.{ours}", f"{t}.{theirs}")
+        for blk, ours in (("attn1", "self"), ("attn2", "cross")):
+            for proj in ("q", "k", "v"):
+                m[f"{dst}.{ours}_{proj}.weight"] = \
+                    f"{t}.{blk}.to_{proj}.weight"
+            conv(f"{dst}.{ours}_o", f"{t}.{blk}.to_out.0")
+        conv(f"{dst}.ff1", f"{t}.ff.net.0.proj")
+        conv(f"{dst}.ff2", f"{t}.ff.net.2")
+
+    conv("conv_in", "conv_in")
+    conv("time_mlp1", "time_embedding.linear_1")
+    conv("time_mlp2", "time_embedding.linear_2")
+    conv("norm_out", "conv_norm_out")
+    conv("conv_out", "conv_out")
+
+    chs = [cfg.base_channels * mlt for mlt in cfg.channel_mults]
+    n_lv = len(chs)
+    cin = cfg.base_channels
+    for lvl, c in enumerate(chs):
+        src = f"down_blocks.{lvl}"
+        dst = f"down.{lvl}"
+        for j in range(cfg.num_res_blocks):
+            resnet(f"{dst}.res.{j}", f"{src}.resnets.{j}", cin != c)
+            if lvl in cfg.attn_levels:
+                xattn(f"{dst}.attn.{j}", f"{src}.attentions.{j}")
+            cin = c
+        if lvl < n_lv - 1:
+            conv(f"{dst}.down", f"{src}.downsamplers.0.conv")
+    resnet("mid_res1", "mid_block.resnets.0", False)
+    xattn("mid_attn", "mid_block.attentions.0")
+    resnet("mid_res2", "mid_block.resnets.1", False)
+    # decoder: up_blocks.0 runs first (mirror of the deepest level); every
+    # up resnet consumes a skip concat, so all have conv_shortcut
+    for k, lvl in enumerate(reversed(range(n_lv))):
+        src = f"up_blocks.{k}"
+        dst = f"up.{k}"
+        for j in range(cfg.num_res_blocks + 1):
+            resnet(f"{dst}.res.{j}", f"{src}.resnets.{j}", True)
+            if lvl in cfg.attn_levels:
+                xattn(f"{dst}.attn.{j}", f"{src}.attentions.{j}")
+        if lvl > 0:
+            conv(f"{dst}.up", f"{src}.upsamplers.0.conv")
+    return m, tr
+
+
+def sd_vae_decoder_mapping(storage, cfg: VaeConfig,
+                           prefix: str = "") -> tuple[dict, dict]:
+    """Diffusers AutoencoderKL decoder names (+post_quant_conv); handles
+    both attention-name generations."""
+    m: dict[str, str] = {}
+    tr: dict[str, object] = {}
+
+    def conv(dst, src):
+        m[f"{dst}.weight"] = f"{src}.weight"
+        m[f"{dst}.bias"] = f"{src}.bias"
+
+    def resnet(dst, src, has_shortcut):
+        for ours, theirs in (("norm1", "norm1"), ("conv1", "conv1"),
+                             ("norm2", "norm2"), ("conv2", "conv2")):
+            conv(f"{dst}.{ours}", f"{src}.{theirs}")
+        if has_shortcut:
+            conv(f"{dst}.shortcut", f"{src}.conv_shortcut")
+
+    d = f"{prefix}decoder."
+    conv("post_quant_conv", f"{prefix}post_quant_conv")
+    conv("conv_in", f"{d}conv_in")
+    resnet("mid_res1", f"{d}mid_block.resnets.0", False)
+    resnet("mid_res2", f"{d}mid_block.resnets.1", False)
+    a = f"{d}mid_block.attentions.0"
+    new_style = f"{a}.to_q.weight" in storage
+    names = (("norm", "group_norm"), ("q", "to_q"), ("k", "to_k"),
+             ("v", "to_v"), ("proj", "to_out.0")) if new_style else \
+            (("norm", "group_norm"), ("q", "query"), ("k", "key"),
+             ("v", "value"), ("proj", "proj_attn"))
+    for ours, theirs in names:
+        conv(f"mid_attn.{ours}", f"{a}.{theirs}")
+        if ours != "norm":
+            tr[f"mid_attn.{ours}.weight"] = _expand_conv
+    chs = [cfg.base_channels * mlt for mlt in cfg.channel_mults]
+    n_lv = len(chs)
+    cin = chs[-1]
+    for k in range(n_lv):                  # up_blocks.0 runs first
+        c = list(reversed(chs))[k]
+        src = f"{d}up_blocks.{k}"
+        for j in range(cfg.num_res_blocks):
+            resnet(f"ups.{k}.res.{j}", f"{src}.resnets.{j}", cin != c)
+            cin = c
+        if k < n_lv - 1:
+            conv(f"ups.{k}.upsample", f"{src}.upsamplers.0.conv")
+    conv("norm_out", f"{d}conv_norm_out")
+    conv("conv_out", f"{d}conv_out")
+    return m, tr
+
+
+# ---------------------------------------------------------------------------
+# Detection + configs from diffusers config.json
+# ---------------------------------------------------------------------------
+
+
+def detect_sd_checkpoint(path: str) -> bool:
+    return (os.path.isdir(path)
+            and os.path.exists(os.path.join(path, "unet", "config.json"))
+            and os.path.exists(os.path.join(path, "vae", "config.json")))
+
+
+def _load_json(*parts):
+    with open(os.path.join(*parts)) as f:
+        return json.load(f)
+
+
+def sd_configs_from_dir(model_dir: str) -> SDPipelineConfig:
+    u = _load_json(model_dir, "unet", "config.json")
+    v = _load_json(model_dir, "vae", "config.json")
+    blocks = u["block_out_channels"]
+    base = blocks[0]
+    attn_levels = tuple(i for i, t in enumerate(u["down_block_types"])
+                        if "CrossAttn" in t)
+    head_dim = u.get("attention_head_dim", 8)
+    if isinstance(head_dim, list):
+        raise NotImplementedError(
+            "per-level attention_head_dim (SD2.x/XL-style UNet) is not yet "
+            "supported; SD v1.5-class checkpoints load fine")
+    unet = UNetConfig(
+        in_channels=u["in_channels"], base_channels=base,
+        channel_mults=tuple(c // base for c in blocks),
+        num_res_blocks=u.get("layers_per_block", 2),
+        attn_levels=attn_levels,
+        # SD1.x convention: attention_head_dim is the HEAD COUNT
+        num_heads=head_dim,
+        context_dim=u["cross_attention_dim"],
+        time_dim=base * 4,
+    )
+    vbase = v["block_out_channels"][0]
+    vae = VaeConfig(
+        latent_channels=v["latent_channels"],
+        base_channels=vbase,
+        channel_mults=tuple(c // vbase for c in v["block_out_channels"]),
+        num_res_blocks=v.get("layers_per_block", 2) + 1,
+        scaling_factor=v.get("scaling_factor", 0.18215),
+        shift_factor=v.get("shift_factor") or 0.0,
+    )
+    return SDPipelineConfig(unet=unet, vae=vae)
+
+
+class SDTextEncoder:
+    """prompt -> (CLIP sequence hidden states, pooled) padded to 77."""
+
+    def __init__(self, cfg: CLIPTextConfig, params: dict, model_dir: str,
+                 dtype=jnp.float32):
+        self.cfg, self.params, self.dtype = cfg, params, dtype
+        tok_json = os.path.join(model_dir, "tokenizer", "tokenizer.json")
+        if os.path.exists(tok_json):
+            from tokenizers import Tokenizer
+            self._tok = Tokenizer.from_file(tok_json)
+            self._hf = None
+        else:
+            from transformers import AutoTokenizer
+            self._hf = AutoTokenizer.from_pretrained(
+                os.path.join(model_dir, "tokenizer"))
+            self._tok = None
+
+        @jax.jit
+        def _encode(p, ids):
+            return clip_text_forward(cfg, p, ids)
+
+        self._encode = _encode
+
+    def __call__(self, prompt: str):
+        n = self.cfg.max_positions
+        if self._tok is not None:
+            ids = self._tok.encode(prompt).ids
+        else:
+            ids = self._hf(prompt)["input_ids"]
+        if len(ids) > n:
+            ids = ids[:n]
+            ids[-1] = self.cfg.eot_token_id
+        ids = ids + [self.cfg.eot_token_id] * (n - len(ids))
+        hidden, pooled = self._encode(self.params,
+                                      jnp.asarray([ids], jnp.int32))
+        return hidden.astype(self.dtype), pooled.astype(self.dtype)
+
+
+def load_sd_image_model(path: str, dtype=jnp.float32):
+    """diffusers-layout SD checkpoint -> ready SDImageModel."""
+    from .sd import SDImageModel
+
+    cfg = sd_configs_from_dir(path)
+    unet_st = TensorStorage.from_model_dir(os.path.join(path, "unet"))
+    um, ut = sd_unet_mapping(cfg.unet)
+    params = {
+        "unet": load_mapped_params(
+            unet_st, um,
+            jax.eval_shape(lambda: init_unet_params(
+                cfg.unet, jax.random.PRNGKey(0), dtype)), dtype,
+            transforms=ut),
+    }
+    coverage_report(unet_st, um)
+    vae_st = TensorStorage.from_model_dir(os.path.join(path, "vae"))
+    vm, vt = sd_vae_decoder_mapping(vae_st, cfg.vae)
+    # VAE stays f32 (quality-sensitive, small)
+    vae_shapes = jax.eval_shape(lambda: init_vae_decoder_params(
+        cfg.vae, jax.random.PRNGKey(0), jnp.float32))
+    # post_quant_conv is a diffusers-only leaf the init template doesn't
+    # have; without it here load_mapped_params would silently drop it
+    lc = cfg.vae.latent_channels
+    vae_shapes["post_quant_conv"] = {
+        "weight": jax.ShapeDtypeStruct((lc, lc, 1, 1), jnp.float32),
+        "bias": jax.ShapeDtypeStruct((lc,), jnp.float32)}
+    params["vae"] = load_mapped_params(vae_st, vm, vae_shapes, jnp.float32,
+                                       transforms=vt)
+    assert "post_quant_conv" in params["vae"]
+    coverage_report(vae_st, vm, ignore=("encoder.", "quant_conv."))
+
+    te_dir = os.path.join(path, "text_encoder")
+    te_cfg_raw = _load_json(te_dir, "config.json") \
+        if os.path.exists(os.path.join(te_dir, "config.json")) else {}
+    clip_cfg = CLIPTextConfig(
+        vocab_size=te_cfg_raw.get("vocab_size", 49408),
+        hidden_size=te_cfg_raw.get("hidden_size", 768),
+        num_layers=te_cfg_raw.get("num_hidden_layers", 12),
+        num_heads=te_cfg_raw.get("num_attention_heads", 12),
+        intermediate_size=te_cfg_raw.get("intermediate_size", 3072),
+        max_positions=te_cfg_raw.get("max_position_embeddings", 77),
+        # NOT config.json's eos_token_id: the published CLIP configs say 2
+        # while the real EOT id is vocab-1 (49407) — HF pools by argmax of
+        # ids, which only works because EOT is the highest id
+        eot_token_id=te_cfg_raw.get("eot_token_id",
+                                    te_cfg_raw.get("vocab_size", 49408) - 1),
+    )
+    clip_st = TensorStorage.from_model_dir(te_dir)
+    cm = clip_mapping(clip_cfg)
+    clip_params = load_mapped_params(
+        clip_st, cm,
+        jax.eval_shape(lambda: init_clip_params(
+            clip_cfg, jax.random.PRNGKey(0), dtype)), dtype)
+    coverage_report(clip_st, cm,
+                    ignore=("text_model.embeddings.position_ids",))
+    encoder = SDTextEncoder(clip_cfg, clip_params, path, dtype)
+    log.info("loaded SD checkpoint: base %d, mults %s, ctx %d",
+             cfg.unet.base_channels, cfg.unet.channel_mults,
+             cfg.unet.context_dim)
+    return SDImageModel(cfg, params=params, text_encoder=encoder, dtype=dtype)

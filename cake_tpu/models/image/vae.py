@@ -110,6 +110,11 @@ def vae_decode(cfg: VaeConfig, p: dict, z):
     [-1, 1] but unbounded (no output activation, matching the real
     decoder) — consumers must clamp when converting to pixels."""
     z = z / cfg.scaling_factor + cfg.shift_factor
+    if "post_quant_conv" in p:
+        # diffusers AutoencoderKL: 1x1 conv between latent and decoder
+        # (absent from the BFL FLUX autoencoder)
+        z = conv2d(z, p["post_quant_conv"]["weight"],
+                   p["post_quant_conv"]["bias"])
     x = conv2d(z, p["conv_in"]["weight"], p["conv_in"]["bias"], padding=1)
     x = _resnet(p["mid_res1"], x)
     x = _mid_attention(p["mid_attn"], x)

@@ -99,7 +99,8 @@ def build_image_model(model: str, dtype: str = "bf16"):
     value is a release-checkpoint path (FLUX.1 ComfyUI bundle / BFL split
     layout — see models/image/flux_loader; ref: flux1.rs load path)."""
     from .models.image import (FluxImageModel, SDImageModel,
-                               load_flux_image_model, tiny_flux_config,
+                               detect_sd_checkpoint, load_flux_image_model,
+                               load_sd_image_model, tiny_flux_config,
                                tiny_sd_config)
     if model == "demo:sd":
         return SDImageModel(tiny_sd_config(), dtype=parse_dtype(dtype))
@@ -110,6 +111,8 @@ def build_image_model(model: str, dtype: str = "bf16"):
     path = os.path.expanduser(model)
     if not os.path.exists(path):
         path = resolve_model(model)
+    if detect_sd_checkpoint(path):
+        return load_sd_image_model(path, dtype=parse_dtype(dtype))
     return load_flux_image_model(path, dtype=parse_dtype(dtype))
 
 

@@ -1,0 +1,188 @@
+"""SD release-checkpoint loading: synthesize a tiny diffusers-layout
+directory (unet/ vae/ text_encoder/ tokenizer/ with real tensor names and
+config.json files — the format the reference downloads per component,
+ref: models/sd/sd.rs ModelFile) and load it through the public path.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.image import (load_sd_image_model, sd_unet_mapping,
+                                   sd_vae_decoder_mapping)
+from cake_tpu.models.image.sd import UNetConfig, init_unet_params
+from cake_tpu.models.image.vae import VaeConfig, init_vae_decoder_params
+from cake_tpu.models.text_encoders import (clip_mapping, init_clip_params,
+                                           tiny_clip_config)
+from cake_tpu.utils.mapping import flatten_tree
+from cake_tpu.utils.safetensors_io import save_safetensors
+from test_flux_load import _word_level_tokenizer_json
+
+TINY_UNET = UNetConfig(base_channels=32, channel_mults=(1, 2),
+                       num_res_blocks=1, attn_levels=(1,), num_heads=2,
+                       context_dim=32, time_dim=128)
+TINY_VAE = VaeConfig(latent_channels=4, base_channels=32, channel_mults=(1, 2),
+                     num_res_blocks=2, scaling_factor=0.18215,
+                     shift_factor=0.0)
+
+
+def _inv_transform(path, name, arr):
+    """Store in checkpoint-native layout: conv kernels where diffusers uses
+    them (proj_in/out; vae post_quant/attention linears stay linear)."""
+    if name.endswith(("proj_in.weight", "proj_out.weight")) \
+            and "transformer" not in name and arr.ndim == 2:
+        return arr.reshape(*arr.shape, 1, 1)
+    return arr
+
+
+def synth_sd_dir(tmp_path):
+    clip_cfg = tiny_clip_config()
+    rng = jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 3)
+
+    os.makedirs(tmp_path / "unet")
+    u_params = init_unet_params(TINY_UNET, ks[0], jnp.float32)
+    um, _ = sd_unet_mapping(TINY_UNET)
+    flat = flatten_tree(u_params)
+    tensors = {}
+    for path, name in um.items():
+        tensors[name] = _inv_transform(path, name, np.asarray(flat[path],
+                                                              np.float32))
+    save_safetensors(str(tmp_path / "unet" /
+                         "diffusion_pytorch_model.safetensors"), tensors)
+    with open(tmp_path / "unet" / "config.json", "w") as f:
+        json.dump({
+            "in_channels": 4, "block_out_channels": [32, 64],
+            "layers_per_block": 1, "cross_attention_dim": 32,
+            "attention_head_dim": 2,
+            "down_block_types": ["DownBlock2D", "CrossAttnDownBlock2D"],
+            "up_block_types": ["CrossAttnUpBlock2D", "UpBlock2D"],
+        }, f)
+
+    os.makedirs(tmp_path / "vae")
+    v_params = init_vae_decoder_params(TINY_VAE, ks[1], jnp.float32)
+    # post_quant_conv is part of the diffusers checkpoint
+    v_params["post_quant_conv"] = {
+        "weight": np.random.default_rng(0).standard_normal(
+            (4, 4, 1, 1)).astype(np.float32) * 0.1,
+        "bias": np.zeros((4,), np.float32)}
+
+    vm, _ = sd_vae_decoder_mapping({}, TINY_VAE)   # old-style names (no to_q)
+    flatv = flatten_tree(v_params)
+    tensors = {}
+    for path, name in vm.items():
+        arr = np.asarray(flatv[path], np.float32)
+        if path.startswith("mid_attn") and not path.endswith("norm.weight") \
+                and not path.endswith("norm.bias") and arr.ndim == 4:
+            arr = arr.reshape(arr.shape[0], arr.shape[1])   # linear-style
+        tensors[name] = arr
+    save_safetensors(str(tmp_path / "vae" /
+                         "diffusion_pytorch_model.safetensors"), tensors)
+    with open(tmp_path / "vae" / "config.json", "w") as f:
+        json.dump({"latent_channels": 4, "block_out_channels": [32, 64],
+                   "layers_per_block": 1, "scaling_factor": 0.18215}, f)
+
+    os.makedirs(tmp_path / "text_encoder")
+    c_params = init_clip_params(clip_cfg, ks[2], jnp.float32)
+    flat_c = flatten_tree(c_params)
+    tensors = {name: np.asarray(flat_c[path], np.float32)
+               for path, name in clip_mapping(clip_cfg).items()}
+    save_safetensors(str(tmp_path / "text_encoder" / "model.safetensors"),
+                     tensors)
+    with open(tmp_path / "text_encoder" / "config.json", "w") as f:
+        json.dump({"vocab_size": clip_cfg.vocab_size,
+                   "hidden_size": clip_cfg.hidden_size,
+                   "num_hidden_layers": clip_cfg.num_layers,
+                   "num_attention_heads": clip_cfg.num_heads,
+                   "intermediate_size": clip_cfg.intermediate_size,
+                   "max_position_embeddings": clip_cfg.max_positions,
+                   "eot_token_id": clip_cfg.eot_token_id}, f)
+
+    os.makedirs(tmp_path / "tokenizer")
+    _word_level_tokenizer_json(tmp_path / "tokenizer" / "tokenizer.json",
+                               clip_cfg.vocab_size)
+
+
+EXPECTED_UNET_NAMES = [
+    "conv_in.weight",
+    "time_embedding.linear_1.weight",
+    "down_blocks.0.resnets.0.time_emb_proj.weight",
+    "down_blocks.0.downsamplers.0.conv.weight",
+    "down_blocks.1.resnets.0.conv_shortcut.weight",
+    "down_blocks.1.attentions.0.proj_in.weight",
+    "down_blocks.1.attentions.0.transformer_blocks.0.attn1.to_q.weight",
+    "down_blocks.1.attentions.0.transformer_blocks.0.attn2.to_out.0.bias",
+    "down_blocks.1.attentions.0.transformer_blocks.0.ff.net.0.proj.weight",
+    "mid_block.resnets.1.conv1.weight",
+    "mid_block.attentions.0.transformer_blocks.0.norm3.weight",
+    "up_blocks.0.resnets.1.conv_shortcut.weight",
+    "up_blocks.0.upsamplers.0.conv.weight",
+    "up_blocks.1.resnets.0.conv1.weight",
+    "conv_norm_out.weight",
+]
+EXPECTED_VAE_NAMES = [
+    "post_quant_conv.weight",
+    "decoder.conv_in.weight",
+    "decoder.mid_block.resnets.0.norm1.weight",
+    "decoder.mid_block.attentions.0.group_norm.weight",
+    "decoder.mid_block.attentions.0.query.weight",
+    "decoder.mid_block.attentions.0.proj_attn.bias",
+    "decoder.up_blocks.0.resnets.0.conv1.weight",
+    "decoder.up_blocks.0.upsamplers.0.conv.weight",
+    "decoder.up_blocks.1.resnets.0.conv_shortcut.weight",
+    "decoder.conv_norm_out.weight",
+]
+
+
+def test_sd_names(tmp_path):
+    synth_sd_dir(tmp_path)
+    from cake_tpu.utils.safetensors_io import index_file
+    unet_names = set(index_file(
+        str(tmp_path / "unet" / "diffusion_pytorch_model.safetensors")))
+    missing = [n for n in EXPECTED_UNET_NAMES if n not in unet_names]
+    assert not missing, f"missing unet names: {missing}"
+    vae_names = set(index_file(
+        str(tmp_path / "vae" / "diffusion_pytorch_model.safetensors")))
+    missing = [n for n in EXPECTED_VAE_NAMES if n not in vae_names]
+    assert not missing, f"missing vae names: {missing}"
+
+
+def test_sd_load_and_generate(tmp_path):
+    synth_sd_dir(tmp_path)
+    model = load_sd_image_model(str(tmp_path), dtype=jnp.float32)
+    # the diffusers-only 1x1 latent conv must survive the mapped load
+    assert "post_quant_conv" in model.params["vae"]
+    img = model.generate_image("w1 w2", width=32, height=32, steps=2, seed=0)
+    assert img.size == (32, 32)
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_sd_img2img(tmp_path):
+    synth_sd_dir(tmp_path)
+    model = load_sd_image_model(str(tmp_path), dtype=jnp.float32)
+    init = np.random.default_rng(0).standard_normal((1, 4, 16, 16)) * 0.1
+    img = model.generate_image("w1", width=32, height=32, steps=3,
+                               init_image=init, strength=0.6, seed=1)
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_sd_runtime_detection(tmp_path):
+    synth_sd_dir(tmp_path)
+    from cake_tpu.runtime import build_image_model
+    model = build_image_model(str(tmp_path), dtype="f32")
+    assert type(model).__name__ == "SDImageModel"
+
+
+def test_sd2_per_level_heads_clear_error(tmp_path):
+    synth_sd_dir(tmp_path)
+    cfg_path = tmp_path / "unet" / "config.json"
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    cfg["attention_head_dim"] = [5, 10]
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    with pytest.raises(NotImplementedError, match="attention_head_dim"):
+        load_sd_image_model(str(tmp_path))

@@ -1,3 +1,4 @@
 from .luxtts import LuxTTS, LuxTTSConfig, tiny_luxtts_config
-from .vibevoice import (AudioOutput, TTSConfig, VibeVoiceTTS,
-                        tiny_tts_config)
+from .vibevoice import (AudioOutput, VibeVoiceConfig, VibeVoiceTTS,
+                        tiny_tts_config, vibevoice_config_from_hf)
+from .vibevoice_loader import detect_vibevoice_checkpoint, load_vibevoice

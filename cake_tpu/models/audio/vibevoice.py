@@ -1,137 +1,350 @@
-"""VibeVoice-style streaming TTS: conditioning LM -> per-frame CFG diffusion
-head (DPM-Solver++) -> streaming acoustic VAE decoder
-(ref: models/vibevoice/{vibevoice.rs,ddpm.rs,vae_decoder.rs}; call stack
-SURVEY §3.5 — 20 ms/frame target, 10 solver steps, CFG 1.3).
+"""VibeVoice streaming TTS — the real release architecture
+(ref: models/vibevoice/{vibevoice.rs,prediction_head.rs,vae_decoder.rs,
+acoustic_connector.rs,eos_classifier.rs,config.rs}; call stack SURVEY §3.5).
 
-Architecture here mirrors the reference's decomposition:
-  * base/TTS LMs are stacks of the SAME generic decoder blocks used by the
-    text models (ref: both LMs are Vec<Box<dyn Forwarder>> and therefore
-    shardable over the cluster; here they are LocalStage-compatible ranges)
-  * diffusion head: AdaLN-modulated MLP predicting acoustic-latent velocity
-    conditioned on the LM hidden state (ref: fused adaln_modulate)
-  * acoustic decoder: causal conv1d stack with transposed-conv upsampling
-    (ref: streaming VAE decoder, fused depthwise_conv1d_bias_ctx)
-  * voice-prompt KV injection: prefill the LM cache with voice-prompt
-    frames before generation (ref: cache.rs:213-218 set_kv)
+Components, matching the published checkpoint structure:
+  * base LM (`model.language_model`) + TTS LM (`model.tts_language_model`):
+    Qwen2-style decoder stacks reusing our common blocks — text windows go
+    base -> (+text type embedding) -> TTS; speech frames go connector ->
+    (+speech type embedding) -> TTS.
+  * diffusion prediction head (`model.prediction_head`): DiT-style blocks
+    with AdaLN modulation + SwiGLU FFN, v-prediction, DPM-Solver++(2M)
+    over a cosine schedule, CFG via a negative TTS stream.
+  * acoustic connector (`model.acoustic_connector`): latent->hidden MLP.
+  * EOS classifier (`tts_eos_classifier`): fc1 -> silu -> fc2 -> sigmoid.
+  * acoustic sigma-VAE decoder (`model.acoustic_tokenizer.decoder`): causal
+    Conv1d/ConvTranspose1d upsampling stages with ConvNeXt-style blocks
+    (channel RMS norm, depthwise k=7 causal conv, gamma residuals).
+  * `model.speech_scaling_factor` / `model.speech_bias_factor` scalars
+    denormalize latents for the VAE.
+
+TPU-first deviations from the reference: decode runs over the full latent
+sequence in one jit (the per-frame streaming conv cache is a GPU-latency
+device; causal left-padding gives identical samples), and LM windows are
+jitted stages over our static KV caches.
 """
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...ops import adaln_modulate, conv_transpose1d, conv1d, linear, rms_norm
+from ...ops import adaln_modulate, conv1d, conv_transpose1d, linear, rms_norm
 from ...ops.diffusion import DpmSolverPP, cfg_combine
+from ...ops.norms import rms_norm_channel
 from ...utils.wav import encode_wav
 from ..common.cache import init_cache
 from ..common.config import ModelConfig, tiny_config
 from ..common.layers import forward_layers, init_params
 
+TEXT_WINDOW = 5      # text tokens per window (ref: TTS_TEXT_WINDOW_SIZE)
+SPEECH_WINDOW = 6    # speech frames per window (ref: TTS_SPEECH_WINDOW_SIZE)
+
 
 @dataclasses.dataclass(frozen=True)
-class TTSConfig:
-    lm: ModelConfig = None                   # conditioning LM (decoder blocks)
-    acoustic_dim: int = 64                   # VAE latent per frame
+class VibeVoiceConfig:
+    lm_base: ModelConfig = None        # model.language_model stack
+    lm_tts: ModelConfig = None         # model.tts_language_model stack
+    acoustic_dim: int = 64             # acoustic_vae_dim
     head_layers: int = 4
-    head_hidden: int = 256
-    vae_channels: tuple[int, ...] = (256, 128, 64)
-    vae_upsample: tuple[int, ...] = (5, 4, 4)   # total hop = 80 samples/frame
+    head_ffn_ratio: float = 3.0
+    head_eps: float = 1e-5
+    ddpm_num_steps: int = 1000
+    solver_steps: int = 10
+    vae_n_filters: int = 32
+    vae_ratios: tuple[int, ...] = (8, 5, 5, 4, 2, 2)   # hop = 3200 @24kHz
+    vae_depths: tuple[int, ...] = (3, 3, 3, 3, 3, 3, 8)
+    vae_eps: float = 1e-6
     sample_rate: int = 24000
     cfg_scale: float = 1.3
-    solver_steps: int = 10
+
+    @property
+    def hidden(self) -> int:
+        return self.lm_tts.hidden_size
+
+    @property
+    def vae_channels(self) -> tuple[int, ...]:
+        """n_filters * 2^(stages-1) halving per stage (7 stages for 6
+        ratios — ref: vae_decoder.rs channel progression)."""
+        n = len(self.vae_ratios) + 1
+        return tuple(self.vae_n_filters * (1 << (n - 1 - i))
+                     for i in range(n))
+
+    @property
+    def hop(self) -> int:
+        return int(np.prod(self.vae_ratios))
 
 
-def tiny_tts_config() -> TTSConfig:
-    return TTSConfig(lm=tiny_config("qwen2"), acoustic_dim=16,
-                     head_layers=2, head_hidden=64,
-                     vae_channels=(32, 16), vae_upsample=(4, 4))
+def vibevoice_config_from_hf(raw: dict) -> VibeVoiceConfig:
+    """Parse the release config.json structure (ref: config.rs
+    VibeVoiceConfig: decoder_config + diffusion_head_config +
+    acoustic_tokenizer_config + tts_backbone_num_hidden_layers)."""
+    dc = raw["decoder_config"]
+    hc = raw["diffusion_head_config"]
+    ac = raw["acoustic_tokenizer_config"]
+
+    def lm_cfg(layers: int, prefix: str) -> ModelConfig:
+        from ..common.config import config_from_hf_dict
+        d = dict(dc)
+        d.update(architectures=["Qwen2ForCausalLM"], num_hidden_layers=layers)
+        cfg = config_from_hf_dict(d)
+        return dataclasses.replace(cfg, model_prefix=prefix)
+
+    ratios = tuple(ac.get("decoder_ratios") or ac["encoder_ratios"])
+    depths_s = ac.get("decoder_depths")
+    if depths_s:
+        # explicit decoder string is in decoder stage order (stage 0 = top
+        # channels) — the published checkpoints ship this field
+        depths = tuple(int(x) for x in depths_s.split("-"))
+    else:
+        # mirror the encoder (ref: vae_decoder.rs parse_depths reverses
+        # encoder_depths) — note this is a different source than the
+        # explicit string above, hence the reversal
+        enc = [int(x) for x in (ac.get("encoder_depths") or "").split("-")
+               if x] or [3] * (len(ratios) + 1)
+        depths = tuple(reversed(enc))
+    return VibeVoiceConfig(
+        lm_base=lm_cfg(dc["num_hidden_layers"], "model.language_model"),
+        lm_tts=lm_cfg(raw["tts_backbone_num_hidden_layers"],
+                      "model.tts_language_model"),
+        acoustic_dim=raw["acoustic_vae_dim"],
+        head_layers=hc["head_layers"],
+        head_ffn_ratio=hc.get("head_ffn_ratio", 3.0),
+        head_eps=hc.get("rms_norm_eps", 1e-5),
+        ddpm_num_steps=hc.get("ddpm_num_steps", 1000),
+        solver_steps=hc.get("ddpm_num_inference_steps", 10),
+        vae_n_filters=ac.get("decoder_n_filters")
+        or ac["encoder_n_filters"],
+        vae_ratios=ratios, vae_depths=depths,
+        vae_eps=ac.get("layernorm_eps", 1e-6),
+    )
 
 
-# -- diffusion prediction head ----------------------------------------------
+def tiny_tts_config() -> VibeVoiceConfig:
+    lm = tiny_config("qwen2")
+    return VibeVoiceConfig(
+        lm_base=dataclasses.replace(lm, model_prefix="model.language_model"),
+        lm_tts=dataclasses.replace(
+            lm, model_prefix="model.tts_language_model"),
+        acoustic_dim=16, head_layers=2, head_ffn_ratio=2.0,
+        vae_n_filters=8, vae_ratios=(4, 4), vae_depths=(1, 1, 1),
+        solver_steps=4,
+    )
 
-def init_head_params(cfg: TTSConfig, key, dtype=jnp.float32):
-    ks = iter(jax.random.split(key, 4 + 3 * cfg.head_layers))
-    h = cfg.head_hidden
 
-    # fan-in-scaled init: random-weight pipelines must keep the conditioning
-    # signal observable end-to-end (std 0.02 makes AdaLN gates ~0 and the
-    # cond path numerically vanishes); checkpoint loads override this anyway
-    def lin(k, o, i):
-        return {"weight": jax.random.normal(k, (o, i), dtype) / (i ** 0.5),
-                "bias": jnp.zeros((o,), dtype)}
-    p = {
-        "in": lin(next(ks), h, cfg.acoustic_dim),
-        "cond": lin(next(ks), h, cfg.lm.hidden_size),
-        "time": lin(next(ks), h, 256),
+# -- diffusion prediction head (ref: prediction_head.rs) ---------------------
+
+
+def vv_timestep_embedding(t):
+    """Sinusoidal embedding of RAW timesteps -> [B, 256] (half=128 fixed)."""
+    half = 128
+    freqs = jnp.exp(jnp.arange(half, dtype=jnp.float32)
+                    * (-math.log(10000.0) / half))
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def init_head_params(cfg: VibeVoiceConfig, key, dtype=jnp.float32) -> dict:
+    h, lat = cfg.hidden, cfg.acoustic_dim
+    inner = int(h * cfg.head_ffn_ratio)
+    ks = iter(jax.random.split(key, 6 + 4 * cfg.head_layers))
+
+    def w(k, o, i):
+        return {"weight": jax.random.normal(k, (o, i), dtype) / (i ** 0.5)}
+
+    return {
+        "t_mlp1": w(next(ks), h, 256),
+        "t_mlp2": w(next(ks), h, h),
+        "noisy_proj": w(next(ks), h, lat),
+        "cond_proj": w(next(ks), h, h),
         "layers": [{
-            "mod": lin(next(ks), 3 * h, h),
-            "fc1": lin(next(ks), 4 * h, h),
-            "fc2": lin(next(ks), h, 4 * h),
+            "norm": {"weight": jnp.ones((h,), dtype)},
+            "ada": w(next(ks), 3 * h, h),
+            "gate_proj": w(next(ks), inner, h),
+            "up_proj": w(next(ks), inner, h),
+            "down_proj": w(next(ks), h, inner),
         } for _ in range(cfg.head_layers)],
-        "out": lin(next(ks), cfg.acoustic_dim, h),
-        "norm": {"weight": jnp.ones((h,), dtype)},
+        "final_ada": w(next(ks), 2 * h, h),
+        "final_linear": w(next(ks), lat, h),
     }
-    return p
 
 
-def head_forward(cfg: TTSConfig, p, x_t, cond, t):
-    """x_t: [B, acoustic_dim] noisy latent; cond: [B, lm_hidden]; t: [B]."""
-    from ..image.mmdit import timestep_embedding
-    h = linear(x_t, p["in"]["weight"], p["in"]["bias"])
-    c = linear(cond, p["cond"]["weight"], p["cond"]["bias"]) \
-        + linear(timestep_embedding(t, 256).astype(h.dtype),
-                 p["time"]["weight"], p["time"]["bias"])
-    for layer in p["layers"]:
-        mod = linear(jax.nn.silu(c), layer["mod"]["weight"],
-                     layer["mod"]["bias"])
+def head_forward(cfg: VibeVoiceConfig, p: dict, x_t, t, cond):
+    """x_t: [B, latent]; t: [B] raw timesteps; cond: [B, hidden].
+    Returns v-prediction [B, latent]."""
+    h = linear(x_t, p["noisy_proj"]["weight"])
+    temb = linear(jax.nn.silu(
+        linear(vv_timestep_embedding(t).astype(x_t.dtype),
+               p["t_mlp1"]["weight"])), p["t_mlp2"]["weight"])
+    c = linear(cond, p["cond_proj"]["weight"]) + temb
+    sc = jax.nn.silu(c)
+    eps = cfg.head_eps
+    for lp in p["layers"]:
+        mod = linear(sc, lp["ada"]["weight"])
         shift, scale, gate = jnp.split(mod, 3, axis=-1)
-        hh = adaln_modulate(rms_norm(h, p["norm"]["weight"]), shift, scale)
-        hh = linear(jax.nn.silu(linear(hh, layer["fc1"]["weight"],
-                                       layer["fc1"]["bias"])),
-                    layer["fc2"]["weight"], layer["fc2"]["bias"])
+        hh = adaln_modulate(rms_norm(h, lp["norm"]["weight"], eps),
+                            shift, scale)
+        hh = linear(jax.nn.silu(linear(hh, lp["gate_proj"]["weight"]))
+                    * linear(hh, lp["up_proj"]["weight"]),
+                    lp["down_proj"]["weight"])
         h = h + gate * hh
-    return linear(h, p["out"]["weight"], p["out"]["bias"])
+    mod = linear(sc, p["final_ada"]["weight"])
+    shift, scale = jnp.split(mod, 2, axis=-1)
+    ones = jnp.ones((cfg.hidden,), h.dtype)   # norm_final has no affine
+    hh = adaln_modulate(rms_norm(h, ones, eps), shift, scale)
+    return linear(hh, p["final_linear"]["weight"])
 
 
-# -- streaming acoustic decoder ---------------------------------------------
+# -- acoustic connector + EOS classifier -------------------------------------
 
-def init_vae_decoder_params(cfg: TTSConfig, key, dtype=jnp.float32):
-    ks = iter(jax.random.split(key, 2 * len(cfg.vae_channels) + 2))
-    chans = [cfg.acoustic_dim, *cfg.vae_channels]
-    p = {"ups": []}
-    for i, up in enumerate(cfg.vae_upsample):
-        cin, cout = chans[i], chans[i + 1]
-        p["ups"].append({
-            "tconv": {"weight": jax.random.normal(
-                next(ks), (cin, cout, 2 * up), dtype) * 0.05,
-                "bias": jnp.zeros((cout,), dtype)},
-            "conv": {"weight": jax.random.normal(
-                next(ks), (cout, cout, 3), dtype) * 0.05,
-                "bias": jnp.zeros((cout,), dtype)},
-        })
-    p["out"] = {"weight": jax.random.normal(
-        next(ks), (1, chans[len(cfg.vae_upsample)], 3), dtype) * 0.05,
-        "bias": jnp.zeros((1,), dtype)}
+
+def init_connector_params(cfg: VibeVoiceConfig, key, dtype=jnp.float32,
+                          bias: bool = True) -> dict:
+    h, lat = cfg.hidden, cfg.acoustic_dim
+    k1, k2 = jax.random.split(key)
+    p = {"fc1": {"weight": jax.random.normal(k1, (h, lat), dtype) * 0.02},
+         "norm": {"weight": jnp.ones((h,), dtype)},
+         "fc2": {"weight": jax.random.normal(k2, (h, h), dtype) * 0.02}}
+    if bias:
+        p["fc1"]["bias"] = jnp.zeros((h,), dtype)
+        p["fc2"]["bias"] = jnp.zeros((h,), dtype)
     return p
 
 
-def vae_decode_frames(cfg: TTSConfig, p, latents):
-    """latents: [B, T, acoustic_dim] -> waveform [B, T * hop] in [-1, 1]."""
-    x = latents.transpose(0, 2, 1)                  # [B, D, T]
-    # strides come from the STATIC config, not the traced params pytree
-    for blk, up in zip(p["ups"], cfg.vae_upsample):
-        x = conv_transpose1d(x, blk["tconv"]["weight"], blk["tconv"]["bias"],
-                             stride=up, padding=up // 2)
-        x = jax.nn.silu(x)
-        x = jax.nn.silu(conv1d(x, blk["conv"]["weight"], blk["conv"]["bias"],
-                               padding=1))
-    return jnp.tanh(conv1d(x, p["out"]["weight"], p["out"]["bias"],
-                           padding=1))[:, 0]
+def connector_forward(cfg: VibeVoiceConfig, p: dict, latent):
+    h = linear(latent, p["fc1"]["weight"], p["fc1"].get("bias"))
+    h = rms_norm(h, p["norm"]["weight"], cfg.lm_tts.rms_norm_eps)
+    return linear(h, p["fc2"]["weight"], p["fc2"].get("bias"))
+
+
+def init_eos_params(cfg: VibeVoiceConfig, key, dtype=jnp.float32,
+                    inner: int | None = None) -> dict:
+    h = cfg.hidden
+    inner = inner or h
+    k1, k2 = jax.random.split(key)
+    return {"fc1": {"weight": jax.random.normal(k1, (inner, h), dtype) * 0.02,
+                    "bias": jnp.zeros((inner,), dtype)},
+            "fc2": {"weight": jax.random.normal(k2, (1, inner), dtype) * 0.02,
+                    "bias": jnp.zeros((1,), dtype)}}
+
+
+def eos_probability(p: dict, cond):
+    h = jax.nn.silu(linear(cond, p["fc1"]["weight"], p["fc1"]["bias"]))
+    logit = linear(h, p["fc2"]["weight"], p["fc2"]["bias"])
+    return jax.nn.sigmoid(logit.astype(jnp.float32))
+
+
+# -- acoustic sigma-VAE decoder (ref: vae_decoder.rs) ------------------------
+
+
+def init_vae_decoder_params(cfg: VibeVoiceConfig, key,
+                            dtype=jnp.float32) -> dict:
+    chans = cfg.vae_channels
+    ks = iter(jax.random.split(key, 4 + 2 * len(chans)
+                               + 8 * sum(cfg.vae_depths)))
+
+    def conv_p(k, co, ci, kk):
+        return {"weight": jax.random.normal(k, (co, ci, kk), dtype) * 0.05,
+                "bias": jnp.zeros((co,), dtype)}
+
+    def block_p(c):
+        inner = 4 * c
+        return {
+            "norm": {"weight": jnp.ones((c,), dtype)},
+            "gamma": jnp.full((c,), 0.1, dtype),
+            "mixer": {"weight": jax.random.normal(next(ks), (c, 1, 7),
+                                                  dtype) * 0.1,
+                      "bias": jnp.zeros((c,), dtype)},
+            "ffn_norm": {"weight": jnp.ones((c,), dtype)},
+            "ffn_gamma": jnp.full((c,), 0.1, dtype),
+            "ffn1": {"weight": jax.random.normal(next(ks), (inner, c),
+                                                 dtype) * 0.05,
+                     "bias": jnp.zeros((inner,), dtype)},
+            "ffn2": {"weight": jax.random.normal(next(ks), (c, inner),
+                                                 dtype) * 0.05,
+                     "bias": jnp.zeros((c,), dtype)},
+        }
+
+    p: dict = {"up": [conv_p(next(ks), chans[0], cfg.acoustic_dim, 7)]}
+    for i, r in enumerate(cfg.vae_ratios):
+        # ConvTranspose1d weight is [in, out, k] (torch convention)
+        p["up"].append({"weight": jax.random.normal(
+            next(ks), (chans[i], chans[i + 1], 2 * r), dtype) * 0.05,
+            "bias": jnp.zeros((chans[i + 1],), dtype)})
+    p["stages"] = [[block_p(chans[i]) for _ in range(cfg.vae_depths[i])]
+                   for i in range(len(chans))]
+    p["head"] = conv_p(next(ks), 1, chans[-1], 7)
+    return p
+
+
+def _causal_pad(x, amount: int):
+    return jnp.pad(x, ((0, 0), (0, 0), (amount, 0)))
+
+
+def _decoder_block(cfg: VibeVoiceConfig, p: dict, x):
+    """ConvNeXt-style: channel-RMS -> depthwise causal k7 conv -> gamma
+    residual; channel-RMS -> FFN(gelu) -> gamma residual."""
+    c = x.shape[1]
+    h = rms_norm_channel(x, p["norm"]["weight"], cfg.vae_eps)
+    h = conv1d(_causal_pad(h, 6), p["mixer"]["weight"], p["mixer"]["bias"],
+               groups=c)
+    x = x + p["gamma"][None, :, None] * h
+    h = rms_norm_channel(x, p["ffn_norm"]["weight"], cfg.vae_eps)
+    h = h.transpose(0, 2, 1)
+    h = linear(h, p["ffn1"]["weight"], p["ffn1"]["bias"])
+    h = jax.nn.gelu(h, approximate=False)
+    h = linear(h, p["ffn2"]["weight"], p["ffn2"]["bias"])
+    return x + p["ffn_gamma"][None, :, None] * h.transpose(0, 2, 1)
+
+
+def vae_decode_frames(cfg: VibeVoiceConfig, p: dict, latents):
+    """latents: [B, T, acoustic_dim] (denormalized) -> waveform [B, T*hop]."""
+    x = latents.transpose(0, 2, 1)                     # [B, D, T]
+    for i, up in enumerate(p["up"]):
+        if i == 0:
+            x = conv1d(_causal_pad(x, 6), up["weight"], up["bias"])
+        else:
+            r = cfg.vae_ratios[i - 1]
+            x = conv_transpose1d(x, up["weight"], up["bias"], stride=r)
+            x = x[:, :, :-r]                           # causal right-trim
+        for blk in p["stages"][i]:
+            x = _decoder_block(cfg, blk, x)
+    x = conv1d(_causal_pad(x, 6), p["head"]["weight"], p["head"]["bias"])
+    return x[:, 0]
+
+
+# -- voice prompt (precomputed KV caches, ref: voice_prompt.rs) --------------
+
+
+def inject_voice_kv(cache: dict, kv: list[tuple[np.ndarray, np.ndarray]],
+                    dtype) -> dict:
+    """Scatter per-layer (key, value) [1, Hkv, S, D] prompt tensors into a
+    fresh cache at positions 0..S-1 (ref: cache.rs set_kv)."""
+    layers = list(cache["layers"])
+    seq = 0
+    for i, (k, v) in enumerate(kv):
+        k = jnp.asarray(k).astype(dtype).transpose(0, 2, 1, 3)  # [1,S,H,D]
+        v = jnp.asarray(v).astype(dtype).transpose(0, 2, 1, 3)
+        seq = k.shape[1]
+        if seq > layers[i]["k"].shape[1]:
+            raise ValueError(
+                f"voice prompt ({seq} positions) exceeds cache "
+                f"({layers[i]['k'].shape[1]} slots)")
+        lc = layers[i]
+        pos = jnp.arange(seq, dtype=jnp.int32)[None]
+        layers[i] = {
+            "k": lc["k"].at[:, :seq].set(k),
+            "v": lc["v"].at[:, :seq].set(v),
+            "pos": lc["pos"].at[:, :seq].set(pos),
+        }
+    return {"layers": layers, "pos": jnp.asarray(seq, jnp.int32)}
 
 
 # -- facade ------------------------------------------------------------------
+
 
 @dataclasses.dataclass
 class AudioOutput:
@@ -148,9 +361,16 @@ class AudioOutput:
 
 
 class VibeVoiceTTS:
-    """AudioGenerator facade: generate_speech(text) -> AudioOutput."""
+    """AudioGenerator facade: generate_speech(text) -> AudioOutput.
 
-    def __init__(self, cfg: TTSConfig, params: dict | None = None,
+    Interleaved generation (ref: vibevoice.rs generate): windows of up to
+    5 text tokens feed base LM -> (+text type) -> TTS LM; then up to 6
+    speech frames are diffused, denormalized into the latent buffer, and
+    fed back through the TTS LM pos+neg streams via the connector
+    (+speech type) until EOS or max_frames.
+    """
+
+    def __init__(self, cfg: VibeVoiceConfig, params: dict | None = None,
                  tokenizer=None, dtype=jnp.float32, seed: int = 0,
                  max_frames: int = 256):
         self.cfg = cfg
@@ -158,96 +378,232 @@ class VibeVoiceTTS:
         self.tokenizer = tokenizer
         self.max_frames = max_frames
         if params is None:
-            ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+            ks = jax.random.split(jax.random.PRNGKey(seed), 8)
             params = {
-                "lm": init_params(cfg.lm, ks[0], dtype),
-                "latent_in": {"weight": jax.random.normal(
-                    ks[3], (cfg.lm.hidden_size, cfg.acoustic_dim), dtype) * 0.02},
-                "head": init_head_params(cfg, ks[1], dtype),
-                "vae": init_vae_decoder_params(cfg, ks[2], dtype),
-                "eos": {"weight": jax.random.normal(
-                    ks[4], (1, cfg.lm.hidden_size), dtype) * 0.02},
+                "base": init_params(cfg.lm_base, ks[0], dtype),
+                "tts": init_params(cfg.lm_tts, ks[1], dtype),
+                "input_types": {"weight": jax.random.normal(
+                    ks[2], (2, cfg.hidden), dtype) * 0.02},
+                "head": init_head_params(cfg, ks[3], dtype),
+                "connector": init_connector_params(cfg, ks[4], dtype),
+                "eos": init_eos_params(cfg, ks[5], dtype),
+                "vae": init_vae_decoder_params(cfg, ks[6], dtype),
+                "speech_scaling_factor": jnp.asarray(1.0, jnp.float32),
+                "speech_bias_factor": jnp.asarray(0.0, jnp.float32),
             }
         self.params = params
-        self.scheduler = DpmSolverPP.from_betas()
+        self.scheduler = DpmSolverPP.from_cosine(n=cfg.ddpm_num_steps)
 
-        lm_cfg = cfg.lm
+        base_cfg, tts_cfg = cfg.lm_base, cfg.lm_tts
 
         @jax.jit
-        def _lm_step(lm_params, x, cache, pos):
-            return forward_layers(lm_cfg, lm_params, x, cache, pos)
+        def _base_fwd(p, x, cache, pos):
+            x, cache = forward_layers(base_cfg, p, x, cache, pos)
+            return rms_norm(x, p["norm"]["weight"],
+                            base_cfg.rms_norm_eps), cache
 
-        self._lm_step = _lm_step
-        self._head = jax.jit(lambda p, x, c, t: head_forward(cfg, p, x, c, t))
+        @jax.jit
+        def _tts_fwd(p, x, cache, pos):
+            x, cache = forward_layers(tts_cfg, p, x, cache, pos)
+            return rms_norm(x, p["norm"]["weight"],
+                            tts_cfg.rms_norm_eps), cache
+
+        self._base_fwd = _base_fwd
+        self._tts_fwd = _tts_fwd
+        self._head = jax.jit(
+            lambda p, x, t, c: head_forward(cfg, p, x, t, c))
         self._decode = jax.jit(lambda p, l: vae_decode_frames(cfg, p, l))
+        self._connector = jax.jit(
+            lambda p, l: connector_forward(cfg, p, l))
 
-    def _fresh(self):
-        return init_cache(self.cfg.lm, 1, self.max_frames + 16, self.dtype)
+    # -- internals ----------------------------------------------------------
 
-    def generate_speech(self, text: str, voice=None, voice_wav: bytes | None = None,
-                        cfg_scale: float | None = None, steps: int | None = None,
-                        seed: int = 0, max_frames: int | None = None,
+    def _fresh(self, which: str, cache_len: int):
+        lm = self.cfg.lm_base if which == "base" else self.cfg.lm_tts
+        return init_cache(lm, 1, cache_len, self.dtype)
+
+    def _type_embed(self, idx: int):
+        return self.params["input_types"]["weight"][idx][None, None, :]
+
+    def _sample_latent(self, cond_pos, cond_neg, scale, steps, rng):
+        """Batched-CFG diffusion of one acoustic frame (ref:
+        sample_speech_latent — pos+neg through one head call)."""
+        cfg = self.cfg
+        cond = jnp.concatenate([cond_pos, cond_neg], axis=0)
+        sch = self.scheduler
+        sch.reset()
+        x = jax.random.normal(rng, (1, cfg.acoustic_dim), self.dtype)
+        ts = sch.timesteps(steps)
+        for j, t in enumerate(ts):
+            tv = jnp.full((2,), float(t), jnp.float32)
+            v2 = self._head(self.params["head"],
+                            jnp.concatenate([x, x], axis=0), tv, cond)
+            v = cfg_combine(v2[1:], v2[:1], scale)
+            t_next = int(ts[j + 1]) if j + 1 < len(ts) else 0
+            x = sch.step(v, int(t), t_next, x)
+        return x
+
+    # -- public -------------------------------------------------------------
+
+    def generate_speech(self, text: str, voice=None,
+                        voice_wav: bytes | None = None,
+                        cfg_scale: float | None = None,
+                        steps: int | None = None, seed: int = 0,
+                        max_frames: int | None = None,
                         on_frame=None) -> AudioOutput:
         cfg = self.cfg
         scale = cfg.cfg_scale if cfg_scale is None else cfg_scale
         steps = cfg.solver_steps if steps is None else steps
-        max_frames = max_frames or min(self.max_frames,
-                                       8 + len(text) // 2)
+        max_frames = max_frames or min(self.max_frames, 8 + len(text) // 2)
         rng = jax.random.PRNGKey(seed)
 
-        # conditioning state: pos stream (text-conditioned via a hash-seeded
-        # start frame until a text encoder is wired) + neg stream for CFG
-        # (ref: CFG pos+neg LM streams)
-        cache_pos, cache_neg = self._fresh(), self._fresh()
-        import zlib
-        tseed = zlib.crc32(text.encode())   # stable across processes
-        frame = jax.random.normal(jax.random.PRNGKey(tseed),
-                                  (1, cfg.acoustic_dim), self.dtype) * 0.1
-        # voice-prompt KV injection: encode prompt audio frames into the cache
-        if voice_wav is not None:
-            from ...utils.wav import decode_wav
-            samples, _ = decode_wav(voice_wav)
-            n = max(1, min(8, len(samples) // 2000))
-            vp = jnp.asarray(samples[:n * cfg.acoustic_dim
-                                     ].reshape(1, -1, cfg.acoustic_dim)
-                             if len(samples) >= n * cfg.acoustic_dim
-                             else np.zeros((1, 1, cfg.acoustic_dim)),
-                             self.dtype)
-            x = linear(vp, self.params["latent_in"]["weight"])
-            _, cache_pos = self._lm_step(self.params["lm"], x, cache_pos,
-                                         jnp.asarray(0, jnp.int32))
+        token_ids = self._encode_text(text)
 
-        latents = []
-        for i in range(max_frames):
-            x = linear(frame[:, None, :], self.params["latent_in"]["weight"])
-            h_pos, cache_pos = self._lm_step(self.params["lm"], x, cache_pos,
-                                             cache_pos["pos"])
-            h_neg, cache_neg = self._lm_step(self.params["lm"],
-                                             jnp.zeros_like(x), cache_neg,
-                                             cache_neg["pos"])
-            cond_p, cond_n = h_pos[:, -1], h_neg[:, -1]
+        # resolve the voice prompt BEFORE sizing caches: injected prompt KV
+        # occupies positions 0..S-1, so the static cache must cover S too
+        vp = None
+        if voice is not None:
+            import os
+            if os.path.exists(str(voice)):
+                vp = load_voice_prompt(str(voice))
+            else:
+                # OpenAI-style voice names ("alloy", ...) have no prompt
+                # file here — accept and ignore, like the pre-clone path
+                import logging
+                logging.getLogger("cake_tpu.vibevoice").warning(
+                    "voice %r is not a voice-prompt file; ignoring", voice)
+        vseq = max((kv[0].shape[2] for kv in vp["tts_lm"]), default=0) \
+            if vp else 0
+        # rounded up so jitted LM stages compile per 64-bucket, not per text
+        cache_len = -(-max(64, vseq + len(token_ids) + max_frames + 80)
+                      // 64) * 64
+        base_cache = self._fresh("base", cache_len)
+        tts_cache = self._fresh("tts", cache_len)
+        neg_cache = self._fresh("tts", cache_len)
+        neg_cond = jnp.zeros((1, cfg.hidden), self.dtype)
 
-            # per-frame diffusion: DPM-Solver++ with CFG
-            self.scheduler.reset()
-            rng, k = jax.random.split(rng)
-            x_t = jax.random.normal(k, (1, cfg.acoustic_dim), self.dtype)
-            ts = self.scheduler.timesteps(steps)
-            for j, t in enumerate(ts):
-                tv = jnp.asarray([t / self.scheduler.T], jnp.float32)
-                vp_ = self._head(self.params["head"], x_t, cond_p, tv)
-                vn_ = self._head(self.params["head"], x_t, cond_n, tv)
-                v = cfg_combine(vn_, vp_, scale)
-                t_next = int(ts[j + 1]) if j + 1 < len(ts) else 0
-                x_t = self.scheduler.step(v, int(t), t_next, x_t)
-            frame = x_t
-            latents.append(np.asarray(frame[0]))
-            if on_frame:
-                on_frame(i + 1)
-            # EOS classifier on the conditioning state (ref: EOS classifier)
-            eos_logit = float(linear(cond_p, self.params["eos"]["weight"])[0, 0])
-            if i >= 2 and eos_logit > 4.0:
+        if vp is not None:
+            base_cache = inject_voice_kv(base_cache, vp["lm"], self.dtype)
+            tts_cache = inject_voice_kv(tts_cache, vp["tts_lm"], self.dtype)
+            neg_cache = inject_voice_kv(neg_cache, vp["neg_tts_lm"],
+                                        self.dtype)
+            neg_cond = jnp.asarray(vp["neg_hidden"][:, -1]).astype(self.dtype)
+        elif voice_wav is not None:
+            # no VAE encoder in the realtime variant: approximate speaker
+            # conditioning by folding prompt samples into latent frames
+            # (documented deviation; precomputed prompts give parity)
+            base_cache, tts_cache = self._approx_voice(voice_wav, base_cache,
+                                                       tts_cache)
+
+        text_type = self._type_embed(1)
+        speech_type = self._type_embed(0)
+        sf = float(self.params["speech_scaling_factor"])
+        bf = float(self.params["speech_bias_factor"])
+
+        latents: list[np.ndarray] = []
+        cursor = 0
+        pos_last = None
+        while len(latents) < max_frames:
+            # -- text window -------------------------------------------------
+            window = token_ids[cursor:cursor + TEXT_WINDOW]
+            if window:
+                emb = self.params["base"]["embed_tokens"]["weight"][
+                    jnp.asarray([window], jnp.int32)].astype(self.dtype)
+                h, base_cache = self._base_fwd(self.params["base"], emb,
+                                               base_cache, base_cache["pos"])
+                h = h + text_type.astype(self.dtype)
+                h, tts_cache = self._tts_fwd(self.params["tts"], h,
+                                             tts_cache, tts_cache["pos"])
+                pos_last = h
+                cursor += len(window)
+            if pos_last is None:
+                break
+            # -- speech window ----------------------------------------------
+            n_frames = SPEECH_WINDOW if cursor < len(token_ids) \
+                else max_frames - len(latents)
+            stop = False
+            for _ in range(n_frames):
+                if len(latents) >= max_frames:
+                    break
+                cond = pos_last[:, -1]
+                rng, k = jax.random.split(rng)
+                latent = self._sample_latent(cond, neg_cond, scale, steps, k)
+                latents.append(np.asarray(latent[0] / sf - bf, np.float32))
+                if on_frame:
+                    on_frame(len(latents))
+                if len(latents) >= 3 and float(
+                        eos_probability(self.params["eos"], cond)[0, 0]) > 0.9:
+                    stop = True
+                    break
+                emb = self._connector(self.params["connector"], latent)
+                emb = emb[:, None, :] + speech_type.astype(self.dtype)
+                pos_last, tts_cache = self._tts_fwd(
+                    self.params["tts"], emb, tts_cache, tts_cache["pos"])
+                hneg, neg_cache = self._tts_fwd(
+                    self.params["tts"], emb, neg_cache, neg_cache["pos"])
+                neg_cond = hneg[:, -1]
+            if stop or (cursor >= len(token_ids)):
                 break
 
+        if not latents:
+            return AudioOutput(samples=np.zeros(0, np.float32),
+                               sample_rate=cfg.sample_rate)
         lat = jnp.asarray(np.stack(latents)[None], self.dtype)
-        wav = np.asarray(self._decode(self.params["vae"], lat)[0])
-        return AudioOutput(samples=wav, sample_rate=cfg.sample_rate)
+        wav = np.asarray(self._decode(self.params["vae"], lat)[0],
+                         np.float32)
+        return AudioOutput(samples=np.clip(wav, -1.0, 1.0),
+                           sample_rate=cfg.sample_rate)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _encode_text(self, text: str) -> list[int]:
+        if self.tokenizer is not None:
+            enc = self.tokenizer.encode(text)
+            return list(enc.ids if hasattr(enc, "ids") else enc)
+        # demo fallback: deterministic hash tokens in-vocab
+        import zlib
+        v = self.cfg.lm_base.vocab_size
+        return [(zlib.crc32(f"{text}:{i}".encode()) % (v - 4)) + 2
+                for i in range(min(32, max(4, len(text) // 3)))]
+
+    def _approx_voice(self, voice_wav: bytes, base_cache, tts_cache):
+        from ...utils.wav import decode_wav
+        cfg = self.cfg
+        samples, _ = decode_wav(voice_wav)
+        n = max(1, min(8, len(samples) // max(cfg.hop, 1)))
+        need = n * cfg.acoustic_dim
+        if len(samples) < need:
+            samples = np.pad(samples, (0, need - len(samples)))
+        frames = jnp.asarray(samples[:need].reshape(1, n, cfg.acoustic_dim),
+                             self.dtype)
+        emb = self._connector(self.params["connector"], frames)
+        emb = emb + self._type_embed(0).astype(self.dtype)
+        _, base_cache = self._base_fwd(self.params["base"], emb, base_cache,
+                                       base_cache["pos"])
+        _, tts_cache = self._tts_fwd(self.params["tts"], emb, tts_cache,
+                                     tts_cache["pos"])
+        return base_cache, tts_cache
+
+
+def load_voice_prompt(path: str) -> dict:
+    """Load a precomputed voice-prompt safetensors file
+    ({lm,tts_lm,neg_lm,neg_tts_lm}.{last_hidden_state,kv.N.{key,value}} —
+    ref: voice_prompt.rs format)."""
+    from ...utils.safetensors_io import TensorStorage, index_file
+    st = TensorStorage(index_file(path))
+
+    def kv_list(prefix: str):
+        out = []
+        i = 0
+        while f"{prefix}.kv.{i}.key" in st:
+            out.append((st.read(f"{prefix}.kv.{i}.key"),
+                        st.read(f"{prefix}.kv.{i}.value")))
+            i += 1
+        return out
+
+    return {
+        "lm": kv_list("lm"),
+        "tts_lm": kv_list("tts_lm"),
+        "neg_tts_lm": kv_list("neg_tts_lm"),
+        "neg_hidden": st.read("neg_tts_lm.last_hidden_state"),
+    }

@@ -1,0 +1,176 @@
+"""VibeVoice release-checkpoint loading.
+
+Expected layout: an HF-style model directory with config.json (the
+VibeVoice structure: decoder_config / diffusion_head_config /
+acoustic_tokenizer_config / tts_backbone_num_hidden_layers) and
+safetensors holding (ref: vibevoice.rs load prefixes):
+    model.language_model.*            base Qwen2 LM
+    model.tts_language_model.*        TTS Qwen2 LM
+    model.tts_input_types.weight      [2, hidden] type embeddings
+    model.prediction_head.*           diffusion head
+    model.acoustic_connector.*        latent->hidden MLP
+    model.acoustic_tokenizer.decoder.* sigma-VAE decoder
+    model.speech_scaling_factor / model.speech_bias_factor   scalars
+    tts_eos_classifier.*              EOS head (no model. prefix)
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.loaders import ParamLoader
+from ...utils.mapping import coverage_report, load_mapped_params
+from ...utils.quant import NoQuantization
+from ...utils.safetensors_io import TensorStorage
+from .vibevoice import (VibeVoiceConfig, VibeVoiceTTS, init_connector_params,
+                        init_eos_params, init_head_params,
+                        init_vae_decoder_params, vibevoice_config_from_hf)
+
+log = logging.getLogger("cake_tpu.vibevoice_loader")
+
+HEAD_PREFIX = "model.prediction_head."
+VAE_PREFIX = "model.acoustic_tokenizer.decoder."
+CONNECTOR_PREFIX = "model.acoustic_connector."
+EOS_PREFIX = "tts_eos_classifier."
+
+
+def head_mapping(cfg: VibeVoiceConfig,
+                 prefix: str = HEAD_PREFIX) -> dict[str, str]:
+    m = {
+        "t_mlp1.weight": f"{prefix}t_embedder.mlp.0.weight",
+        "t_mlp2.weight": f"{prefix}t_embedder.mlp.2.weight",
+        "noisy_proj.weight": f"{prefix}noisy_images_proj.weight",
+        "cond_proj.weight": f"{prefix}cond_proj.weight",
+        "final_ada.weight": f"{prefix}final_layer.adaLN_modulation.1.weight",
+        "final_linear.weight": f"{prefix}final_layer.linear.weight",
+    }
+    for i in range(cfg.head_layers):
+        src = f"{prefix}layers.{i}."
+        dst = f"layers.{i}."
+        m[f"{dst}norm.weight"] = f"{src}norm.weight"
+        m[f"{dst}ada.weight"] = f"{src}adaLN_modulation.1.weight"
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            m[f"{dst}{proj}.weight"] = f"{src}ffn.{proj}.weight"
+    return m
+
+
+def vae_decoder_mapping(cfg: VibeVoiceConfig,
+                        prefix: str = VAE_PREFIX) -> dict[str, str]:
+    m = {
+        "up.0.weight": f"{prefix}upsample_layers.0.0.conv.conv.weight",
+        "up.0.bias": f"{prefix}upsample_layers.0.0.conv.conv.bias",
+        "head.weight": f"{prefix}head.conv.conv.weight",
+        "head.bias": f"{prefix}head.conv.conv.bias",
+    }
+    for i in range(len(cfg.vae_ratios)):
+        src = f"{prefix}upsample_layers.{i + 1}.0.convtr.convtr"
+        m[f"up.{i + 1}.weight"] = f"{src}.weight"
+        m[f"up.{i + 1}.bias"] = f"{src}.bias"
+    for i, depth in enumerate(cfg.vae_depths):
+        for j in range(depth):
+            src = f"{prefix}stages.{i}.{j}."
+            dst = f"stages.{i}.{j}."
+            m[f"{dst}norm.weight"] = f"{src}norm.weight"
+            m[f"{dst}gamma"] = f"{src}gamma"
+            m[f"{dst}mixer.weight"] = f"{src}mixer.conv.conv.conv.weight"
+            m[f"{dst}mixer.bias"] = f"{src}mixer.conv.conv.conv.bias"
+            m[f"{dst}ffn_norm.weight"] = f"{src}ffn_norm.weight"
+            m[f"{dst}ffn_gamma"] = f"{src}ffn_gamma"
+            m[f"{dst}ffn1.weight"] = f"{src}ffn.linear1.weight"
+            m[f"{dst}ffn1.bias"] = f"{src}ffn.linear1.bias"
+            m[f"{dst}ffn2.weight"] = f"{src}ffn.linear2.weight"
+            m[f"{dst}ffn2.bias"] = f"{src}ffn.linear2.bias"
+    return m
+
+
+def connector_mapping(with_bias: bool,
+                      prefix: str = CONNECTOR_PREFIX) -> dict[str, str]:
+    m = {"fc1.weight": f"{prefix}fc1.weight",
+         "norm.weight": f"{prefix}norm.weight",
+         "fc2.weight": f"{prefix}fc2.weight"}
+    if with_bias:
+        m["fc1.bias"] = f"{prefix}fc1.bias"
+        m["fc2.bias"] = f"{prefix}fc2.bias"
+    return m
+
+
+def eos_mapping(prefix: str = EOS_PREFIX) -> dict[str, str]:
+    return {f"{a}.{b}": f"{prefix}{a}.{b}"
+            for a in ("fc1", "fc2") for b in ("weight", "bias")}
+
+
+def detect_vibevoice_checkpoint(path: str) -> bool:
+    cfg_path = os.path.join(path, "config.json")
+    if not (os.path.isdir(path) and os.path.exists(cfg_path)):
+        return False
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    return "diffusion_head_config" in raw and "decoder_config" in raw
+
+
+def load_vibevoice(model_dir: str, dtype=jnp.float32,
+                   tokenizer=None, max_frames: int = 256) -> VibeVoiceTTS:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        raw = json.load(f)
+    cfg = vibevoice_config_from_hf(raw)
+    st = TensorStorage.from_model_dir(model_dir)
+
+    # LM stacks through the standard text loader (Qwen2 names under their
+    # prefixes). The LMs have a final norm but no lm_head; force tied so
+    # the loader doesn't look for one.
+    def lm_params(lm_cfg):
+        lc = dataclasses.replace(lm_cfg, tie_word_embeddings=True)
+        return ParamLoader(lc, st, dtype, NoQuantization()).load(
+            include_embed=True, include_head=True)
+
+    params: dict = {
+        "base": lm_params(cfg.lm_base),
+        "tts": lm_params(cfg.lm_tts),
+        "input_types": {"weight": jnp.asarray(
+            st.read("model.tts_input_types.weight")).astype(dtype)},
+        "speech_scaling_factor": jnp.asarray(
+            st.read("model.speech_scaling_factor"), jnp.float32),
+        "speech_bias_factor": jnp.asarray(
+            st.read("model.speech_bias_factor"), jnp.float32),
+    }
+
+    hm = head_mapping(cfg)
+    params["head"] = load_mapped_params(
+        st, hm, jax.eval_shape(lambda: init_head_params(
+            cfg, jax.random.PRNGKey(0), dtype)), dtype)
+    coverage_report(st, hm, HEAD_PREFIX)
+
+    with_bias = CONNECTOR_PREFIX + "fc1.bias" in st
+    cm = connector_mapping(with_bias)
+    params["connector"] = load_mapped_params(
+        st, cm, jax.eval_shape(lambda: init_connector_params(
+            cfg, jax.random.PRNGKey(0), dtype, bias=with_bias)), dtype)
+    coverage_report(st, cm, CONNECTOR_PREFIX)
+
+    eos_inner = st.records[EOS_PREFIX + "fc1.weight"].shape[0]
+    em = eos_mapping()
+    params["eos"] = load_mapped_params(
+        st, em, jax.eval_shape(lambda: init_eos_params(
+            cfg, jax.random.PRNGKey(0), dtype, inner=eos_inner)), dtype)
+
+    vm = vae_decoder_mapping(cfg)
+    params["vae"] = load_mapped_params(
+        st, vm, jax.eval_shape(lambda: init_vae_decoder_params(
+            cfg, jax.random.PRNGKey(0), jnp.float32)), jnp.float32)
+    coverage_report(st, vm, VAE_PREFIX)
+
+    if tokenizer is None:
+        tok_json = os.path.join(model_dir, "tokenizer.json")
+        if os.path.exists(tok_json):
+            from tokenizers import Tokenizer
+            tokenizer = Tokenizer.from_file(tok_json)
+    log.info("loaded VibeVoice: base %d + tts %d layers, hidden %d, "
+             "hop %d", cfg.lm_base.num_hidden_layers,
+             cfg.lm_tts.num_hidden_layers, cfg.hidden, cfg.hop)
+    return VibeVoiceTTS(cfg, params=params, tokenizer=tokenizer,
+                        dtype=dtype, max_frames=max_frames)

@@ -63,6 +63,16 @@ class DpmSolverPP:
             betas = np.linspace(beta_start, beta_end, n)
         return cls(np.cumprod(1.0 - betas), **kw)
 
+    @classmethod
+    def from_cosine(cls, n=1000, s=0.008, max_beta=0.999, **kw):
+        """squaredcos_cap_v2 schedule (VibeVoice's ddpm_beta_schedule
+        default 'cosine' — ref: vibevoice/config.rs)."""
+        def f(t):
+            return np.cos((t / n + s) / (1 + s) * np.pi / 2) ** 2
+        t = np.arange(n)
+        betas = np.clip(1.0 - f(t + 1) / f(t), 0.0, max_beta)
+        return cls(np.cumprod(1.0 - betas), **kw)
+
     def reset(self):
         self._last_x0 = None
         self._last_lambda = None

@@ -117,17 +117,26 @@ def build_image_model(model: str, dtype: str = "bf16"):
 
 
 def build_audio_model(model: str, dtype: str = "bf16"):
-    """TTS generator for the serve path ('demo:vibevoice' | 'demo:luxtts')."""
-    from .models.audio import (LuxTTS, VibeVoiceTTS, tiny_luxtts_config,
-                               tiny_tts_config)
+    """TTS generator for the serve path: 'demo:vibevoice' / 'demo:luxtts'
+    run on random weights; any other value is a release-checkpoint path
+    (VibeVoice HF layout — models/audio/vibevoice_loader)."""
+    from .models.audio import (LuxTTS, VibeVoiceTTS,
+                               detect_vibevoice_checkpoint, load_vibevoice,
+                               tiny_luxtts_config, tiny_tts_config)
     dt = parse_dtype(dtype)
     if model == "demo:luxtts":
         return LuxTTS(tiny_luxtts_config(), dtype=dt)
     if model.startswith("demo"):
         return VibeVoiceTTS(tiny_tts_config(), dtype=dt)
-    raise NotImplementedError(
-        f"audio checkpoint loading for {model!r} not yet wired; use "
-        f"'demo:vibevoice' or 'demo:luxtts'")
+    path = os.path.expanduser(model)
+    if not os.path.exists(path):
+        path = resolve_model(model)
+    if detect_vibevoice_checkpoint(path):
+        return load_vibevoice(path, dtype=dt)
+    raise ValueError(
+        f"audio model {model!r}: not a demo: alias and not a recognizable "
+        f"VibeVoice checkpoint directory (config.json with "
+        f"decoder_config + diffusion_head_config)")
 
 
 def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,

@@ -1,0 +1,193 @@
+"""VibeVoice release-checkpoint loading: synthesize an HF-layout dir with
+the REAL tensor names (model.language_model / model.tts_language_model /
+model.prediction_head / model.acoustic_tokenizer.decoder / ... — the
+prefixes the reference wires in vibevoice.rs) and load through the public
+path, including a precomputed voice-prompt file (voice_prompt.rs format).
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.audio import (detect_vibevoice_checkpoint,
+                                   load_vibevoice, tiny_tts_config)
+from cake_tpu.models.audio.vibevoice import (init_connector_params,
+                                             init_eos_params,
+                                             init_head_params,
+                                             init_vae_decoder_params)
+from cake_tpu.models.audio.vibevoice_loader import (connector_mapping,
+                                                    eos_mapping,
+                                                    head_mapping,
+                                                    vae_decoder_mapping)
+from cake_tpu.utils.mapping import flatten_tree
+from cake_tpu.utils.safetensors_io import save_safetensors
+
+
+def _lm_tensors(cfg, params, prefix):
+    """Emit HF Qwen2-style names for an LM stack pytree."""
+    out = {}
+    out[f"{prefix}.embed_tokens.weight"] = params["embed_tokens"]["weight"]
+    out[f"{prefix}.norm.weight"] = params["norm"]["weight"]
+    for i, lp in enumerate(params["layers"]):
+        lpfx = f"{prefix}.layers.{i}"
+        at = lp["self_attn"]
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            out[f"{lpfx}.self_attn.{proj}.weight"] = at[proj]["weight"]
+            if "bias" in at[proj]:
+                out[f"{lpfx}.self_attn.{proj}.bias"] = at[proj]["bias"]
+        out[f"{lpfx}.self_attn.o_proj.weight"] = at["o_proj"]["weight"]
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            out[f"{lpfx}.mlp.{proj}.weight"] = lp["mlp"][proj]["weight"]
+        out[f"{lpfx}.input_layernorm.weight"] = \
+            lp["input_layernorm"]["weight"]
+        out[f"{lpfx}.post_attention_layernorm.weight"] = \
+            lp["post_attention_layernorm"]["weight"]
+    return out
+
+
+def synth_vibevoice_dir(tmp_path):
+    cfg = tiny_tts_config()
+    ks = jax.random.split(jax.random.PRNGKey(0), 8)
+    from cake_tpu.models.common.layers import init_params
+    tensors = {}
+    tensors.update(_lm_tensors(
+        cfg.lm_base, init_params(cfg.lm_base, ks[0], jnp.float32),
+        "model.language_model"))
+    tensors.update(_lm_tensors(
+        cfg.lm_tts, init_params(cfg.lm_tts, ks[1], jnp.float32),
+        "model.tts_language_model"))
+    for pytree, mapping in (
+            (init_head_params(cfg, ks[2], jnp.float32), head_mapping(cfg)),
+            (init_connector_params(cfg, ks[3], jnp.float32, bias=True),
+             connector_mapping(True)),
+            (init_eos_params(cfg, ks[4], jnp.float32), eos_mapping()),
+            (init_vae_decoder_params(cfg, ks[5], jnp.float32),
+             vae_decoder_mapping(cfg))):
+        flat = flatten_tree(pytree)
+        for path, name in mapping.items():
+            tensors[name] = np.asarray(flat[path], np.float32)
+    tensors["model.tts_input_types.weight"] = \
+        np.asarray(jax.random.normal(ks[6], (2, cfg.hidden)), np.float32) * .02
+    tensors["model.speech_scaling_factor"] = np.asarray(1.5, np.float32)
+    tensors["model.speech_bias_factor"] = np.asarray(0.1, np.float32)
+    save_safetensors(str(tmp_path / "model.safetensors"),
+                     {k: np.asarray(v, np.float32) if np.asarray(v).dtype
+                      != np.float32 else np.asarray(v)
+                      for k, v in tensors.items()})
+    raw = {
+        "acoustic_vae_dim": cfg.acoustic_dim,
+        "tts_backbone_num_hidden_layers": cfg.lm_tts.num_hidden_layers,
+        "decoder_config": {
+            "hidden_size": cfg.lm_base.hidden_size,
+            "intermediate_size": cfg.lm_base.intermediate_size,
+            "num_attention_heads": cfg.lm_base.num_attention_heads,
+            "num_hidden_layers": cfg.lm_base.num_hidden_layers,
+            "num_key_value_heads": cfg.lm_base.num_key_value_heads,
+            "rms_norm_eps": cfg.lm_base.rms_norm_eps,
+            "rope_theta": cfg.lm_base.rope_theta,
+            "vocab_size": cfg.lm_base.vocab_size,
+            "max_position_embeddings": 128,
+            "tie_word_embeddings": True,
+        },
+        "diffusion_head_config": {
+            "ddpm_num_inference_steps": cfg.solver_steps,
+            "ddpm_num_steps": cfg.ddpm_num_steps,
+            "head_layers": cfg.head_layers,
+            "hidden_size": cfg.hidden,
+            "latent_size": cfg.acoustic_dim,
+            "head_ffn_ratio": cfg.head_ffn_ratio,
+            "prediction_type": "v_prediction",
+            "rms_norm_eps": cfg.head_eps,
+        },
+        "acoustic_tokenizer_config": {
+            "vae_dim": cfg.acoustic_dim,
+            "encoder_n_filters": cfg.vae_n_filters,
+            "decoder_n_filters": cfg.vae_n_filters,
+            "encoder_ratios": list(cfg.vae_ratios),
+            "decoder_ratios": list(cfg.vae_ratios),
+            "decoder_depths": "-".join(str(d) for d in cfg.vae_depths),
+            "layernorm": "RMSNorm", "layernorm_eps": cfg.vae_eps,
+            "causal": True,
+        },
+    }
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(raw, f)
+    return cfg
+
+
+EXPECTED_NAMES = [
+    "model.language_model.embed_tokens.weight",
+    "model.language_model.layers.0.self_attn.q_proj.bias",
+    "model.tts_language_model.layers.1.mlp.gate_proj.weight",
+    "model.tts_language_model.norm.weight",
+    "model.tts_input_types.weight",
+    "model.prediction_head.t_embedder.mlp.0.weight",
+    "model.prediction_head.noisy_images_proj.weight",
+    "model.prediction_head.layers.0.adaLN_modulation.1.weight",
+    "model.prediction_head.layers.1.ffn.gate_proj.weight",
+    "model.prediction_head.final_layer.adaLN_modulation.1.weight",
+    "model.prediction_head.final_layer.linear.weight",
+    "model.acoustic_connector.fc1.weight",
+    "model.acoustic_connector.norm.weight",
+    "tts_eos_classifier.fc1.weight",
+    "model.acoustic_tokenizer.decoder.upsample_layers.0.0.conv.conv.weight",
+    "model.acoustic_tokenizer.decoder.upsample_layers.1.0.convtr.convtr"
+    ".weight",
+    "model.acoustic_tokenizer.decoder.stages.0.0.mixer.conv.conv.conv"
+    ".weight",
+    "model.acoustic_tokenizer.decoder.stages.2.0.ffn.linear1.weight",
+    "model.acoustic_tokenizer.decoder.head.conv.conv.weight",
+    "model.speech_scaling_factor",
+]
+
+
+def test_names_and_detection(tmp_path):
+    synth_vibevoice_dir(tmp_path)
+    from cake_tpu.utils.safetensors_io import index_file
+    names = set(index_file(str(tmp_path / "model.safetensors")))
+    missing = [n for n in EXPECTED_NAMES if n not in names]
+    assert not missing, f"missing names: {missing}"
+    assert detect_vibevoice_checkpoint(str(tmp_path))
+
+
+def test_load_and_generate(tmp_path):
+    cfg = synth_vibevoice_dir(tmp_path)
+    tts = load_vibevoice(str(tmp_path), dtype=jnp.float32, max_frames=4)
+    audio = tts.generate_speech("hello world", max_frames=3, steps=2)
+    assert audio.sample_rate == cfg.sample_rate
+    assert len(audio.samples) == 3 * cfg.hop       # frames x hop samples
+    assert np.isfinite(audio.samples).all()
+    # scaling factors came from the checkpoint
+    assert float(tts.params["speech_scaling_factor"]) == 1.5
+
+
+def test_voice_prompt_kv_injection(tmp_path):
+    cfg = synth_vibevoice_dir(tmp_path)
+    tts = load_vibevoice(str(tmp_path), dtype=jnp.float32, max_frames=4)
+    # synthesize a voice prompt in the reference format
+    rng = np.random.default_rng(0)
+    seq, hkv, d = 3, cfg.lm_tts.num_key_value_heads, cfg.lm_tts.head_dim
+    vp = {}
+    for pfx, layers in (("lm", cfg.lm_base.num_hidden_layers),
+                        ("tts_lm", cfg.lm_tts.num_hidden_layers),
+                        ("neg_tts_lm", cfg.lm_tts.num_hidden_layers)):
+        for i in range(layers):
+            vp[f"{pfx}.kv.{i}.key"] = rng.standard_normal(
+                (1, hkv, seq, d)).astype(np.float32)
+            vp[f"{pfx}.kv.{i}.value"] = rng.standard_normal(
+                (1, hkv, seq, d)).astype(np.float32)
+        vp[f"{pfx}.last_hidden_state"] = rng.standard_normal(
+            (1, seq, cfg.hidden)).astype(np.float32)
+    save_safetensors(str(tmp_path / "voice.safetensors"), vp)
+    a = tts.generate_speech("hi", max_frames=2, steps=2)
+    b = tts.generate_speech("hi", voice=str(tmp_path / "voice.safetensors"),
+                            max_frames=2, steps=2)
+    assert not np.allclose(a.samples, b.samples)
+
+
+def test_runtime_detection(tmp_path):
+    synth_vibevoice_dir(tmp_path)
+    from cake_tpu.runtime import build_audio_model
+    tts = build_audio_model(str(tmp_path), dtype="f32")
+    assert type(tts).__name__ == "VibeVoiceTTS"

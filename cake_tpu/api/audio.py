@@ -3,10 +3,33 @@ wav/pcm response (ref: cake-core/src/cake/sharding/api/audio.rs:1-155)."""
 from __future__ import annotations
 
 import base64
+import logging
+import os
 
 from aiohttp import web
 
 from .state import ApiState
+
+log = logging.getLogger("cake_tpu.api.audio")
+
+
+def resolve_voice(state: ApiState, voice) -> str | None:
+    """Map a client voice NAME to a prompt file inside the server's
+    configured voices dir. The raw string never reaches the filesystem
+    layer: generate_speech treats `voice` as a path, and forwarding
+    client input verbatim would let remote callers probe/read arbitrary
+    server paths."""
+    if not voice or not getattr(state, "voices_dir", None):
+        if voice:
+            log.info("voice %r ignored (no --voices-dir configured)", voice)
+        return None
+    base = os.path.basename(str(voice))          # strip any path components
+    for cand in (base, base + ".safetensors"):
+        p = os.path.join(state.voices_dir, cand)
+        if os.path.isfile(p):
+            return p
+    log.info("voice %r not found in voices dir; ignoring", voice)
+    return None
 
 
 async def audio_speech(request: web.Request) -> web.Response:
@@ -24,7 +47,7 @@ async def audio_speech(request: web.Request) -> web.Response:
     if fmt not in ("wav", "pcm"):
         return web.json_response({"error": f"unsupported format {fmt}"},
                                  status=400)
-    voice = body.get("voice")
+    voice = resolve_voice(state, body.get("voice"))
     voice_wav = None
     if body.get("voice_b64"):
         try:

@@ -36,8 +36,11 @@ async def basic_auth_middleware(request, handler):
         ok = False
         if hdr.startswith("Basic "):
             try:
+                import hmac
                 user_pass = base64.b64decode(hdr[6:]).decode()
-                ok = user_pass == creds
+                # constant-time compare, same as the cluster handshake
+                # (ref: constant_time_eq in auth.rs)
+                ok = hmac.compare_digest(user_pass.encode(), creds.encode())
             except Exception:
                 ok = False
         if not ok:

@@ -22,6 +22,7 @@ class ApiState:
     image_model: Any = None
     audio_model: Any = None
     topology: Any = None            # cluster Topology or None
+    voices_dir: str | None = None   # server-side voice-prompt directory
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     created: int = 0
 

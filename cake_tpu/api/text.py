@@ -13,15 +13,40 @@ from ..ops.sampling import SamplingConfig
 from .state import ApiState, run_generation_streamed
 
 
+TOP_K_CHOICES = (1, 5, 10, 20, 40, 64, 100, 200)
+
+
+def _grid(v: float, step: float, lo: float, hi: float) -> float:
+    return round(round(max(lo, min(hi, v)) / step) * step, 2)
+
+
 def _sampling_from_request(body: dict) -> SamplingConfig:
-    temp = float(body.get("temperature", 0.7))
-    return SamplingConfig(
-        temperature=temp,
-        top_k=body.get("top_k"),
-        top_p=body.get("top_p"),
-        repeat_penalty=float(body.get("repetition_penalty",
-                                      body.get("repeat_penalty", 1.0))),
-    )
+    """Clamp + quantize client sampling params onto a small grid.
+
+    SamplingConfig is a STATIC jit argument of the decode programs: every
+    distinct value combination compiles and permanently caches a new XLA
+    executable, so raw client-controlled floats would be an unbounded
+    compile-cache DoS. The grid bounds the executable count while staying
+    well inside perceptual resolution.
+    """
+    temp = _grid(float(body.get("temperature", 0.7)), 0.05, 0.0, 2.0)
+    top_p = body.get("top_p")
+    if top_p is not None:
+        top_p = _grid(float(top_p), 0.05, 0.05, 1.0)
+        if top_p >= 1.0:
+            top_p = None
+    top_k = body.get("top_k")
+    if top_k is not None:
+        top_k = int(top_k)
+        if top_k <= 0:
+            top_k = None       # llama.cpp/OpenAI convention: 0 = disabled
+        else:
+            top_k = min(TOP_K_CHOICES, key=lambda c: abs(c - top_k))
+    rp = _grid(float(body.get("repetition_penalty",
+                              body.get("repeat_penalty", 1.0))),
+               0.05, 1.0, 2.0)
+    return SamplingConfig(temperature=temp, top_k=top_k, top_p=top_p,
+                          repeat_penalty=rp)
 
 
 def _gen_kwargs(body: dict) -> dict:
@@ -119,18 +144,41 @@ async def _chat_stream(request, state: ApiState, messages, body):
 
     await resp.write(chunk({"role": "assistant"}))
     finish = "length"
+    client_gone = False
+
+    async def write_safe(data: bytes) -> None:
+        # a disconnected client must not abort the drain below — note it
+        # and keep consuming so the worker thread/queue reader wind down
+        nonlocal client_gone
+        if client_gone:
+            return
+        try:
+            await resp.write(data)
+        except (ConnectionError, ConnectionResetError):
+            client_gone = True
+
     async with state.lock:
         aiter, result = run_generation_streamed(state.model, messages,
                                                 _gen_kwargs(body))
-        async for tok in aiter:
-            if tok.is_end_of_stream:
-                finish = "stop"
-                break
-            if tok.text:
-                await resp.write(chunk({"content": tok.text}))
-    await resp.write(chunk({}, finish=finish))
-    await resp.write(b"data: [DONE]\n\n")
-    await resp.write_eof()
+        try:
+            # drain to the DONE sentinel even past EOS: breaking out would
+            # abandon the queue reader (pending executor q.get, skipped
+            # join) and drop a worker error raised after the EOS token
+            async for tok in aiter:
+                if tok.is_end_of_stream:
+                    finish = "stop"
+                    continue
+                if finish == "length" and tok.text:
+                    await write_safe(chunk({"content": tok.text}))
+        except Exception as e:
+            # mid-stream generation failure: still close the SSE stream
+            # with a final chunk + [DONE] so clients don't hang
+            await write_safe(chunk({"content": f"\n[error: {e}]"}))
+            finish = "error"
+    await write_safe(chunk({}, finish=finish))
+    await write_safe(b"data: [DONE]\n\n")
+    if not client_gone:
+        await resp.write_eof()
     return resp
 
 

@@ -95,7 +95,7 @@ def cmd_serve(args) -> int:
         audio_model = build_audio_model(args.audio_model, dtype=args.dtype)
     state = ApiState(model=gen, tokenizer=tokenizer, model_id=model_id,
                      topology=topo, image_model=image_model,
-                     audio_model=audio_model)
+                     audio_model=audio_model, voices_dir=args.voices_dir)
     serve(state, host=args.host, port=args.port, basic_auth=args.basic_auth)
     return 0
 
@@ -182,6 +182,10 @@ def main(argv=None) -> int:
                                  description="TPU-native distributed "
                                              "multimodal inference")
     ap.add_argument("-v", "--verbose", action="count", default=0)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU platform (the JAX_PLATFORMS env "
+                         "var is ignored when a sitecustomize pre-imports "
+                         "jax)")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("run", help="generate text for a prompt")
@@ -199,6 +203,9 @@ def main(argv=None) -> int:
     p.add_argument("--basic-auth", default=None, help="user:pass")
     p.add_argument("--image-model", default=None,
                    help="image model dir ('demo:flux' for random weights)")
+    p.add_argument("--voices-dir", default=None,
+                   help="directory of voice-prompt .safetensors files "
+                        "served by name via the API")
     p.add_argument("--audio-model", default=None,
                    help="TTS model dir ('demo:vibevoice' | 'demo:luxtts')")
     p.set_defaults(fn=cmd_serve)
@@ -240,6 +247,9 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_chat)
 
     args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     logging.basicConfig(
         level=[logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)],
         format="%(asctime)s %(name)s %(levelname)s %(message)s")

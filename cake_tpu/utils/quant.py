@@ -65,8 +65,9 @@ class GptqQuantization:
     name = "gptq"
     vram_factor = 4.0
 
-    def __init__(self, group_size: int = 128):
+    def __init__(self, group_size: int = 128, desc_act: bool = False):
         self.group_size = group_size
+        self.desc_act = desc_act
 
     def has(self, storage, name: str) -> bool:
         return (name in storage
@@ -79,7 +80,19 @@ class GptqQuantization:
         qweight = storage.read(qname).view(np.uint32)
         scales = storage.read(name.replace(".weight", ".scales")).astype(np.float32)
         qzeros = storage.read(name.replace(".weight", ".qzeros")).view(np.uint32)
-        return dequantize_gptq_4bit(qweight, scales, qzeros, self.group_size)
+        # act-order checkpoints permute the group mapping; honor the stored
+        # g_idx when present, refuse (instead of silently producing garbage
+        # like the reference's gptq.rs would) when it is missing
+        gname = name.replace(".weight", ".g_idx")
+        g_idx = storage.read(gname).astype(np.int64) if gname in storage \
+            else None
+        if self.desc_act and g_idx is None:
+            raise NotImplementedError(
+                f"GPTQ desc_act=true checkpoint without a g_idx tensor for "
+                f"{name}: sequential group mapping would silently produce "
+                f"wrong weights")
+        return dequantize_gptq_4bit(qweight, scales, qzeros, self.group_size,
+                                    g_idx)
 
 
 def unpack_int4(packed: np.ndarray, axis: int) -> np.ndarray:
@@ -93,12 +106,16 @@ def unpack_int4(packed: np.ndarray, axis: int) -> np.ndarray:
 
 
 def dequantize_gptq_4bit(qweight: np.ndarray, scales: np.ndarray,
-                         qzeros: np.ndarray, group_size: int = 128) -> np.ndarray:
-    """Returns [out_features, in_features] f32."""
+                         qzeros: np.ndarray, group_size: int = 128,
+                         g_idx: np.ndarray | None = None) -> np.ndarray:
+    """Returns [out_features, in_features] f32. g_idx (per-in-feature group
+    index) overrides the sequential arange//group_size mapping — required
+    for act-order (desc_act) checkpoints."""
     q = unpack_int4(qweight, axis=0)                # [in, out]
     zeros = unpack_int4(qzeros, axis=1)             # [groups, out]
     in_features = q.shape[0]
-    g_idx = np.arange(in_features) // group_size
+    if g_idx is None:
+        g_idx = np.arange(in_features) // group_size
     w = (q - zeros[g_idx] - 1).astype(np.float32) * scales[g_idx]
     return np.ascontiguousarray(w.T)
 
@@ -117,7 +134,8 @@ def detect_quantization(config: dict):
             if bits != 4:
                 raise NotImplementedError(
                     f"GPTQ {bits}-bit not supported (4-bit only)")
-            return GptqQuantization(int(qc.get("group_size", 128)))
+            return GptqQuantization(int(qc.get("group_size", 128)),
+                                    desc_act=bool(qc.get("desc_act", False)))
         if method == "fp8" or qc.get("fmt") in ("e4m3", "float8_e4m3fn"):
             return Fp8Quantization()
     return NoQuantization()

@@ -219,3 +219,43 @@ def test_basic_auth():
     app = create_app(ApiState(model=MockTextModel(), model_id="m"),
                      basic_auth="user:pw")
     with_client(app, scenario)
+
+
+def test_sampling_request_grid():
+    """Client sampling params are clamped/quantized to a bounded grid:
+    SamplingConfig is a static jit arg, so unbounded distinct values would
+    be a compile-cache DoS (round-1 advisor finding)."""
+    from cake_tpu.api.text import _sampling_from_request
+    a = _sampling_from_request({"temperature": 0.7123, "top_p": 0.912,
+                                "top_k": 37, "repetition_penalty": 1.0812})
+    assert a.temperature == 0.7 and a.top_p == 0.9
+    assert a.top_k == 40 and a.repeat_penalty == 1.1
+    # out-of-range values clamp instead of erroring
+    b = _sampling_from_request({"temperature": 99.0, "top_p": 1.0})
+    assert b.temperature == 2.0 and b.top_p is None
+    # nearby floats collapse onto the same grid point (bounded cache)
+    c1 = _sampling_from_request({"temperature": 0.701})
+    c2 = _sampling_from_request({"temperature": 0.699})
+    assert c1 == c2
+
+
+def test_resolve_voice_sandboxed(tmp_path):
+    """Client voice strings resolve only inside the configured voices dir —
+    never used as raw server paths (file-probe/arbitrary-read hazard)."""
+    from cake_tpu.api.audio import resolve_voice
+    from cake_tpu.api.state import ApiState
+    (tmp_path / "alloy.safetensors").write_bytes(b"x")
+    state = ApiState(model=None, voices_dir=str(tmp_path))
+    got = resolve_voice(state, "alloy")
+    assert got == str(tmp_path / "alloy.safetensors")
+    # path components are stripped; escapes stay inside the dir
+    assert resolve_voice(state, "../../etc/passwd") is None
+    assert resolve_voice(state, "/etc/passwd") is None
+    # without a voices dir every voice is ignored
+    assert resolve_voice(ApiState(model=None), "/etc/passwd") is None
+
+
+def test_top_k_zero_disables():
+    from cake_tpu.api.text import _sampling_from_request
+    assert _sampling_from_request({"top_k": 0}).top_k is None
+    assert _sampling_from_request({"top_k": -1}).top_k is None

@@ -261,3 +261,51 @@ def test_fp8_native_dtype_path(tmp_path, rng):
     toks, _ = m2.generate([1, 2, 3], max_new_tokens=4,
                           sampling=SamplingConfig(temperature=0.0), chunk=4)
     assert len(toks) >= 1
+
+
+def test_gptq_act_order_g_idx():
+    """desc_act checkpoints carry a g_idx permutation; dequant must honor
+    it, and refuse desc_act without g_idx instead of silently mis-mapping."""
+    from cake_tpu.utils.quant import GptqQuantization
+    in_f, out_f = 16, 8
+    rng = np.random.default_rng(1)
+    q = rng.integers(0, 16, (in_f, out_f)).astype(np.uint32)
+    zeros = rng.integers(0, 15, (2, out_f)).astype(np.uint32)
+    scales = rng.uniform(0.5, 2.0, (2, out_f)).astype(np.float32)
+    qweight = np.zeros((2, out_f), np.uint32)
+    for blk in range(2):
+        for i in range(8):
+            qweight[blk] |= q[blk * 8 + i] << (4 * i)
+    qzeros = np.zeros((2, 1), np.uint32)
+    for g in range(2):
+        for j in range(8):
+            qzeros[g, 0] |= zeros[g, j] << (4 * j)
+    # act-order: interleaved group assignment instead of blocks of 8
+    g_idx = (np.arange(in_f) % 2).astype(np.int64)
+    want = ((q.astype(np.int32) - zeros[g_idx].astype(np.int32) - 1)
+            * scales[g_idx]).T.astype(np.float32)
+    got = dequantize_gptq_4bit(qweight, scales, qzeros, 8, g_idx)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # sequential mapping differs -> proves g_idx is honored
+    seq = dequantize_gptq_4bit(qweight, scales, qzeros, 8)
+    assert not np.allclose(got, seq)
+
+    class FakeStorage(dict):
+        def read(self, name):
+            return self[name]
+    st = FakeStorage({"w.qweight": qweight.view(np.int32),
+                      "w.scales": scales, "w.qzeros": qzeros.view(np.int32)})
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError, match="desc_act"):
+        GptqQuantization(8, desc_act=True).load(st, "w.weight")
+    st["w.g_idx"] = g_idx.astype(np.int32)
+    np.testing.assert_allclose(
+        GptqQuantization(8, desc_act=True).load(st, "w.weight"), want,
+        atol=1e-6)
+
+
+def test_detect_quantization_desc_act():
+    from cake_tpu.utils.quant import detect_quantization
+    q = detect_quantization({"quantization_config": {
+        "quant_method": "gptq", "group_size": 64, "desc_act": True}})
+    assert q.desc_act is True

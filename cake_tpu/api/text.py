@@ -77,9 +77,16 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             return web.json_response(
                 {"error": "each message needs role and content"}, status=400)
 
+    try:
+        # validate/quantize sampling params BEFORE any streaming response
+        # is prepared: a malformed float must be a 400, not a hung SSE
+        gen_kwargs = _gen_kwargs(body)
+    except (TypeError, ValueError) as e:
+        return web.json_response({"error": f"invalid sampling params: {e}"},
+                                 status=400)
     if body.get("stream"):
-        return await _chat_stream(request, state, messages, body)
-    return await _chat_blocking(request, state, messages, body)
+        return await _chat_stream(request, state, messages, gen_kwargs)
+    return await _chat_blocking(request, state, messages, gen_kwargs)
 
 
 def _prompt_token_count(state: ApiState, messages) -> int:
@@ -91,10 +98,10 @@ def _prompt_token_count(state: ApiState, messages) -> int:
         return 0
 
 
-async def _chat_blocking(request, state: ApiState, messages, body):
+async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
     async with state.lock:                  # one inference at a time
         aiter, result = run_generation_streamed(state.model, messages,
-                                               _gen_kwargs(body))
+                                               gen_kwargs)
         text_parts = []
         last = None
         async for tok in aiter:
@@ -124,7 +131,7 @@ async def _chat_blocking(request, state: ApiState, messages, body):
     })
 
 
-async def _chat_stream(request, state: ApiState, messages, body):
+async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -159,7 +166,7 @@ async def _chat_stream(request, state: ApiState, messages, body):
 
     async with state.lock:
         aiter, result = run_generation_streamed(state.model, messages,
-                                                _gen_kwargs(body))
+                                                gen_kwargs)
         try:
             # drain to the DONE sentinel even past EOS: breaking out would
             # abandon the queue reader (pending executor q.get, skipped

@@ -202,12 +202,35 @@ GGUF_NAME_MAP = {
     "ffn_gate": "mlp.gate_proj", "ffn_up": "mlp.up_proj",
     "ffn_down": "mlp.down_proj",
     "attn_norm": "input_layernorm", "ffn_norm": "post_attention_layernorm",
+    "ffn_gate_inp": "mlp.gate",               # MoE router
+}
+
+# per-architecture llama.cpp tensor-name overrides: gemma-family sandwich
+# norms repurpose ffn_norm as the PRE-feedforward norm, olmo2 is post-norm
+# only (llama.cpp LLM_ARCH_GEMMA3 / LLM_ARCH_OLMO2 tensor tables)
+_GEMMA_NORMS = {
+    "ffn_norm": "pre_feedforward_layernorm",
+    "post_attention_norm": "post_attention_layernorm",
+    "post_ffw_norm": "post_feedforward_layernorm",
+}
+GGUF_NAME_OVERRIDES: dict[str, dict[str, str]] = {
+    "gemma2": _GEMMA_NORMS,
+    "gemma3": _GEMMA_NORMS,
+    "olmo2": {"post_attention_norm": "post_attention_layernorm",
+              "post_ffw_norm": "post_feedforward_layernorm"},
+}
+
+# expert banks: blk.N.ffn_gate_exps.weight holds [n_expert, inter, hidden]
+MOE_BANK_STEMS = {
+    "ffn_gate_exps": "gate_proj", "ffn_up_exps": "up_proj",
+    "ffn_down_exps": "down_proj",
 }
 
 
-def gguf_to_hf_name(name: str, prefix: str = "model") -> str | None:
+def gguf_to_hf_name(name: str, prefix: str = "model",
+                    arch: str = "llama") -> str | None:
     """blk.N.attn_q.weight -> model.layers.N.self_attn.q_proj.weight
-    (ref: gguf.rs name mapping)."""
+    (ref: gguf.rs name mapping, plus arch-aware norm/MoE extensions)."""
     if name == "token_embd.weight":
         return f"{prefix}.embed_tokens.weight"
     if name == "output_norm.weight":
@@ -217,21 +240,25 @@ def gguf_to_hf_name(name: str, prefix: str = "model") -> str | None:
     if name.startswith("blk."):
         _, layer, rest = name.split(".", 2)
         stem, suffix = rest.rsplit(".", 1)
-        mapped = GGUF_NAME_MAP.get(stem)
+        mapped = GGUF_NAME_OVERRIDES.get(arch, {}).get(stem) \
+            or GGUF_NAME_MAP.get(stem)
         if mapped:
             return f"{prefix}.layers.{layer}.{mapped}.{suffix}"
     return None
 
 
-# Only architectures whose full tensor set GGUF_NAME_MAP covers (standard
-# llama-layout decoders). MoE expert banks (ffn_*_exps) and sandwich/post
-# norm layouts (gemma3/olmo2/exaone4) need additional mappings — their GGUFs
-# are rejected with a clear error instead of mis-wiring norms.
+# Architectures whose tensor set the name maps cover. Qwen3.5 GDN hybrids
+# still need linear-attention mappings — rejected with a clear error
+# instead of mis-wiring.
 GGUF_ARCH_TO_HF = {
     "llama": "LlamaForCausalLM", "qwen2": "Qwen2ForCausalLM",
-    "qwen3": "Qwen3ForCausalLM",
+    "qwen3": "Qwen3ForCausalLM", "qwen3moe": "Qwen3MoeForCausalLM",
     "phi3": "Phi3ForCausalLM", "mistral": "MistralForCausalLM",
     "falcon": "FalconForCausalLM",
+    # gemma2 deliberately absent: no QK norms, 1:1 interleave, logit
+    # softcapping — the gemma3 adapter would mis-model it
+    "gemma3": "Gemma3ForCausalLM",
+    "olmo2": "Olmo2ForCausalLM",
 }
 
 
@@ -270,6 +297,17 @@ def gguf_config_dict(reader: GgufReader) -> dict:
         d["head_dim"] = int(g("attention.key_length"))
     if g("attention.sliding_window"):
         d["sliding_window"] = int(g("attention.sliding_window"))
+    if arch == "qwen3moe":
+        d["num_experts"] = int(g("expert_count", 128))
+        d["num_experts_per_tok"] = int(g("expert_used_count", 8))
+        d["moe_intermediate_size"] = int(g("expert_feed_forward_length",
+                                           d["intermediate_size"]))
+        d["norm_topk_prob"] = True
+    if arch in ("gemma2", "gemma3"):
+        # llama.cpp hardcodes the 5-local:1-global interleave; the adapter's
+        # sliding_window_pattern=6 default reproduces it
+        d.setdefault("sliding_window", int(g("attention.sliding_window",
+                                             1024)))
     eos = md.get("tokenizer.ggml.eos_token_id")
     if eos is not None:
         d["eos_token_id"] = int(eos)
@@ -281,24 +319,61 @@ def gguf_config_dict(reader: GgufReader) -> dict:
 
 class GgufStorage:
     """TensorStorage-compatible facade over a GGUF file: HF names in,
-    dequantized arrays out — so ParamLoader works unchanged."""
+    dequantized arrays out — so ParamLoader works unchanged.
+
+    MoE expert banks (blk.N.ffn_*_exps, [n_expert, inter, hidden]) are
+    exposed as virtual per-expert names matching the HF layout the loader
+    expects; a small dequant cache keeps the bank hot while the loader
+    iterates experts."""
 
     def __init__(self, path: str, prefix: str = "model"):
         self.reader = GgufReader(path)
+        arch = self.reader.metadata.get("general.architecture", "llama")
+        # llama.cpp's gemma converter bakes the (1+w) residual offset INTO
+        # every *norm.weight tensor; our loader applies (1+w) itself for
+        # residual_rms_norm configs, so undo the baked offset here or every
+        # norm would be off by exactly 1
+        self._norm_offset = -1.0 if arch.startswith("gemma") else 0.0
         self._map: dict[str, str] = {}
-        for gname in self.reader.tensors:
-            hf = gguf_to_hf_name(gname, prefix)
+        self._experts: dict[str, tuple[str, int]] = {}
+        self._bank_cache: dict[str, np.ndarray] = {}
+        for gname, t in self.reader.tensors.items():
+            hf = gguf_to_hf_name(gname, prefix, arch)
             if hf:
                 self._map[hf] = gname
+                continue
+            if gname.startswith("blk."):
+                _, layer, rest = gname.split(".", 2)
+                stem, suffix = rest.rsplit(".", 1)
+                proj = MOE_BANK_STEMS.get(stem)
+                if proj and suffix == "weight":
+                    n_exp = t.dims[-1]     # outermost ggml dim
+                    for e in range(n_exp):
+                        self._experts[
+                            f"{prefix}.layers.{layer}.mlp.experts.{e}."
+                            f"{proj}.weight"] = (gname, e)
 
     def names(self):
-        return self._map.keys()
+        return list(self._map) + list(self._experts)
 
     def __contains__(self, name):
-        return name in self._map
+        return name in self._map or name in self._experts
+
+    def _bank(self, gname: str) -> np.ndarray:
+        if gname not in self._bank_cache:
+            if len(self._bank_cache) >= 3:   # gate/up/down of current layer
+                self._bank_cache.pop(next(iter(self._bank_cache)))
+            self._bank_cache[gname] = self.reader.read_tensor(gname)
+        return self._bank_cache[gname]
 
     def read(self, name: str) -> np.ndarray:
-        return self.reader.read_tensor(self._map[name])
+        if name in self._experts:
+            gname, e = self._experts[name]
+            return self._bank(gname)[e]
+        arr = self.reader.read_tensor(self._map[name])
+        if self._norm_offset and name.endswith("norm.weight"):
+            arr = arr + np.asarray(self._norm_offset, arr.dtype)
+        return arr
 
     def close(self):
-        pass
+        self._bank_cache.clear()

@@ -1,0 +1,74 @@
+"""Generate the committed golden-logit fixtures (run on CPU, f32).
+
+    python tests/golden/make_golden.py
+
+Records, for each family: prefill logits, per-step incremental decode
+logits, a chunked-prefill logit row, and a greedy token sequence — from
+seeded random weights. test_golden.py asserts the current implementation
+reproduces these within atol 1e-3 (the BASELINE.json north-star bar), so
+any silent numerics change in norms/rope/attention/cache/sampling shows
+up as a diff against a committed artifact rather than passing self-
+consistency tests.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cake_tpu.models import TextModel, tiny_config  # noqa: E402
+from cake_tpu.ops.sampling import SamplingConfig  # noqa: E402
+
+FAMILIES = ("llama", "qwen2", "qwen3", "qwen3_moe", "phi4", "mistral",
+            "gemma3", "falcon3", "olmo2", "exaone4", "qwen3_5")
+SEED = 7
+PROMPT = [11, 23, 5, 190, 77, 3, 149, 66, 20]
+
+
+def build(fam: str) -> dict[str, np.ndarray]:
+    cfg = tiny_config(fam, eos_token_id=255)
+    model = TextModel(cfg, dtype=jnp.float32, seed=SEED, max_cache_len=64)
+    out: dict[str, np.ndarray] = {}
+
+    logits, cache = model.prefill(model.new_cache(), PROMPT)
+    out["prefill_logits"] = np.asarray(logits[0], np.float32)
+
+    dec = []
+    tid = int(np.argmax(out["prefill_logits"]))
+    for _ in range(5):
+        logits, cache = model.decode_logits(cache, tid)
+        dec.append(np.asarray(logits[0], np.float32))
+        tid = int(np.argmax(dec[-1]))
+    out["decode_logits"] = np.stack(dec)
+
+    # chunked prefill across a bucket boundary (5 then 4 tokens)
+    cache2 = model.new_cache()
+    _, cache2 = model.prefill(cache2, PROMPT[:5])
+    logits2, _ = model.prefill(cache2, PROMPT[5:], pos0=5)
+    out["chunked_prefill_logits"] = np.asarray(logits2[0], np.float32)
+
+    toks, _ = model.generate(PROMPT, max_new_tokens=16,
+                             sampling=SamplingConfig(temperature=0.0),
+                             chunk=8)
+    out["greedy_tokens"] = np.asarray(toks, np.int64)
+    return out
+
+
+def main():
+    # CPU forcing only when run as a script — importing this module from
+    # the test suite must not re-platform the whole pytest process
+    jax.config.update("jax_platforms", "cpu")
+    here = os.path.dirname(os.path.abspath(__file__))
+    for fam in FAMILIES:
+        arrs = build(fam)
+        path = os.path.join(here, f"{fam}.npz")
+        np.savez_compressed(path, **arrs)
+        print(f"{fam}: greedy={arrs['greedy_tokens'][:6]}... -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -259,3 +259,26 @@ def test_top_k_zero_disables():
     from cake_tpu.api.text import _sampling_from_request
     assert _sampling_from_request({"top_k": 0}).top_k is None
     assert _sampling_from_request({"top_k": -1}).top_k is None
+
+
+def test_bad_sampling_params_400():
+    """Malformed numeric params must be a 400 before the SSE response is
+    prepared — not a hung stream or a 500."""
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api.server import create_app
+    from cake_tpu.api.state import ApiState
+
+    async def run():
+        app = create_app(ApiState(model=object(), model_id="m"))
+        async with TestClient(TestServer(app)) as client:
+            for payload in (
+                    {"messages": [{"role": "user", "content": "x"}],
+                     "temperature": "hot", "stream": True},
+                    {"messages": [{"role": "user", "content": "x"}],
+                     "top_k": "many"},
+                    {"messages": [{"role": "user", "content": "x"}],
+                     "max_tokens": "all"}):
+                r = await client.post("/v1/chat/completions", json=payload)
+                assert r.status == 400, payload
+    asyncio.new_event_loop().run_until_complete(run())

@@ -28,21 +28,27 @@ def _w_kv_str(key, val) -> bytes:
     return _w_str(key) + struct.pack("<I", 8) + _w_str(val)
 
 
-def write_tiny_gguf(path, tensors: dict[str, np.ndarray], meta_arch="llama"):
-    """Minimal GGUF v3 writer for tests (F32 tensors only)."""
+def write_tiny_gguf(path, tensors: dict[str, np.ndarray], meta_arch="llama",
+                    kv_overrides: dict | None = None):
+    """Minimal GGUF v3 writer for tests (F32 tensors only).
+    kv_overrides: unprefixed key -> int/float, merged over the defaults."""
+    meta = {
+        "embedding_length": 64, "block_count": 2,
+        "attention.head_count": 4, "attention.head_count_kv": 2,
+        "feed_forward_length": 128, "context_length": 512,
+        "rope.freq_base": 10000.0,
+        "attention.layer_norm_rms_epsilon": 1e-5,
+    }
+    meta.update(kv_overrides or {})
     kvs = [
         _w_kv_str("general.architecture", meta_arch),
         _w_kv_u32("general.alignment", 32),
-        _w_kv_u32(f"{meta_arch}.embedding_length", 64),
-        _w_kv_u32(f"{meta_arch}.block_count", 2),
-        _w_kv_u32(f"{meta_arch}.attention.head_count", 4),
-        _w_kv_u32(f"{meta_arch}.attention.head_count_kv", 2),
-        _w_kv_u32(f"{meta_arch}.feed_forward_length", 128),
-        _w_kv_u32(f"{meta_arch}.context_length", 512),
-        _w_kv_f32(f"{meta_arch}.rope.freq_base", 10000.0),
-        _w_kv_f32(f"{meta_arch}.attention.layer_norm_rms_epsilon", 1e-5),
         _w_kv_u32("tokenizer.ggml.eos_token_id", 2),
     ]
+    for k, v in meta.items():
+        key = f"{meta_arch}.{k}"
+        kvs.append(_w_kv_f32(key, v) if isinstance(v, float)
+                   else _w_kv_u32(key, int(v)))
     infos = []
     data = b""
     for name, arr in tensors.items():
@@ -168,3 +174,123 @@ def test_q6_k_dequant_vs_scalar(rng):
         + np.float16(0.77).tobytes()
     got = dequant_q6_k(block, 256)
     np.testing.assert_allclose(got, _scalar_q6k(block), atol=1e-4)
+
+
+# -- arch round-trips: GGUF load must equal safetensors load ----------------
+
+def _hf_to_gguf(hf: str, arch: str) -> str:
+    """Invert the name mapping for test emission."""
+    import re
+    from cake_tpu.utils.gguf import (GGUF_NAME_MAP, GGUF_NAME_OVERRIDES)
+    if hf.endswith("embed_tokens.weight"):
+        return "token_embd.weight"
+    if hf == "model.norm.weight":
+        return "output_norm.weight"
+    if hf == "lm_head.weight":
+        return "output.weight"
+    m = re.match(r"model\.layers\.(\d+)\.(.+)\.(weight|bias)$", hf)
+    assert m, hf
+    inv = {v: k for k, v in GGUF_NAME_MAP.items()}
+    inv.update({v: k for k, v in GGUF_NAME_OVERRIDES.get(arch, {}).items()})
+    stem = inv[m.group(2)]
+    return f"blk.{m.group(1)}.{stem}.{m.group(3)}"
+
+
+def _roundtrip_arch(tmp_path, fam, gguf_arch, kv_overrides, cfg_overrides,
+                    gguf_norm_offset=0.0):
+    import jax
+    import jax.numpy as jnp
+    from cake_tpu.models import init_params, tiny_config
+    from cake_tpu.models.common.layers import forward_train
+    from cake_tpu.runtime import load_config_and_quant
+    from cake_tpu.utils.export import params_to_hf_tensors
+    from cake_tpu.utils.gguf import GgufStorage
+    from cake_tpu.utils.loaders import ParamLoader
+    from cake_tpu.utils.safetensors_io import TensorStorage, save_safetensors
+
+    cfg = tiny_config(fam, **cfg_overrides)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    hf = params_to_hf_tensors(cfg, params)
+
+    # split expert tensors into stacked GGUF banks, map the rest by name
+    import re
+    banks: dict[str, dict[int, np.ndarray]] = {}
+    gguf_tensors: dict[str, np.ndarray] = {}
+    for name, arr in hf.items():
+        em = re.match(
+            r"model\.layers\.(\d+)\.mlp\.experts\.(\d+)\.(\w+)\.weight$",
+            name)
+        if em:
+            stem = {"gate_proj": "ffn_gate_exps", "up_proj": "ffn_up_exps",
+                    "down_proj": "ffn_down_exps"}[em.group(3)]
+            banks.setdefault(f"blk.{em.group(1)}.{stem}.weight",
+                             {})[int(em.group(2))] = arr
+        else:
+            if gguf_norm_offset and name.endswith("norm.weight"):
+                # llama.cpp gemma converters store norms with +1 baked in
+                arr = arr + np.float32(gguf_norm_offset)
+            gguf_tensors[_hf_to_gguf(name, gguf_arch)] = arr
+    for bname, parts in banks.items():
+        gguf_tensors[bname] = np.stack([parts[e]
+                                        for e in sorted(parts)])
+
+    gdir = tmp_path / "gguf"
+    gdir.mkdir()
+    write_tiny_gguf(str(gdir / "m.gguf"), gguf_tensors, gguf_arch,
+                    kv_overrides)
+    sdir = tmp_path / "st"
+    sdir.mkdir()
+    save_safetensors(str(sdir / "model.safetensors"), hf)
+
+    # config straight from GGUF metadata must select the right family
+    gcfg, _, _ = load_config_and_quant(str(gdir))
+    assert gcfg.arch == cfg.arch
+    assert gcfg.num_hidden_layers == cfg.num_hidden_layers
+
+    p_gguf = ParamLoader(gcfg, GgufStorage(str(gdir / "m.gguf")),
+                         jnp.float32).load()
+    p_st = ParamLoader(gcfg, TensorStorage.from_model_dir(str(sdir)),
+                       jnp.float32).load()
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 255, (1, 7)))
+    l_gguf = forward_train(gcfg, p_gguf, toks)
+    l_st = forward_train(gcfg, p_st, toks)
+    np.testing.assert_allclose(np.asarray(l_gguf), np.asarray(l_st),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gguf_gemma3_roundtrip(tmp_path):
+    """Sandwich norms: ffn_norm maps to PRE-feedforward for gemma-family."""
+    _roundtrip_arch(
+        tmp_path, "gemma3", "gemma3",
+        kv_overrides={"block_count": 4, "attention.head_count_kv": 2,
+                      "attention.sliding_window": 16,
+                      "attention.key_length": 16},
+        cfg_overrides={}, gguf_norm_offset=1.0)
+
+
+def test_gguf_olmo2_roundtrip(tmp_path):
+    """Post-norm layout via post_attention_norm/post_ffw_norm names."""
+    _roundtrip_arch(
+        tmp_path, "olmo2", "olmo2",
+        kv_overrides={"block_count": 4},
+        cfg_overrides={})
+
+
+def test_gguf_qwen3moe_roundtrip(tmp_path):
+    """Stacked expert banks + router through virtual per-expert names."""
+    _roundtrip_arch(
+        tmp_path, "qwen3_moe", "qwen3moe",
+        kv_overrides={"block_count": 4, "expert_count": 8,
+                      "expert_used_count": 2,
+                      "expert_feed_forward_length": 32,
+                      "attention.key_length": 16},
+        cfg_overrides={})
+
+
+def test_gguf_unknown_arch_clear_error(tmp_path):
+    write_tiny_gguf(str(tmp_path / "m.gguf"),
+                    {"token_embd.weight": np.zeros((8, 64), np.float32)},
+                    "qwen3next")
+    r = GgufReader(str(tmp_path / "m.gguf"))
+    with pytest.raises(NotImplementedError, match="qwen3next"):
+        gguf_config_dict(r)

@@ -63,6 +63,7 @@ def create_app(state: ApiState, basic_auth: str | None = None) -> web.Applicatio
     app.router.add_post("/api/v1/image", image_routes.images_generations)
     app.router.add_post("/v1/audio/speech", audio_routes.audio_speech)
     app.router.add_get("/api/v1/topology", ui_routes.topology)
+    app.router.add_get("/api/v1/layers", ui_routes.layers)
     app.router.add_get("/", ui_routes.index)
     return app
 

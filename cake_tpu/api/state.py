@@ -23,6 +23,7 @@ class ApiState:
     audio_model: Any = None
     topology: Any = None            # cluster Topology or None
     voices_dir: str | None = None   # server-side voice-prompt directory
+    layer_tensors: dict | None = None   # per-layer tensor detail for the UI
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     created: int = 0
 

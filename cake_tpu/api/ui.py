@@ -44,6 +44,36 @@ async def topology(request: web.Request) -> web.Response:
     return web.json_response({"master": master, "nodes": nodes})
 
 
+async def layers(request: web.Request) -> web.Response:
+    """Per-layer tensor detail (name/shape/dtype/bytes) from the
+    safetensors headers (ref: api/ui.rs parallel header scan). Separate
+    from /api/v1/topology: the blob is static and can be large, while
+    topology is polled — clients fetch this once."""
+    state: ApiState = request.app["state"]
+    return web.json_response(
+        {"layers": getattr(state, "layer_tensors", None) or {}})
+
+
+def layer_tensor_details(model_dir: str) -> dict:
+    """{layer index (str): [{name, shape, dtype, bytes}]} + "other" for
+    non-layer tensors — header-only scan, no tensor data read."""
+    from ..utils.safetensors_io import TensorStorage, layer_of
+    try:
+        st = TensorStorage.from_model_dir(model_dir)
+    except FileNotFoundError:
+        return {}
+    out: dict[str, list] = {}
+    for name, rec in sorted(st.records.items()):
+        layer = layer_of(name)
+        key = str(layer) if layer is not None else "other"
+        out.setdefault(key, []).append({
+            "name": name, "shape": list(rec.shape), "dtype": rec.dtype,
+            "bytes": rec.nbytes,
+        })
+    st.close()
+    return out
+
+
 async def index(request: web.Request) -> web.Response:
     with open(os.path.join(_HERE, "index.html")) as f:
         return web.Response(text=f.read(), content_type="text/html")

@@ -93,9 +93,19 @@ def cmd_serve(args) -> int:
     if args.audio_model:
         from .runtime import build_audio_model
         audio_model = build_audio_model(args.audio_model, dtype=args.dtype)
+    layer_tensors = None
+    try:
+        # resolve the same way _build did (repo id -> cached snapshot dir)
+        from .api.ui import layer_tensor_details
+        from .utils.hub import resolve_model
+        layer_tensors = layer_tensor_details(
+            resolve_model(os.path.expanduser(args.model), download=False))
+    except Exception:
+        pass        # GGUF-only dirs / unresolved ids: UI shows no detail
     state = ApiState(model=gen, tokenizer=tokenizer, model_id=model_id,
                      topology=topo, image_model=image_model,
-                     audio_model=audio_model, voices_dir=args.voices_dir)
+                     audio_model=audio_model, voices_dir=args.voices_dir,
+                     layer_tensors=layer_tensors)
     serve(state, host=args.host, port=args.port, basic_auth=args.basic_auth)
     return 0
 

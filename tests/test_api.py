@@ -196,6 +196,10 @@ def test_topology_endpoint():
         data = await r.json()
         assert data["master"]["model"] == "mock-model"
         assert data["master"]["num_layers"] == 4
+        assert "layers" not in data          # static blob lives elsewhere
+        r = await client.get("/api/v1/layers")
+        assert r.status == 200
+        assert (await r.json())["layers"] == {}
     with_client(make_state(), scenario)
 
 
@@ -205,6 +209,11 @@ def test_web_ui():
         assert r.status == 200
         html = await r.text()
         assert "cake" in html and "chat/completions" in html
+        # the two-view SPA: chat + cluster topology visualization
+        for el in ("tabChat", "tabCluster", "layerStrip", "nodeCards",
+                   "layerBody", "api/v1/topology", "sendMessage",
+                   "refreshTopology"):
+            assert el in html, el
     with_client(make_state(), scenario)
 
 
@@ -282,3 +291,25 @@ def test_bad_sampling_params_400():
                 r = await client.post("/v1/chat/completions", json=payload)
                 assert r.status == 400, payload
     asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_topology_layer_detail(tmp_path):
+    """Per-layer tensor detail (name/shape/dtype/bytes) from the
+    safetensors headers feeds the UI's layers view (ref: api/ui.rs
+    parallel header scan)."""
+    import jax
+    import jax.numpy as jnp
+    from cake_tpu.api.ui import layer_tensor_details
+    from cake_tpu.models import init_params, tiny_config
+    from cake_tpu.utils.export import params_to_hf_tensors
+    from cake_tpu.utils.safetensors_io import save_safetensors
+    cfg = tiny_config("llama")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    save_safetensors(str(tmp_path / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    detail = layer_tensor_details(str(tmp_path))
+    assert set(detail) == {"0", "1", "2", "3", "other"}
+    l0 = {t["name"] for t in detail["0"]}
+    assert "model.layers.0.self_attn.q_proj.weight" in l0
+    t = detail["0"][0]
+    assert t["bytes"] > 0 and t["shape"] and t["dtype"]

@@ -213,10 +213,16 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
                  assignments: dict[str, tuple[int, int]] | None = None,
                  dtype_str: str = "bf16", max_cache_len: int = 2048,
                  push_weights: bool = True,
-                 master_device_fraction_reserved: float = 0.1) -> MasterSetup:
+                 master_device_fraction_reserved: float = 0.1,
+                 fp8_native: bool = False) -> MasterSetup:
     """Connect/auth/assign/push to each worker; build the stage chain.
 
     workers: discovery replies ({"name", "host", "port", "caps"}).
+    fp8_native: stream the checkpoint's f8e4m3 tensors verbatim (the wire
+    already carries raw safetensors bytes, so FP8 stays 1 byte/param in
+    transit) and have every node keep them native in HBM with per-layer
+    dequant fused into the matmuls (ref: native_dtype_backend.rs through
+    sharding/mod.rs push_model_data).
     """
     import json
     import os
@@ -252,7 +258,7 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
         assignment = proto.layer_assignment(
             model_id=mhash, arch=cfg.arch, config=config_raw,
             start=start, end=end, dtype=dtype_str, cache_key=ckey,
-            push_weights=push_weights)
+            push_weights=push_weights, fp8_native=fp8_native)
         assignment["max_cache_len"] = max_cache_len
         assignment["expected_files"] = expected
         resp = client.assign(assignment)
@@ -286,12 +292,17 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
 
     dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32,
              "f16": jnp.float16}.get(dtype_str, jnp.bfloat16)
-    master_params = load_model_params(cfg, model_dir, dtype,
+    quant = None
+    if fp8_native:
+        from ..utils.quant import fp8_native_quant
+        quant = fp8_native_quant()
+    master_params = load_model_params(cfg, model_dir, dtype, quant=quant,
                                       layer_range=(0, 0),
                                       include_embed=True, include_head=True)
     for kind, lo, hi, runner in ranges:
         if kind == "local":
-            p = load_model_params(cfg, model_dir, dtype, layer_range=(lo, hi),
+            p = load_model_params(cfg, model_dir, dtype, quant=quant,
+                                  layer_range=(lo, hi),
                                   include_embed=False, include_head=False)
             runner = LocalStage(cfg, p, lo, hi)
             cache = init_cache(cfg, 1, max_cache_len, dtype, (lo, hi))

@@ -155,10 +155,12 @@ def worker_info(name: str, layers: list[int], backend: str, device: str,
 
 def layer_assignment(model_id: str, arch: str, config: dict,
                      start: int, end: int, dtype: str,
-                     cache_key: str, push_weights: bool) -> dict:
+                     cache_key: str, push_weights: bool,
+                     fp8_native: bool = False) -> dict:
     return {"t": "layer_assignment", "model_id": model_id, "arch": arch,
             "config": config, "start": start, "end": end, "dtype": dtype,
-            "cache_key": cache_key, "push_weights": push_weights}
+            "cache_key": cache_key, "push_weights": push_weights,
+            "fp8_native": fp8_native}
 
 
 def model_chunk(file_name: str, index: int, total: int, data: bytes,

@@ -194,8 +194,13 @@ class WorkerServer:
         try:
             t0 = time.monotonic()
             from ..utils.loaders import load_model_params
+            quant = None
+            if msg.get("fp8_native"):
+                from ..utils.quant import fp8_native_quant
+                quant = fp8_native_quant()
             params = load_model_params(
-                cfg, model_dir, st.dtype, layer_range=(st.start, st.end),
+                cfg, model_dir, st.dtype, quant=quant,
+                layer_range=(st.start, st.end),
                 include_embed=False, include_head=False)
             st.stage = LocalStage(cfg, params, st.start, st.end)
             # warm the decode-shape compile so the first token isn't slow

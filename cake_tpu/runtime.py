@@ -155,11 +155,11 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
     model_dir = resolve_model(model, download=download)
     cfg, quant, raw = load_config_and_quant(model_dir, arch)
     if fp8_native:
-        from .utils.quant import Fp8Quantization
+        from .utils.quant import Fp8Quantization, fp8_native_quant
         if not isinstance(quant, Fp8Quantization):
             raise ValueError("--fp8-native requires an FP8 checkpoint "
                              f"(detected quantization: {quant.name})")
-        quant = Fp8Quantization(keep_native=True)
+        quant = fp8_native_quant()
     dt = parse_dtype(dtype)
     tokenizer = CakeTokenizer(model_dir)
     model_id = os.path.basename(model.rstrip("/"))
@@ -182,10 +182,6 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
             log.warning("no workers found; running all-local")
 
     if cluster_key and workers:
-        if fp8_native:
-            raise NotImplementedError(
-                "--fp8-native is not yet plumbed through cluster weight "
-                "streaming; run without it in distributed mode")
         from .cluster.master import DistributedTextModel, master_setup
         assignments = None
         if topology_path:
@@ -194,7 +190,8 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                            for name, n in topo.nodes.items() if n.layer_range}
         setup = master_setup(model_dir, cluster_key, cfg, workers,
                              assignments=assignments, dtype_str=dtype,
-                             max_cache_len=max_cache_len)
+                             max_cache_len=max_cache_len,
+                             fp8_native=fp8_native)
         gen = DistributedTextModel(cfg, setup.master_params, setup.stages,
                                    tokenizer=tokenizer, dtype=dt,
                                    max_cache_len=max_cache_len, seed=seed)

@@ -120,6 +120,13 @@ def dequantize_gptq_4bit(qweight: np.ndarray, scales: np.ndarray,
     return np.ascontiguousarray(w.T)
 
 
+def fp8_native_quant() -> "Fp8Quantization":
+    """The keep-native FP8 strategy (1 byte/param in HBM, per-layer dequant
+    fused into the matmuls) — single construction site for the runtime,
+    master and worker paths."""
+    return Fp8Quantization(keep_native=True)
+
+
 def detect_quantization(config: dict):
     """From config.json quantization_config (top-level or text_config —
     ref: gptq.rs is_gptq_quantized, utils/mod.rs detection)."""

@@ -163,7 +163,7 @@ def make_rope(cfg: ModelConfig) -> dict:
 
 def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
                       layer_cache: dict, pos0, rope: dict, valid_len=None,
-                      fresh: bool = False):
+                      flash_mode: str = "off"):
     """x: [B, S, H], pos0: traced scalar (first absolute position).
     Returns (y [B, S, H], new_layer_cache)."""
     b, s, _ = x.shape
@@ -209,16 +209,30 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
         idx < valid_len, positions, -1)                    # pads invisible
     kv_pos_new = jnp.broadcast_to(kv_pos_new[None, :], (b, s))
     from ...ops.flash import FLASH_MIN_SEQ, flash_attention, flash_enabled
-    use_flash = (fresh and spec.window is None and s >= FLASH_MIN_SEQ
-                 and s % 128 == 0 and flash_enabled())
-    if use_flash:
+    flash_ok = s >= FLASH_MIN_SEQ and flash_enabled()
+    use_flash = flash_ok and (
+        flash_mode == "fresh"
+        or (flash_mode == "append" and spec.window is None
+            and layer_cache is not None))
+    if use_flash and flash_mode == "fresh":
         # fresh-cache prefill: nothing in the cache is visible yet, so
-        # causal flash over the in-pass K/V is exact (Pallas kernel; ref:
-        # flash-attn dispatch attention.rs:270-277). Inference-only — the
-        # kernel has no VJP; `fresh` is never set on the training path.
-        y = flash_attention(q, k, v, scale=cfg.attn_scale, valid_len=valid_len)
+        # causal flash over the in-pass K/V is exact, incl. SWA layers via
+        # the kernel's window mask (Pallas; ref: flash-attn dispatch
+        # attention.rs:270-277). Inference-only — the kernel has no VJP;
+        # flash_mode stays "off" on the training path.
+        y = flash_attention(q, k, v, scale=cfg.attn_scale, valid_len=valid_len,
+                            window=spec.window)
         new_cache = (update_kv_cache(layer_cache, k, v, pos0, valid_len)
                      if layer_cache is not None else None)
+        kv_pos = k_all = v_all = None
+    elif use_flash:
+        # continued prefill (cache append): scatter the chunk into the
+        # cache, then flash over the buffer — valid because "append" is
+        # only selected when the buffer is unwrapped (index == position)
+        new_cache = update_kv_cache(layer_cache, k, v, pos0, valid_len)
+        y = flash_attention(q, new_cache["k"], new_cache["v"],
+                            scale=cfg.attn_scale, valid_len=valid_len,
+                            q_offset=pos0)
         kv_pos = k_all = v_all = None
     elif layer_cache is None:
         kv_pos, k_all, v_all = kv_pos_new, k, v
@@ -281,35 +295,35 @@ def _ffn(cfg, spec, p, x):
 
 
 def _attn(cfg, spec, p, x, lc, pos0, rope, valid_len=None,
-          fresh=False):
+          flash_mode="off"):
     if spec.kind == "linear":
         from ..qwen3_5 import gdn_forward
         return gdn_forward(cfg, p["linear_attn"], x, lc, pos0, valid_len)
     return attention_forward(cfg, spec, p["self_attn"], x, lc, pos0, rope,
-                             valid_len, fresh)
+                             valid_len, flash_mode)
 
 
 def block_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
                   layer_cache: dict, pos0, rope: dict, valid_len=None,
-                  fresh: bool = False):
+                  flash_mode: str = "off"):
     """One decoder block; norm placement per family
     (ref: common/transformer.rs pre-norm; olmo2/block.rs post-norm;
     gemma3/block.rs sandwich)."""
     eps = cfg.rms_norm_eps
     if spec.norm_style == "pre":
         h = rms_norm(x, p["input_layernorm"]["weight"], eps)
-        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, fresh)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, flash_mode)
         x = x + attn_out
         h = rms_norm(x, p["post_attention_layernorm"]["weight"], eps)
         x = x + _ffn(cfg, spec, p, h)
     elif spec.norm_style == "post":
-        attn_out, layer_cache = _attn(cfg, spec, p, x, layer_cache, pos0, rope, valid_len, fresh)
+        attn_out, layer_cache = _attn(cfg, spec, p, x, layer_cache, pos0, rope, valid_len, flash_mode)
         x = x + rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
         x = x + rms_norm(_ffn(cfg, spec, p, x),
                          p["post_feedforward_layernorm"]["weight"], eps)
     elif spec.norm_style == "sandwich":
         h = rms_norm(x, p["input_layernorm"]["weight"], eps)
-        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, fresh)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, flash_mode)
         attn_out = rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
         x = x + attn_out
         h = rms_norm(x, p["pre_feedforward_layernorm"]["weight"], eps)
@@ -323,7 +337,7 @@ def block_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
 
 def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
                    layer_range: tuple[int, int] | None = None, valid_len=None,
-                   fresh: bool = False):
+                   flash_mode: str = "off"):
     """Run a contiguous range of blocks over hidden states — the jit unit for
     both local stages and remote workers (ref: Forwarder.forward_batch /
     worker.rs op-batch execution, but compiled as ONE device program)."""
@@ -339,7 +353,7 @@ def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
     for j, spec in enumerate(specs):
         x, new_layers[j] = block_forward(cfg, spec, params["layers"][j], x,
                                          cache["layers"][j], pos0, rope,
-                                         valid_len, fresh)
+                                         valid_len, flash_mode)
     advance = x.shape[1] if valid_len is None else valid_len
     new_cache = {"layers": new_layers, "pos": pos0 + advance}
     return x, new_cache

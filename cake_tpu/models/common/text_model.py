@@ -111,11 +111,12 @@ class TextModel:
         cfg = self.cfg
 
         @functools.partial(jax.jit, donate_argnums=(2,),
-                           static_argnames=("fresh",))
-        def _prefill(params, tokens, cache, pos0, valid_len, fresh):
+                           static_argnames=("flash_mode",))
+        def _prefill(params, tokens, cache, pos0, valid_len, flash_mode):
             x = embed_tokens(cfg, params, tokens)
             x, cache = forward_layers(cfg, params, x, cache, pos0,
-                                      valid_len=valid_len, fresh=fresh)
+                                      valid_len=valid_len,
+                                      flash_mode=flash_mode)
             # logits at the last valid position
             idx = jnp.clip(valid_len - 1, 0, x.shape[1] - 1)
             x_last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
@@ -174,14 +175,21 @@ class TextModel:
     def prefill(self, cache, token_ids: Iterable[int], pos0: int = 0):
         ids = list(token_ids)
         n = len(ids)
-        bkt = check_prefill_bounds(n, pos0, kv_capacity(self.cfg, cache),
-                                   self.max_cache_len)
+        cap = kv_capacity(self.cfg, cache)
+        bkt = check_prefill_bounds(n, pos0, cap, self.max_cache_len)
         padded = np.zeros((1, bkt), np.int32)
         padded[0, :n] = ids
+        if pos0 == 0:
+            flash_mode = "fresh"
+        elif cap is not None and pos0 + bkt <= cap:
+            # continued prefill can flash over the (unwrapped) cache buffer
+            flash_mode = "append"
+        else:
+            flash_mode = "off"
         logits, cache = self._prefill(self.params, jnp.asarray(padded), cache,
                                       jnp.asarray(pos0, jnp.int32),
                                       jnp.asarray(n, jnp.int32),
-                                      fresh=(pos0 == 0))
+                                      flash_mode=flash_mode)
         return logits, cache
 
     def decode_logits(self, cache, token_id: int):

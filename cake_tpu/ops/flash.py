@@ -11,12 +11,14 @@ kernel grid is (batch*q_heads, q_blocks) with the K loop inside, GQA via
 q_head -> kv_head integer division. Causal masking by absolute block
 bounds; optional valid_len clamps padded prefill tails.
 
-Dispatched from the serving prefill when the cache is FRESH (pos0 == 0 —
-a host-static property, threaded as the `fresh` flag through
-forward_layers) and seq_len >= FLASH_MIN_SEQ on TPU. The XLA einsum path
-remains the fallback (and the CPU/test path — interpret mode validates the
-kernel without hardware). Inference-only: no custom VJP is defined, so the
-differentiable training path never dispatches here.
+Dispatched from the serving prefill via the host-static `flash_mode`
+threaded through forward_layers: "fresh" (pos0 == 0; SWA layers included
+via the kernel's window mask) and "append" (continued prefill — the chunk
+is scattered into the cache first, then the kernel runs over the unwrapped
+buffer with a q_offset scalar), for seq_len >= FLASH_MIN_SEQ on TPU. The
+XLA einsum path remains the fallback (and the CPU/test path — interpret
+mode validates the kernel without hardware). Inference-only: no custom VJP
+is defined, so the differentiable training path never dispatches here.
 """
 from __future__ import annotations
 
@@ -34,23 +36,32 @@ FLASH_MIN_SEQ = 256
 NEG_INF = -1e30
 
 
-def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_k,
-                  kv_len, causal):
+def _flash_kernel(vl_ref, off_ref, q_ref, k_ref, v_ref, o_ref, *, scale,
+                  block_k, kv_len, causal, window):
     """One (batch*head, q_block) program: loop K blocks with online softmax.
 
-    vl_ref: (1, 1) SMEM valid-length scalar (dynamic — padded prefill);
+    vl_ref:  (1, 1) SMEM scalar — absolute key-position limit (valid keys
+             occupy positions [0, limit); padded prefill tails excluded).
+    off_ref: (1, 1) SMEM scalar — absolute position of query row 0
+             (continued prefill appends at pos0 > 0; keys' positions are
+             their buffer indices, valid because append mode requires an
+             unwrapped cache).
     q_ref: [block_q, D]; k_ref/v_ref: [kv_len, D]; o_ref: [block_q, D].
+    window: sliding-window size (None = full attention) — key visible iff
+             q_pos - window < k_pos.
     """
     block_q, d = q_ref.shape
     qi = pl.program_id(1)
     q_start = qi * block_q
+    off = off_ref[0, 0]
 
     q = q_ref[:].astype(jnp.float32) * scale
     acc = jnp.zeros((block_q, d), jnp.float32)
     m = jnp.full((block_q,), -jnp.inf, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
 
-    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    q_pos = off + q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
     limit = vl_ref[0, 0]
 
     def body(ki, carry):
@@ -64,6 +75,8 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_k,
         mask = k_pos < limit
         if causal:
             mask &= k_pos <= q_pos
+        if window is not None:
+            mask &= k_pos > q_pos - window
         s = jnp.where(mask, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -74,58 +87,88 @@ def _flash_kernel(vl_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_k,
         l = l * alpha + jnp.sum(p, axis=-1)
         return acc, m_new, l
 
+    n_k_full = kv_len // block_k
     if causal:
-        # skip K blocks entirely above the causal diagonal
-        n_k = (q_start + block_q + block_k - 1) // block_k
+        # skip K blocks entirely above the causal diagonal (traced bound:
+        # off is dynamic in append mode)
+        n_k = jnp.minimum(
+            (off + q_start + block_q + block_k - 1) // block_k, n_k_full)
     else:
-        n_k = kv_len // block_k
-    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc, m, l))
+        n_k = n_k_full
+    if window is not None:
+        # skip K blocks entirely below the window
+        lo = jnp.maximum((off + q_start - window + 1) // block_k, 0)
+    else:
+        lo = 0
+    acc, m, l = jax.lax.fori_loop(lo, n_k, body, (acc, m, l))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
-def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
-                    valid_len=None, block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
-    """q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] (Hq multiple of Hkv).
+def _pad_seq(x, mult: int):
+    s = x.shape[1]
+    pad = (-s) % mult
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return x
 
-    Returns [B, S, Hq, D]. S must be a multiple of block sizes (the caller
-    pads — bucketed prefill already guarantees power-of-two lengths).
-    valid_len: int or traced scalar bounding valid keys (padded prefill
-    tails); None means all S keys are valid.
+
+def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
+                    valid_len=None, q_offset=None, window: int | None = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
+    """q: [B, Sq, Hq, D]; k/v: [B, Skv, Hkv, D] (Hq multiple of Hkv).
+
+    Returns [B, Sq, Hq, D]. Non-multiple-of-block lengths are padded here
+    (pad keys are masked via the limit, pad query rows sliced off).
+    valid_len: int or traced scalar — number of valid NEW keys; the
+       absolute limit becomes q_offset + valid_len.
+    q_offset: absolute position of query row 0 (continued prefill over an
+       unwrapped cache buffer whose index == position); None/0 = fresh.
+    window: sliding-window size for SWA layers.
     """
     b, s, hq, d = q.shape
+    skv = k.shape[1]
     hkv = k.shape[2]
     g = hq // hkv
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0, (s, block_q, block_k)
+    # blocks stay multiples of 16 (bf16 TPU tile); _pad_seq covers the rest
+    block_q = min(block_q, max(-(-s // 16) * 16, 16))
+    block_k = min(block_k, max(-(-skv // 16) * 16, 16))
+
+    off = jnp.asarray(0 if q_offset is None else q_offset, jnp.int32)
+    vl = off + jnp.asarray(s if valid_len is None else valid_len, jnp.int32)
+
+    q = _pad_seq(q, block_q)
+    k = _pad_seq(k, block_k)
+    v = _pad_seq(v, block_k)
+    s_p, skv_p = q.shape[1], k.shape[1]
 
     # [B, S, H, D] -> [B*H, S, D] with GQA expansion folded into indexing
-    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, s, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * hq, s_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, skv_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, skv_p, d)
 
-    vl = jnp.asarray(s if valid_len is None else valid_len,
-                     jnp.int32).reshape(1, 1)
     kernel = functools.partial(_flash_kernel, scale=scale, block_k=block_k,
-                               kv_len=s, causal=causal)
+                               kv_len=skv_p, causal=causal, window=window)
     out = pl.pallas_call(
         kernel,
-        grid=(b * hq, s // block_q),
+        grid=(b * hq, s_p // block_q),
         in_specs=[
             pl.BlockSpec((1, 1), lambda h, i: (0, 0),
                          memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda h, i: (0, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((None, block_q, d), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((None, s, d), lambda h, i: (h // g, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda h, i: (h // g, 0, 0)),
+            pl.BlockSpec((None, skv_p, d), lambda h, i: (h // g, 0, 0)),
+            pl.BlockSpec((None, skv_p, d), lambda h, i: (h // g, 0, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda h, i: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * hq, s_p, d), q.dtype),
         interpret=interpret,
-    )(vl, qt, kt, vt)
-    return out.reshape(b, hq, s, d).transpose(0, 2, 1, 3)
+    )(vl.reshape(1, 1), off.reshape(1, 1), qt, kt, vt)
+    out = out.reshape(b, hq, s_p, d).transpose(0, 2, 1, 3)
+    return out[:, :s]
 
 
 def flash_enabled() -> bool:

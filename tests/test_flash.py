@@ -83,3 +83,100 @@ def test_flash_valid_len_masks_padding(rng):
     want = multi_head_attention(q, k, v, mask)
     np.testing.assert_allclose(np.asarray(got)[:, :vl], np.asarray(want)[:, :vl],
                                atol=2e-4, rtol=1e-3)
+
+
+def test_flash_sliding_window(rng):
+    """SWA masking inside the kernel must equal the position-mask path."""
+    b, s, h, d, w = 1, 256, 2, 16, 48
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    got = flash_attention(q, k, v, window=w, interpret=True,
+                          block_q=64, block_k=64)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    mask = make_attention_mask(pos, pos, window=w)
+    want = multi_head_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_flash_non_multiple_lengths(rng):
+    """The wrapper pads odd lengths to the block size internally."""
+    b, s, h, d = 1, 100, 2, 16          # 100 % 64 != 0
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    got = flash_attention(q, k, v, interpret=True, block_q=64, block_k=64)
+    want = causal_sdpa(q, k, v)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_flash_append_q_offset(rng):
+    """Continued prefill: queries at pos0..pos0+s over a prefix-filled
+    buffer must equal full attention over the valid prefix+chunk."""
+    b, h, d = 1, 2, 16
+    cap, pos0, s = 256, 70, 64
+    kv = jnp.asarray(rng.standard_normal((b, cap, h, d)), jnp.float32)
+    vv = jnp.asarray(rng.standard_normal((b, cap, h, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    got = flash_attention(q, kv, vv, valid_len=s, q_offset=pos0,
+                          interpret=True, block_q=64, block_k=64)
+    q_pos = jnp.broadcast_to(pos0 + jnp.arange(s, dtype=jnp.int32)[None],
+                             (b, s))
+    k_idx = jnp.arange(cap, dtype=jnp.int32)
+    k_pos = jnp.where(k_idx < pos0 + s, k_idx, -1)[None]
+    mask = make_attention_mask(q_pos, jnp.broadcast_to(k_pos, (b, cap)))
+    want = multi_head_attention(q, kv, vv, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_flash_chunked_prefill_serving(rng, monkeypatch):
+    """Serving path: chunked prefill (append mode) and SWA fresh prefill
+    both dispatch the kernel and match the mask path end to end."""
+    import cake_tpu.ops.flash as fl
+    from cake_tpu.models import TextModel, tiny_config
+
+    calls = []
+    orig = fl.flash_attention
+
+    def spy(*a, **k):
+        calls.append(k.get("q_offset") is not None)
+        k["interpret"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(fl, "flash_enabled", lambda: True)
+    monkeypatch.setattr(fl, "FLASH_MIN_SEQ", 64)
+    monkeypatch.setattr(fl, "flash_attention", spy)
+
+    toks = list(np.random.default_rng(1).integers(0, 255, 150))
+    cfg = tiny_config("qwen3", max_position_embeddings=512)
+    m = TextModel(cfg, dtype=jnp.float32, max_cache_len=256)
+    cache = m.new_cache()
+    _, cache = m.prefill(cache, toks[:80])          # fresh, bucket 128
+    n_fresh = len(calls)
+    l1, cache = m.prefill(cache, toks[80:], pos0=80)  # append, bucket 128
+    assert n_fresh == cfg.num_hidden_layers
+    assert any(calls[n_fresh:]), "append mode never dispatched flash"
+
+    monkeypatch.setattr(fl, "flash_enabled", lambda: False)
+    m2 = TextModel(cfg, dtype=jnp.float32, max_cache_len=256)
+    c2 = m2.new_cache()
+    _, c2 = m2.prefill(c2, toks[:80])
+    l2, c2 = m2.prefill(c2, toks[80:], pos0=80)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+    # SWA model: fresh prefill now flashes through the window mask
+    calls.clear()
+    monkeypatch.setattr(fl, "flash_enabled", lambda: True)
+    cfgw = tiny_config("mistral", sliding_window=48,
+                       max_position_embeddings=512)
+    mw = TextModel(cfgw, dtype=jnp.float32, max_cache_len=256)
+    lw, _ = mw.prefill(mw.new_cache(), toks)        # bucket 256
+    assert len(calls) == cfgw.num_hidden_layers
+    monkeypatch.setattr(fl, "flash_enabled", lambda: False)
+    mw2 = TextModel(cfgw, dtype=jnp.float32, max_cache_len=256)
+    lw2, _ = mw2.prefill(mw2.new_cache(), toks)
+    np.testing.assert_allclose(np.asarray(lw), np.asarray(lw2), atol=1e-5)

@@ -49,7 +49,7 @@ def test_padding_invariance():
     padded[0, :5] = toks
     l2, _ = model._prefill(model.params, jnp.asarray(padded), model.new_cache(),
                            jnp.asarray(0, jnp.int32), jnp.asarray(5, jnp.int32),
-                           fresh=True)
+                           flash_mode="fresh")
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=2e-3,
                                rtol=1e-3)
     # chunked prefill across two calls must also agree

@@ -1,5 +1,8 @@
 #!/bin/sh
 python - <<'PY'
+import os
+if os.environ.get("CAKE_BENCH_CPU") == "1":
+    import jax; jax.config.update("jax_platforms", "cpu")
 import json, time, jax, jax.numpy as jnp
 from cake_tpu.ops.flash import flash_attention
 b, s, hq, hkv, d = 1, 4096, 16, 8, 128

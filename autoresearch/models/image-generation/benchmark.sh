@@ -1,0 +1,15 @@
+#!/bin/sh
+python - <<'PY'
+import os
+if os.environ.get("CAKE_BENCH_CPU") == "1":
+    import jax; jax.config.update("jax_platforms", "cpu")
+import json, time, jax, jax.numpy as jnp
+from cake_tpu.models.image.flux import tiny_flux_config, FluxImageModel
+import cake_tpu.models.image.mmdit as mm
+cfg = tiny_flux_config()
+m = FluxImageModel(cfg, dtype=jnp.bfloat16)
+m.generate_image("warm", width=64, height=64, steps=1, seed=0)
+t0 = time.perf_counter()
+m.generate_image("bench", width=64, height=64, steps=4, seed=0)
+print(json.dumps({"mmdit_step_s": round((time.perf_counter() - t0) / 4, 4)}))
+PY

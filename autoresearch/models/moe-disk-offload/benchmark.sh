@@ -1,0 +1,19 @@
+#!/bin/sh
+python - <<'PY'
+import os
+if os.environ.get("CAKE_BENCH_CPU") == "1":
+    import jax; jax.config.update("jax_platforms", "cpu")
+import json, time, tempfile, os
+import jax, jax.numpy as jnp, numpy as np
+from cake_tpu.models import TextModel, tiny_config
+from cake_tpu.models.common.layers import init_params
+from cake_tpu.ops.sampling import SamplingConfig
+cfg = tiny_config("qwen3_moe", num_experts=16, moe_intermediate_size=64)
+m = TextModel(cfg, dtype=jnp.float32, max_cache_len=128)
+m.generate([1, 2, 3], max_new_tokens=16, chunk=16,
+           sampling=SamplingConfig(temperature=0.0))
+t0 = time.perf_counter()
+out, st = m.generate([1, 2, 3], max_new_tokens=64, chunk=32,
+                     sampling=SamplingConfig(temperature=0.0))
+print(json.dumps({"moe_offload_tok_per_s": round(st["tok_per_s"], 1)}))
+PY

@@ -1,0 +1,14 @@
+#!/bin/sh
+python - <<'PY'
+import os
+if os.environ.get("CAKE_BENCH_CPU") == "1":
+    import jax; jax.config.update("jax_platforms", "cpu")
+import json, time, jax.numpy as jnp
+from cake_tpu.models.audio import VibeVoiceTTS, tiny_tts_config
+tts = VibeVoiceTTS(tiny_tts_config(), dtype=jnp.float32, max_frames=16)
+tts.generate_speech("warm up run", max_frames=4, steps=4)
+t0 = time.perf_counter()
+tts.generate_speech("benchmark sentence for frame timing", max_frames=8,
+                    steps=4)
+print(json.dumps({"ms_per_frame": round((time.perf_counter() - t0) / 8 * 1e3, 1)}))
+PY

@@ -1,0 +1,2 @@
+#!/bin/sh
+python benches/bench_micro.py --filter auth

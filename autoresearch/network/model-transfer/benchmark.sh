@@ -1,0 +1,24 @@
+#!/bin/sh
+python - <<'PY'
+import os
+if os.environ.get("CAKE_BENCH_CPU") == "1":
+    import jax; jax.config.update("jax_platforms", "cpu")
+import json, time, tempfile, os, numpy as np
+from cake_tpu.utils.safetensors_io import TensorStorage, save_safetensors
+from cake_tpu.cluster import transfer
+d = tempfile.mkdtemp()
+tensors = {f"model.layers.{i}.w": np.random.default_rng(i).standard_normal(
+    (512, 512)).astype(np.float32) for i in range(32)}
+save_safetensors(os.path.join(d, "model.safetensors"), tensors)
+st = TensorStorage.from_model_dir(d)
+names = sorted(st.names())
+total, _ = transfer.synthesize_safetensors(st, names)
+t0 = time.perf_counter()
+n = 0
+for chunk in transfer.encode_chunks(
+        "model.safetensors", total,
+        transfer.synthesize_safetensors(st, names)[1]):
+    n += len(chunk.get("d", b""))
+dt = time.perf_counter() - t0
+print(json.dumps({"transfer_mb_s": round(total / dt / 1e6, 1)}))
+PY

@@ -1,4 +1,6 @@
-from .luxtts import LuxTTS, LuxTTSConfig, tiny_luxtts_config
+from .luxtts import (LuxTTS, LuxTTSConfig, Phonemizer, luxtts_config_from_hf,
+                     tiny_luxtts_config)
+from .luxtts_loader import detect_luxtts_checkpoint, load_luxtts
 from .vibevoice import (AudioOutput, VibeVoiceConfig, VibeVoiceTTS,
                         tiny_tts_config, vibevoice_config_from_hf)
 from .vibevoice_loader import detect_vibevoice_checkpoint, load_vibevoice

@@ -1,0 +1,164 @@
+"""LuxTTS release-checkpoint loading.
+
+Expected layout (ref: luxtts/model.rs load path):
+    model_dir/
+      config.json        {"model": {...}, "feature": {...}}
+      model.safetensors  embed + text_encoder.* + fm_decoder.*
+      vocos.safetensors  backbone.* + head.*   (or embedded in model file)
+      tokens.txt         phoneme symbol table
+      cmudict-0.7b-ipa.txt   optional word->IPA dictionary
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.mapping import coverage_report, load_mapped_params
+from ...utils.safetensors_io import TensorStorage, index_file
+from .luxtts import (LuxTTS, LuxTTSConfig, Phonemizer, init_luxtts_params,
+                     luxtts_config_from_hf)
+
+log = logging.getLogger("cake_tpu.luxtts_loader")
+
+
+def _zip_layer_mapping(dst: str, src: str) -> dict[str, str]:
+    m = {
+        f"{dst}.norm.bias": f"{src}.norm.bias",
+        f"{dst}.norm.log_scale": f"{src}.norm.log_scale",
+        f"{dst}.self_attn_weights.in_proj.weight":
+            f"{src}.self_attn_weights.in_proj.weight",
+        f"{dst}.self_attn_weights.in_proj.bias":
+            f"{src}.self_attn_weights.in_proj.bias",
+        f"{dst}.self_attn_weights.linear_pos.weight":
+            f"{src}.self_attn_weights.linear_pos.weight",
+        f"{dst}.bypass.bypass_scale": f"{src}.bypass.bypass_scale",
+        f"{dst}.bypass_mid.bypass_scale": f"{src}.bypass_mid.bypass_scale",
+    }
+    for comp in ("feed_forward1", "feed_forward2", "feed_forward3",
+                 "self_attn1", "self_attn2", "nonlin_attention"):
+        for pj in ("in_proj", "out_proj"):
+            m[f"{dst}.{comp}.{pj}.weight"] = f"{src}.{comp}.{pj}.weight"
+            m[f"{dst}.{comp}.{pj}.bias"] = f"{src}.{comp}.{pj}.bias"
+    for comp in ("conv_module1", "conv_module2"):
+        for pj in ("in_proj", "out_proj", "depthwise_conv"):
+            m[f"{dst}.{comp}.{pj}.weight"] = f"{src}.{comp}.{pj}.weight"
+            m[f"{dst}.{comp}.{pj}.bias"] = f"{src}.{comp}.{pj}.bias"
+    return m
+
+
+def luxtts_mapping(cfg: LuxTTSConfig) -> dict[str, str]:
+    """pytree path -> model.safetensors tensor name (ref: model.rs
+    docstring weight layout)."""
+    m = {"embed.weight": "embed.weight"}
+    for pj in ("in_proj", "out_proj"):
+        m[f"text_encoder.{pj}.weight"] = f"text_encoder.{pj}.weight"
+        m[f"text_encoder.{pj}.bias"] = f"text_encoder.{pj}.bias"
+        m[f"fm_decoder.{pj}.weight"] = f"fm_decoder.{pj}.weight"
+        m[f"fm_decoder.{pj}.bias"] = f"fm_decoder.{pj}.bias"
+    for i in range(cfg.text_encoder_num_layers):
+        m.update(_zip_layer_mapping(f"text_encoder.layers.{i}",
+                                    f"text_encoder.layers.{i}"))
+    for i in range(cfg.total_fm_layers):
+        m.update(_zip_layer_mapping(f"fm_decoder.layers.{i}",
+                                    f"fm_decoder.layers.{i}"))
+    for idx in ("0", "2"):
+        m[f"fm_decoder.time_embed_{idx}.weight"] = \
+            f"fm_decoder.time_embed.{idx}.weight"
+        m[f"fm_decoder.time_embed_{idx}.bias"] = \
+            f"fm_decoder.time_embed.{idx}.bias"
+    for s, ds in enumerate(cfg.fm_decoder_downsampling_factor):
+        m[f"fm_decoder.stack_time_emb.{s}.weight"] = \
+            f"fm_decoder.stack_time_emb.{s}.1.weight"
+        m[f"fm_decoder.stack_time_emb.{s}.bias"] = \
+            f"fm_decoder.stack_time_emb.{s}.1.bias"
+        if ds > 1:
+            m[f"fm_decoder.downsample.{s}.bias"] = \
+                f"fm_decoder.downsample.{s}.bias"
+            m[f"fm_decoder.out_combiner.{s}.bypass_scale"] = \
+                f"fm_decoder.out_combiner.{s}.bypass_scale"
+    return m
+
+
+def vocos_mapping(cfg: LuxTTSConfig) -> dict[str, str]:
+    m = {
+        "embed.weight": "backbone.embed.weight",
+        "embed.bias": "backbone.embed.bias",
+        "norm.weight": "backbone.norm.weight",
+        "norm.bias": "backbone.norm.bias",
+        "final_layer_norm.weight": "backbone.final_layer_norm.weight",
+        "final_layer_norm.bias": "backbone.final_layer_norm.bias",
+        "head_out.weight": "head.out.weight",
+        "head_out.bias": "head.out.bias",
+        "istft_window": "head.istft.window",
+    }
+    for i in range(cfg.vocos_layers):
+        src = f"backbone.convnext.{i}"
+        dst = f"convnext.{i}"
+        m[f"{dst}.gamma"] = f"{src}.gamma"
+        for comp in ("dwconv", "norm", "pwconv1", "pwconv2"):
+            m[f"{dst}.{comp}.weight"] = f"{src}.{comp}.weight"
+            m[f"{dst}.{comp}.bias"] = f"{src}.{comp}.bias"
+    return m
+
+
+def detect_luxtts_checkpoint(path: str) -> bool:
+    cfg_path = os.path.join(path, "config.json")
+    if not (os.path.isdir(path) and os.path.exists(cfg_path)):
+        return False
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    m = raw.get("model", {})
+    return "fm_decoder_dim" in m or "fm_decoder_num_layers" in m
+
+
+def load_luxtts(model_dir: str, dtype=jnp.float32) -> LuxTTS:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        raw = json.load(f)
+    cfg = luxtts_config_from_hf(raw)
+
+    main_st = TensorStorage(index_file(
+        os.path.join(model_dir, "model.safetensors")))
+    vocos_path = os.path.join(model_dir, "vocos.safetensors")
+    vocos_st = TensorStorage(index_file(vocos_path)) \
+        if os.path.exists(vocos_path) else main_st
+
+    # vocos dims come from the weights, not config.json
+    vrec = vocos_st.records
+    cfg = luxtts_vocos_dims(cfg, vrec)
+
+    shapes = jax.eval_shape(lambda: init_luxtts_params(
+        cfg, jax.random.PRNGKey(0), dtype))
+    vocos_shapes = shapes.pop("vocos")
+
+    mm = luxtts_mapping(cfg)
+    params = load_mapped_params(main_st, mm, shapes, dtype)
+    coverage_report(main_st, mm)
+    vm = vocos_mapping(cfg)
+    params["vocos"] = load_mapped_params(vocos_st, vm, vocos_shapes,
+                                         jnp.float32)
+    if vocos_st is not main_st:
+        coverage_report(vocos_st, vm)
+
+    phon = Phonemizer(
+        tokens_path=os.path.join(model_dir, "tokens.txt"),
+        dict_path=os.path.join(model_dir, "cmudict-0.7b-ipa.txt"),
+        vocab_size=cfg.vocab_size)
+    log.info("loaded LuxTTS: %d TE + %d FM layers, feat %d, vocos %dx%d",
+             cfg.text_encoder_num_layers, cfg.total_fm_layers, cfg.feat_dim,
+             cfg.vocos_layers, cfg.vocos_dim)
+    return LuxTTS(cfg, params=params, phonemizer=phon, dtype=dtype)
+
+
+def luxtts_vocos_dims(cfg: LuxTTSConfig, vrec: dict) -> LuxTTSConfig:
+    """Infer vocoder dims from the checkpoint (backbone dim/kernel/layers)."""
+    import dataclasses
+    emb = vrec["backbone.embed.weight"].shape      # [dim, feat, kernel]
+    n = 0
+    while f"backbone.convnext.{n}.gamma" in vrec:
+        n += 1
+    return dataclasses.replace(cfg, vocos_dim=emb[0], vocos_kernel=emb[2],
+                               vocos_layers=n)

@@ -121,8 +121,10 @@ def build_audio_model(model: str, dtype: str = "bf16"):
     run on random weights; any other value is a release-checkpoint path
     (VibeVoice HF layout — models/audio/vibevoice_loader)."""
     from .models.audio import (LuxTTS, VibeVoiceTTS,
-                               detect_vibevoice_checkpoint, load_vibevoice,
-                               tiny_luxtts_config, tiny_tts_config)
+                               detect_luxtts_checkpoint,
+                               detect_vibevoice_checkpoint, load_luxtts,
+                               load_vibevoice, tiny_luxtts_config,
+                               tiny_tts_config)
     dt = parse_dtype(dtype)
     if model == "demo:luxtts":
         return LuxTTS(tiny_luxtts_config(), dtype=dt)
@@ -133,10 +135,11 @@ def build_audio_model(model: str, dtype: str = "bf16"):
         path = resolve_model(model)
     if detect_vibevoice_checkpoint(path):
         return load_vibevoice(path, dtype=dt)
+    if detect_luxtts_checkpoint(path):
+        return load_luxtts(path, dtype=dt)
     raise ValueError(
         f"audio model {model!r}: not a demo: alias and not a recognizable "
-        f"VibeVoice checkpoint directory (config.json with "
-        f"decoder_config + diffusion_head_config)")
+        f"VibeVoice or LuxTTS checkpoint directory")
 
 
 def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,

@@ -1,0 +1,137 @@
+"""LuxTTS release-checkpoint loading: synthesize the reference layout
+(model.safetensors + vocos.safetensors + config.json + tokens.txt with
+the REAL tensor names — ref: luxtts/model.rs weight layout doc) and load
+through the public path.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.audio import (detect_luxtts_checkpoint, load_luxtts,
+                                   tiny_luxtts_config)
+from cake_tpu.models.audio.luxtts import init_luxtts_params
+from cake_tpu.models.audio.luxtts_loader import luxtts_mapping, vocos_mapping
+from cake_tpu.utils.mapping import flatten_tree
+from cake_tpu.utils.safetensors_io import save_safetensors
+
+
+def synth_luxtts_dir(tmp_path):
+    cfg = tiny_luxtts_config()
+    params = init_luxtts_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    vocos = params.pop("vocos")
+    flat = flatten_tree(params)
+    tensors = {name: np.asarray(flat[path], np.float32)
+               for path, name in luxtts_mapping(cfg).items()}
+    save_safetensors(str(tmp_path / "model.safetensors"), tensors)
+    flat_v = flatten_tree(vocos)
+    vtensors = {name: np.asarray(flat_v[path], np.float32)
+                for path, name in vocos_mapping(cfg).items()}
+    save_safetensors(str(tmp_path / "vocos.safetensors"), vtensors)
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump({
+            "model": {
+                "vocab_size": cfg.vocab_size, "feat_dim": cfg.feat_dim,
+                "text_encoder_dim": cfg.text_encoder_dim,
+                "text_encoder_num_layers": cfg.text_encoder_num_layers,
+                "text_encoder_feedforward_dim":
+                    cfg.text_encoder_feedforward_dim,
+                "text_encoder_num_heads": cfg.text_encoder_num_heads,
+                "text_encoder_cnn_module_kernel":
+                    cfg.text_encoder_cnn_module_kernel,
+                "fm_decoder_dim": cfg.fm_decoder_dim,
+                "fm_decoder_feedforward_dim": cfg.fm_decoder_feedforward_dim,
+                "fm_decoder_num_heads": cfg.fm_decoder_num_heads,
+                "fm_decoder_num_layers": list(cfg.fm_decoder_num_layers),
+                "fm_decoder_downsampling_factor":
+                    list(cfg.fm_decoder_downsampling_factor),
+                "fm_decoder_cnn_module_kernel":
+                    list(cfg.fm_decoder_cnn_module_kernel),
+                "query_head_dim": cfg.query_head_dim,
+                "value_head_dim": cfg.value_head_dim,
+                "pos_dim": cfg.pos_dim, "pos_head_dim": cfg.pos_head_dim,
+                "time_embed_dim": cfg.time_embed_dim,
+            },
+            "feature": {"n_fft": cfg.n_fft, "hop_length": cfg.hop_length,
+                        "n_mels": cfg.n_mels,
+                        "sample_rate": cfg.sample_rate},
+        }, f)
+    with open(tmp_path / "tokens.txt", "w") as f:
+        for i, ch in enumerate("abcdefghijklmnopqrstuvwxyz '"):
+            f.write(f"{ch} {i}\n")
+    return cfg
+
+
+EXPECTED_NAMES = [
+    "embed.weight",
+    "text_encoder.in_proj.weight",
+    "text_encoder.layers.0.norm.log_scale",
+    "text_encoder.layers.0.self_attn_weights.in_proj.weight",
+    "text_encoder.layers.0.self_attn_weights.linear_pos.weight",
+    "text_encoder.layers.0.feed_forward2.in_proj.weight",
+    "text_encoder.layers.0.nonlin_attention.in_proj.bias",
+    "text_encoder.layers.0.conv_module1.depthwise_conv.weight",
+    "text_encoder.layers.0.bypass.bypass_scale",
+    "fm_decoder.in_proj.weight",
+    "fm_decoder.time_embed.0.weight",
+    "fm_decoder.time_embed.2.bias",
+    "fm_decoder.stack_time_emb.0.1.weight",
+    "fm_decoder.downsample.1.bias",
+    "fm_decoder.out_combiner.1.bypass_scale",
+    "fm_decoder.layers.1.self_attn2.out_proj.weight",
+    "fm_decoder.out_proj.bias",
+]
+EXPECTED_VOCOS = [
+    "backbone.embed.weight",
+    "backbone.norm.weight",
+    "backbone.convnext.0.dwconv.weight",
+    "backbone.convnext.1.gamma",
+    "backbone.convnext.0.pwconv1.weight",
+    "backbone.final_layer_norm.bias",
+    "head.out.weight",
+    "head.istft.window",
+]
+
+
+def test_names_and_detection(tmp_path):
+    synth_luxtts_dir(tmp_path)
+    from cake_tpu.utils.safetensors_io import index_file
+    names = set(index_file(str(tmp_path / "model.safetensors")))
+    missing = [n for n in EXPECTED_NAMES if n not in names]
+    assert not missing, f"missing names: {missing}"
+    vnames = set(index_file(str(tmp_path / "vocos.safetensors")))
+    missing = [n for n in EXPECTED_VOCOS if n not in vnames]
+    assert not missing, f"missing vocos names: {missing}"
+    assert detect_luxtts_checkpoint(str(tmp_path))
+
+
+def test_load_and_generate(tmp_path):
+    cfg = synth_luxtts_dir(tmp_path)
+    tts = load_luxtts(str(tmp_path), dtype=jnp.float32)
+    audio = tts.generate_speech("hello world", steps=2, max_frames=8)
+    assert audio.sample_rate == cfg.sample_rate * 2     # 24k -> 48k
+    assert len(audio.samples) > 0
+    assert np.isfinite(audio.samples).all()
+    # tokens.txt drove the phonemizer (letters only, in-vocab)
+    ids = tts.phonemizer.tokenize("hello world")
+    assert all(0 <= i < 28 for i in ids)
+
+
+def test_runtime_detection(tmp_path):
+    synth_luxtts_dir(tmp_path)
+    from cake_tpu.runtime import build_audio_model
+    tts = build_audio_model(str(tmp_path), dtype="f32")
+    assert type(tts).__name__ == "LuxTTS"
+
+
+def test_voice_conditioning_changes_output(tmp_path):
+    synth_luxtts_dir(tmp_path)
+    tts = load_luxtts(str(tmp_path), dtype=jnp.float32)
+    from cake_tpu.utils.wav import encode_wav
+    rng = np.random.default_rng(0)
+    wav = encode_wav(rng.standard_normal(4000).astype(np.float32) * 0.1,
+                     24000)
+    a = tts.generate_speech("hi there", steps=2, max_frames=6)
+    b = tts.generate_speech("hi there", voice_wav=wav, steps=2, max_frames=6)
+    assert not np.allclose(a.samples, b.samples)

@@ -118,9 +118,9 @@ def main():
         "value": round(value, 2),
         "unit": "tok/s",
         "vs_baseline": round(value / BASELINE_TOK_S, 3),
+        "p50_ttft_ms": round(float(np.median(ttfts)) * 1e3, 1),
     }
     extra = {
-        "p50_ttft_s": round(float(np.median(ttfts)), 4),
         "runs": args.runs, "tokens": args.tokens,
         "device": str(jax.devices()[0]),
         "dtype": "bfloat16",

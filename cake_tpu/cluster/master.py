@@ -28,7 +28,8 @@ from ..models.common.config import ModelConfig
 from ..models.common.layers import (embed_tokens, forward_layers,
                                     lm_head_logits)
 from ..models.common.text_model import (PREFILL_BUCKETS, LocalStage, Token,
-                                        bucket_for, check_prefill_bounds)
+                                        bucket_for, check_prefill_bounds,
+                                        select_flash_mode)
 from ..ops.sampling import SamplingConfig, push_recent_token, sample
 from .auth import cluster_hash
 from .client import RemoteStage
@@ -92,10 +93,17 @@ class DistributedTextModel:
     def _run_stages(self, x, pos0: int, valid_len: int | None):
         pos = jnp.asarray(pos0, jnp.int32)
         vl = None if valid_len is None else jnp.asarray(valid_len, jnp.int32)
+        # local prefill stages flash like TextModel.prefill (full-length
+        # unwrapped caches)
+        flash_mode = "off"
+        if valid_len is not None:
+            flash_mode = select_flash_mode(pos0, x.shape[1],
+                                           self.max_cache_len)
         for s in self.stages:
             if s.kind == "local":
                 x, s.cache = s.runner.forward_hidden(
-                    jnp.asarray(x).astype(self.dtype), s.cache, pos, vl)
+                    jnp.asarray(x).astype(self.dtype), s.cache, pos, vl,
+                    flash_mode=flash_mode)
             else:
                 x, _ = s.runner.forward_hidden(
                     np.asarray(x), None, pos0, valid_len)

@@ -246,12 +246,21 @@ class WorkerServer:
         t0 = time.monotonic()
         try:
             x = jnp.asarray(proto.unpack_tensor(msg["x"])).astype(st.dtype)
-            pos0 = jnp.asarray(msg["pos0"], jnp.int32)
+            raw_pos0 = int(msg["pos0"])
+            pos0 = jnp.asarray(raw_pos0, jnp.int32)
             vl = msg.get("valid_len")
+            # prefill chunks (valid_len present) take the flash path
+            # (worker caches are full-length, unwrapped)
+            flash_mode = "off"
+            if vl is not None:
+                from ..models.common.text_model import select_flash_mode
+                flash_mode = select_flash_mode(raw_pos0, x.shape[1],
+                                               st.max_cache_len)
             vl = None if vl is None else jnp.asarray(vl, jnp.int32)
             loop = asyncio.get_running_loop()
             y, cache = await loop.run_in_executor(
-                None, lambda: st.stage.forward_hidden(x, cache, pos0, vl))
+                None, lambda: st.stage.forward_hidden(x, cache, pos0, vl,
+                                                      flash_mode=flash_mode))
             await proto.write_frame(
                 writer, proto.tensor_result(np.asarray(y), msg.get("rid", 0)))
         except Exception as e:

@@ -46,6 +46,17 @@ def bucket_for(n: int, max_len: int) -> int:
     return max_len
 
 
+def select_flash_mode(pos0: int, width: int, capacity: int | None) -> str:
+    """Host-static flash dispatch shared by the local, master and worker
+    prefill paths: "fresh" at position 0, scatter-then-flash "append" while
+    the chunk stays inside the unwrapped buffer, else the masked path."""
+    if pos0 == 0:
+        return "fresh"
+    if capacity is not None and pos0 + width <= capacity:
+        return "append"
+    return "off"
+
+
 def check_prefill_bounds(n: int, pos0: int, capacity: int | None,
                          max_len: int) -> int:
     """Validate a prefill request against the cache; returns the prompt
@@ -75,17 +86,20 @@ class LocalStage:
     def __init__(self, cfg: ModelConfig, params: dict, lo: int, hi: int):
         self.cfg, self.params, self.lo, self.hi = cfg, params, lo, hi
 
-        @functools.partial(jax.jit, static_argnames=("padded",), donate_argnums=(2,))
-        def _fwd(params, x, cache, pos0, valid_len, padded):
+        @functools.partial(jax.jit,
+                           static_argnames=("padded", "flash_mode"),
+                           donate_argnums=(2,))
+        def _fwd(params, x, cache, pos0, valid_len, padded, flash_mode):
             del padded  # static marker to separate prefill/decode programs
             return forward_layers(cfg, params, x, cache, pos0,
-                                  layer_range=(lo, hi), valid_len=valid_len)
+                                  layer_range=(lo, hi), valid_len=valid_len,
+                                  flash_mode=flash_mode)
 
         self._fwd = _fwd
 
-    def forward_hidden(self, x, cache, pos0, valid_len):
+    def forward_hidden(self, x, cache, pos0, valid_len, flash_mode="off"):
         return self._fwd(self.params, x, cache, pos0, valid_len,
-                         padded=x.shape[1])
+                         padded=x.shape[1], flash_mode=flash_mode)
 
 
 class TextModel:
@@ -179,13 +193,7 @@ class TextModel:
         bkt = check_prefill_bounds(n, pos0, cap, self.max_cache_len)
         padded = np.zeros((1, bkt), np.int32)
         padded[0, :n] = ids
-        if pos0 == 0:
-            flash_mode = "fresh"
-        elif cap is not None and pos0 + bkt <= cap:
-            # continued prefill can flash over the (unwrapped) cache buffer
-            flash_mode = "append"
-        else:
-            flash_mode = "off"
+        flash_mode = select_flash_mode(pos0, bkt, cap)
         logits, cache = self._prefill(self.params, jnp.asarray(padded), cache,
                                       jnp.asarray(pos0, jnp.int32),
                                       jnp.asarray(n, jnp.int32),

@@ -180,3 +180,46 @@ def test_flash_chunked_prefill_serving(rng, monkeypatch):
     mw2 = TextModel(cfgw, dtype=jnp.float32, max_cache_len=256)
     lw2, _ = mw2.prefill(mw2.new_cache(), toks)
     np.testing.assert_allclose(np.asarray(lw), np.asarray(lw2), atol=1e-5)
+
+
+def test_flash_distributed_stage_dispatch(rng, monkeypatch):
+    """The worker/master stage path (LocalStage.forward_hidden) dispatches
+    flash for prefill chunks and matches the mask path."""
+    import jax
+
+    import cake_tpu.ops.flash as fl
+    from cake_tpu.models import tiny_config
+    from cake_tpu.models.common.cache import init_cache
+    from cake_tpu.models.common.layers import init_params
+    from cake_tpu.models.common.text_model import LocalStage
+
+    calls = []
+    orig = fl.flash_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        k["interpret"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(fl, "flash_enabled", lambda: True)
+    monkeypatch.setattr(fl, "FLASH_MIN_SEQ", 64)
+    monkeypatch.setattr(fl, "flash_attention", spy)
+
+    cfg = tiny_config("qwen3", max_position_embeddings=256)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32,
+                         layer_range=(0, 2))
+    sub = {"layers": params["layers"], "rope": params["rope"]}
+    stage = LocalStage(cfg, sub, 0, 2)
+    x = jnp.asarray(rng.standard_normal((1, 128, cfg.hidden_size)),
+                    jnp.float32)
+    c1 = init_cache(cfg, 1, 256, jnp.float32, (0, 2))
+    y1, _ = stage.forward_hidden(x, c1, jnp.asarray(0, jnp.int32),
+                                 jnp.asarray(100, jnp.int32),
+                                 flash_mode="fresh")
+    assert len(calls) == 2          # one per layer in the range
+
+    c2 = init_cache(cfg, 1, 256, jnp.float32, (0, 2))
+    y2, _ = stage.forward_hidden(x, c2, jnp.asarray(0, jnp.int32),
+                                 jnp.asarray(100, jnp.int32))   # einsum path
+    np.testing.assert_allclose(np.asarray(y1)[:, :100],
+                               np.asarray(y2)[:, :100], atol=1e-5)

@@ -2,7 +2,9 @@
 
 Prints ONE JSON line:
   {"metric": "qwen3_0.6b_decode", "value": <tok/s>, "unit": "tok/s",
-   "vs_baseline": <value / 185.7>}
+   "vs_baseline": <value / 185.7>, "p50_ttft_ms": <ms>}
+(failure paths emit the same schema with value 0.0, an "error" field, and
+no p50_ttft_ms)
 
 Baseline: the reference's best published small-model decode — Qwen2.5-0.5B
 F16 at 185.7 tok/s on an RTX 3080 Laptop (BASELINE.md; the closest published

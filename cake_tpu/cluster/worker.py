@@ -23,7 +23,7 @@ import numpy as np
 
 from ..models.common.cache import cache_reset, init_cache
 from ..models.common.config import config_from_hf_dict
-from ..models.common.text_model import LocalStage
+from ..models.common.text_model import LocalStage, select_flash_mode
 from ..utils.dtypes import parse_dtype
 from ..utils.hub import cake_cache_dir
 from . import proto
@@ -253,7 +253,6 @@ class WorkerServer:
             # (worker caches are full-length, unwrapped)
             flash_mode = "off"
             if vl is not None:
-                from ..models.common.text_model import select_flash_mode
                 flash_mode = select_flash_mode(raw_pos0, x.shape[1],
                                                st.max_cache_len)
             vl = None if vl is None else jnp.asarray(vl, jnp.int32)

@@ -88,12 +88,20 @@ class DiskExpertProvider:
         return self._n
 
     def _read_expert(self, e: int) -> dict:
-        out = {}
-        for proj in ("gate_proj", "up_proj", "down_proj"):
-            name = self.name_fmt.format(lp=self.lp, e=e, proj=proj)
-            out[proj] = jnp.asarray(self.quant.load(self.storage, name),
-                                    dtype=self.dtype)
-        return out
+        from ...utils.quant import NoQuantization
+        projs = ("gate_proj", "up_proj", "down_proj")
+        names = [self.name_fmt.format(lp=self.lp, e=e, proj=p)
+                 for p in projs]
+        if isinstance(self.quant, NoQuantization) \
+                and hasattr(self.storage, "read_many"):
+            # unquantized fast path: one batched preadv for all three
+            # projections (csrc ck_preadv — the Flash-MoE streaming path)
+            arrs = self.storage.read_many(names)
+            return {p: jnp.asarray(a, dtype=self.dtype)
+                    for p, a in zip(projs, arrs)}
+        return {p: jnp.asarray(self.quant.load(self.storage, n),
+                               dtype=self.dtype)
+                for p, n in zip(projs, names)}
 
     def get(self, expert_idx: int) -> dict:
         with self._lock:

@@ -56,6 +56,11 @@ def _load():
         lib.ck_pread_fd.restype = ctypes.c_int64
         lib.ck_pread_fd.argtypes = [ctypes.c_int, ctypes.c_uint64,
                                     ctypes.c_uint64, ctypes.c_void_p]
+        lib.ck_preadv_fd.restype = ctypes.c_int64
+        lib.ck_preadv_fd.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                     ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_void_p]
         lib.ck_preadv.restype = ctypes.c_int64
         lib.ck_preadv.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                   ctypes.c_void_p, ctypes.c_void_p,
@@ -134,6 +139,31 @@ def preadv(path: str, ranges: list[tuple[int, int]]) -> list[bytes]:
         raise OSError(f"ck_preadv({path}) -> {got}")
     raw = buf.raw
     # slice by ACTUAL lengths: short reads at EOF truncate, same as pread
+    return [raw[int(o):int(o + g)] for o, g in zip(out_offsets, got_lens)]
+
+
+def preadv_fd(fd: int, ranges: list[tuple[int, int]]) -> list[bytes]:
+    """Batched positioned reads over a cached fd (expert streaming hot
+    path — no per-call open/close)."""
+    lib = _load()
+    if lib is None:
+        return [os.pread(fd, ln, off) for off, ln in ranges]
+    n = len(ranges)
+    offsets = np.asarray([r[0] for r in ranges], np.uint64)
+    lens = np.asarray([r[1] for r in ranges], np.uint64)
+    out_offsets = np.zeros(n, np.uint64)
+    np.cumsum(lens[:-1], out=out_offsets[1:])
+    buf = ctypes.create_string_buffer(int(lens.sum()))
+    got_lens = np.zeros(n, np.uint64)
+    got = lib.ck_preadv_fd(fd, n,
+                           offsets.ctypes.data_as(ctypes.c_void_p),
+                           lens.ctypes.data_as(ctypes.c_void_p),
+                           buf,
+                           out_offsets.ctypes.data_as(ctypes.c_void_p),
+                           got_lens.ctypes.data_as(ctypes.c_void_p))
+    if got < 0:
+        raise OSError(f"ck_preadv_fd({fd}) -> {got}")
+    raw = buf.raw
     return [raw[int(o):int(o + g)] for o, g in zip(out_offsets, got_lens)]
 
 

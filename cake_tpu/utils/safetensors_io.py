@@ -114,6 +114,30 @@ class TensorStorage:
         data = self.read_bytes(name)
         return np.frombuffer(bytearray(data), dtype=np_dt).reshape(r.shape)
 
+    def read_many(self, names: list[str]) -> list[np.ndarray]:
+        """Read several tensors; same-file groups go through the native
+        batched preadv (one syscall round per file — the expert-streaming
+        fast path, ref: tensor_storage.rs batched reads)."""
+        import jax.numpy as jnp
+        out: dict[str, np.ndarray] = {}
+        by_file: dict[str, list[str]] = {}
+        for n in names:
+            by_file.setdefault(self.records[n].file, []).append(n)
+        for path, group in by_file.items():
+            if _CAKEKIT is not None and len(group) > 1:
+                ranges = [(self.records[n].start, self.records[n].nbytes)
+                          for n in group]
+                blobs = _CAKEKIT.preadv_fd(self._fd(path), ranges)
+                for n, blob in zip(group, blobs):
+                    r = self.records[n]
+                    out[n] = np.frombuffer(bytearray(blob),
+                                           dtype=jnp.dtype(r.dtype)
+                                           ).reshape(r.shape)
+            else:
+                for n in group:
+                    out[n] = self.read(n)
+        return [out[n] for n in names]
+
     def nbytes(self, name: str) -> int:
         return self.records[name].nbytes
 

@@ -109,6 +109,22 @@ int64_t ck_preadv(const char* path, uint64_t n, const uint64_t* offsets,
     return (int64_t)total;
 }
 
+// Batched reads over an already-open fd (callers keep fds cached — no
+// per-call open/close). Same contract as ck_preadv.
+int64_t ck_preadv_fd(int fd, uint64_t n, const uint64_t* offsets,
+                     const uint64_t* lens, uint8_t* out,
+                     const uint64_t* out_offsets, uint64_t* got_lens) {
+    uint64_t total = 0;
+    for (uint64_t i = 0; i < n; i++) {
+        int64_t got = ck_pread_fd(fd, offsets[i], lens[i],
+                                  out + out_offsets[i]);
+        if (got < 0) return got;
+        got_lens[i] = (uint64_t)got;
+        total += (uint64_t)got;
+    }
+    return (int64_t)total;
+}
+
 // ---------------------------------------------------------------- framing
 
 // Validate a header; returns payload length, or negative on error:

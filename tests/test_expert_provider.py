@@ -59,3 +59,73 @@ def test_prefetch_warms_lru(tmp_path):
     prov.prefetch([0, 1, 2])
     prov._prefetcher.join(timeout=10)
     assert set(prov._lru) == {0, 1, 2}
+
+
+def test_disk_offload_full_model_load(tmp_path):
+    """Round-1 review gap: the batched-preadv streaming path under a REAL
+    MoE forward — every MoE layer of a qwen3_moe model computed via
+    DiskExpertProvider (LRU smaller than the expert count, so the run
+    evicts and re-streams) must match the resident full-model forward."""
+    from cake_tpu.models.common.layers import forward_train
+    from cake_tpu.utils import cakekit
+
+    cfg = tiny_config("qwen3_moe")
+    params = init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    save_safetensors(str(tmp_path / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    st = TensorStorage.from_model_dir(str(tmp_path))
+    if not cakekit.available():
+        import pytest
+        pytest.skip("native cakekit core not built (optional)")
+
+    toks = jnp.asarray(np.random.default_rng(2).integers(0, 255, (1, 6)))
+    want = forward_train(cfg, params, toks)
+
+    # rebuild the forward with every MoE mlp routed through the provider
+    from cake_tpu.models.common.layers import (block_forward, embed_tokens,
+                                               lm_head_logits)
+    from cake_tpu.ops.norms import rms_norm
+    x = embed_tokens(cfg, params, toks)
+    rope = params["rope"]
+    pos0 = jnp.asarray(0, jnp.int32)
+    for i, spec in enumerate(cfg.layer_specs()):
+        lp = params["layers"][i]
+        if spec.is_moe:
+            prov = DiskExpertProvider(st, f"model.layers.{i}",
+                                      cfg.num_experts, dtype=jnp.float32,
+                                      lru_size=3)   # < num_experts: evicts
+            h = rms_norm(x, lp["input_layernorm"]["weight"],
+                         cfg.rms_norm_eps)
+            from cake_tpu.models.common.layers import attention_forward
+            attn_out, _ = attention_forward(cfg, spec, lp["self_attn"], h,
+                                            None, pos0, rope)
+            x = x + attn_out
+            h = rms_norm(x, lp["post_attention_layernorm"]["weight"],
+                         cfg.rms_norm_eps)
+            flat = h.reshape(-1, cfg.hidden_size)
+            y = moe_ffn_offloaded(flat, lp["mlp"]["gate"]["weight"], prov,
+                                  cfg.num_experts_per_tok,
+                                  cfg.norm_topk_prob)
+            x = x + y.reshape(x.shape)
+            assert len(prov._lru) <= 3          # LRU actually bounded
+        else:
+            x, _ = block_forward(cfg, spec, lp, x, None, pos0, rope)
+    got = lm_head_logits(cfg, params, x[:, -1:]).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want[:, -1:]),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_read_many_batched_preadv(tmp_path):
+    """TensorStorage.read_many returns the same bytes as per-name reads
+    (same-file groups ride one ck_preadv call)."""
+    cfg = tiny_config("qwen3_moe")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    save_safetensors(str(tmp_path / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    st = TensorStorage.from_model_dir(str(tmp_path))
+    names = ["model.layers.0.mlp.experts.1.gate_proj.weight",
+             "model.layers.0.mlp.experts.5.down_proj.weight",
+             "model.layers.1.input_layernorm.weight"]
+    batched = st.read_many(names)
+    for n, arr in zip(names, batched):
+        np.testing.assert_array_equal(arr, st.read(n))

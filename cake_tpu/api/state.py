@@ -38,6 +38,27 @@ class ApiState:
         return out
 
 
+def _call_generate(model, messages_or_ids, gen_kwargs: dict, on_token=None):
+    """Shared messages-vs-token-ids dispatch for both endpoints."""
+    kw = dict(gen_kwargs)
+    if on_token is not None:
+        kw["on_token"] = on_token
+    if isinstance(messages_or_ids, list) and messages_or_ids and \
+            isinstance(messages_or_ids[0], dict):
+        return model.chat_generate(messages_or_ids, **kw)
+    return model.generate(messages_or_ids, **kw)
+
+
+async def run_generation_blocking(model, messages_or_ids, gen_kwargs: dict):
+    """Run a full generation in a worker thread WITHOUT a token callback, so
+    TextModel takes the single-device-call while_loop decode path (one host
+    sync per cache bucket instead of one per streamed chunk). Returns
+    (token_ids, stats)."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, lambda: _call_generate(model, messages_or_ids, gen_kwargs))
+
+
 def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):
     """Run model generation in a thread; yield Token objects as they arrive.
 
@@ -50,13 +71,8 @@ def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):
 
     def worker():
         try:
-            if isinstance(messages_or_ids, list) and messages_or_ids and \
-                    isinstance(messages_or_ids[0], dict):
-                toks, stats = model.chat_generate(
-                    messages_or_ids, on_token=q.put, **gen_kwargs)
-            else:
-                toks, stats = model.generate(
-                    messages_or_ids, on_token=q.put, **gen_kwargs)
+            toks, stats = _call_generate(model, messages_or_ids, gen_kwargs,
+                                         on_token=q.put)
             result["tokens"] = toks
             result["stats"] = stats
         except Exception as e:  # surfaced to the stream consumer

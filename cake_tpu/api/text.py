@@ -10,7 +10,8 @@ import uuid
 from aiohttp import web
 
 from ..ops.sampling import SamplingConfig
-from .state import ApiState, run_generation_streamed
+from .state import (ApiState, run_generation_blocking,
+                    run_generation_streamed)
 
 
 TOP_K_CHOICES = (1, 5, 10, 20, 40, 64, 100, 200)
@@ -98,20 +99,39 @@ def _prompt_token_count(state: ApiState, messages) -> int:
         return 0
 
 
+def _decode_text(tokenizer, ids: list[int]) -> str:
+    """Decode output ids, degrading per-token on failure so one bad id
+    (e.g. out-of-range special) drops only itself, matching the streamed
+    path's per-token behavior."""
+    if tokenizer is None or not ids:
+        return ""
+    try:
+        return tokenizer.decode(ids)
+    except Exception:
+        parts = []
+        for i in ids:
+            try:
+                parts.append(tokenizer.decode([i]))
+            except Exception:
+                pass
+        return "".join(parts)
+
+
 async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
     async with state.lock:                  # one inference at a time
-        aiter, result = run_generation_streamed(state.model, messages,
-                                               gen_kwargs)
-        text_parts = []
-        last = None
-        async for tok in aiter:
-            last = tok
-            if tok.text and not tok.is_end_of_stream:
-                text_parts.append(tok.text)
-    stats = result.get("stats", {})
-    n_out = len(result.get("tokens", []))
+        try:
+            toks, stats = await run_generation_blocking(state.model, messages,
+                                                        gen_kwargs)
+        except Exception as e:
+            return web.json_response({"error": f"generation failed: {e}"},
+                                     status=500)
+    n_out = len(toks)
     n_in = _prompt_token_count(state, messages)
-    finish = "stop" if (last is not None and last.is_end_of_stream) else "length"
+    ended = bool(toks) and state.model.cfg.is_eos(toks[-1])
+    finish = "stop" if ended else "length"
+    content_ids = toks[:-1] if ended else toks
+    tokenizer = state.tokenizer or getattr(state.model, "tokenizer", None)
+    text = _decode_text(tokenizer, content_ids)
     return web.json_response({
         "id": _completion_id(),
         "object": "chat.completion",
@@ -119,7 +139,7 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
         "model": state.model_id,
         "choices": [{
             "index": 0,
-            "message": {"role": "assistant", "content": "".join(text_parts)},
+            "message": {"role": "assistant", "content": text},
             "finish_reason": finish,
         }],
         "usage": {

@@ -106,6 +106,10 @@ class TextModel:
     """Single-process text model (all layers local). The distributed master
     variant lives in cluster/master.py and reuses the same compiled pieces."""
 
+    # first non-streaming decode segment (and so the initial KV bucket) is
+    # capped at this many tokens; later segments fill the growing buckets
+    UNTIL_SEGMENT = 256
+
     def __init__(self, cfg: ModelConfig, params: dict | None = None,
                  tokenizer=None, dtype=jnp.bfloat16, seed: int = 42,
                  max_cache_len: int | None = None):
@@ -156,6 +160,42 @@ class TextModel:
                 body, (token, cache, rng, recent), None, length=n)
             return toks, cache, rng, recent
 
+        @functools.partial(jax.jit, static_argnames=("scfg", "nbuf"),
+                           donate_argnums=(2,))
+        def _decode_until(params, token, cache, rng, recent, n_limit, scfg,
+                          nbuf):
+            """Decode up to n_limit tokens on device, stopping at EOS
+            (lax.while_loop): ONE host round trip per generation. Through a
+            high-latency device link the per-sync cost dominates chunked
+            decode (fetches are stream-ordered, so they cannot overlap queued
+            compute), and the while_loop also removes past-EOS overshoot.
+            Returns [count, tok0, tok1, ...] packed into one array so the
+            host pays a single small fetch."""
+            eos = jnp.asarray(cfg.eos_token_ids or (-1,), jnp.int32)
+
+            def cond(c):
+                i, done = c[0], c[1]
+                return jnp.logical_and(~done, i < n_limit)
+
+            def body(c):
+                i, done, tok, cache, rng, recent, buf = c
+                rng, sk = jax.random.split(rng)
+                x = embed_tokens(cfg, params, tok[:, None])
+                x, cache = forward_layers(cfg, params, x, cache, cache["pos"])
+                logits = lm_head_logits(cfg, params, x)[:, -1]
+                nxt = sample(logits[0], sk, scfg, recent)
+                recent = push_recent_token(recent, nxt)
+                buf = jax.lax.dynamic_update_index_in_dim(buf, nxt, i, 0)
+                return (i + 1, jnp.any(nxt == eos),
+                        jnp.broadcast_to(nxt, tok.shape), cache, rng, recent,
+                        buf)
+
+            init = (jnp.asarray(0, jnp.int32), jnp.asarray(False), token,
+                    cache, rng, recent, jnp.zeros((nbuf,), jnp.int32))
+            i, _, _, cache, rng, recent, buf = jax.lax.while_loop(
+                cond, body, init)
+            return jnp.concatenate([i[None], buf]), cache, rng, recent
+
         @functools.partial(jax.jit, donate_argnums=(2,))
         def _decode_step(params, token, cache):
             """One decode step returning raw logits (distributed master path +
@@ -173,6 +213,7 @@ class TextModel:
 
         self._prefill = _prefill
         self._decode_chunk = _decode_chunk
+        self._decode_until = _decode_until
         self._decode_step = _decode_step
         self._grow = _grow
 
@@ -211,16 +252,23 @@ class TextModel:
                  chunk: int = 16, rng=None) -> tuple[list[int], dict]:
         """Streamed generation. Returns (token_ids, stats).
 
-        Decode runs in on-device chunks of `chunk` tokens; EOS is checked
-        between chunks (overshoot compute is wasted but state advances are
-        discarded past EOS).
+        Without an `on_token` callback the whole decode runs as ONE device
+        call (`_decode_until`: while_loop to EOS/budget, single fetch) —
+        syncs are stream-ordered through the host↔device link, so their
+        fixed latency is paid per call, not per token. With a callback,
+        decode runs in on-device chunks of `chunk` tokens so tokens stream
+        out with bounded latency; EOS is checked between chunks.
         """
         cfg = self.cfg
         scfg = sampling or SamplingConfig()
         rng = self._rng if rng is None else rng
-        # smallest bucket covering prompt + first decode chunk; grown
-        # bucket-by-bucket below so decode never attends over unused slots
-        kv_len = bucket_for(len(prompt_ids) + 1 + chunk, self.max_cache_len)
+        streaming = on_token is not None
+        # smallest bucket covering everything the first device call will
+        # write — grown bucket-by-bucket below so decode never attends over
+        # unused slots (the non-streaming path grows between segments)
+        first_span = 1 + chunk if streaming else 1 + min(max_new_tokens,
+                                                         self.UNTIL_SEGMENT)
+        kv_len = bucket_for(len(prompt_ids) + first_span, self.max_cache_len)
         cache = self.new_cache(1, kv_len=kv_len)
 
         t0 = time.monotonic()
@@ -229,41 +277,68 @@ class TextModel:
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
         first = sample(logits[0], sk, scfg, recent)
         recent = push_recent_token(recent, first)
+        tid = int(first)                  # device sync: TTFT is honest
         ttft = time.monotonic() - t0
 
-        out: list[int] = []
+        out: list[int] = [tid]
         tok_arr = first[None]
-        tid = int(first)
-        out.append(tid)
         if on_token:
             on_token(self._mk_token(tid))
         done = cfg.is_eos(tid)
 
         t1 = time.monotonic()
-        # never decode past the cache (full-attn buffers are not rings)
-        budget = self.max_cache_len - len(prompt_ids) - 1 - chunk
-        max_new_tokens = min(max_new_tokens, max(budget, 1))
         pos = len(prompt_ids)            # next write position (first token)
-        while not done and len(out) < max_new_tokens:
-            if pos + chunk > kv_len:
-                kv_len = bucket_for(pos + chunk, self.max_cache_len)
-                cache = self._grow(cache, new_len=kv_len)
-            # Always run the full chunk (one compiled program for all calls);
-            # overshoot past EOS/max_new is discarded on the host — wasted
-            # FLOPs bounded by chunk-1, zero recompiles.
-            toks, cache, rng, recent = self._decode_chunk(
-                self.params, tok_arr, cache, rng, recent, scfg, chunk)
-            pos += chunk
-            toks_np = np.asarray(toks)
-            for t in toks_np:
-                tid = int(t)
-                out.append(tid)
-                if on_token:
-                    on_token(self._mk_token(tid))
-                if cfg.is_eos(tid) or len(out) >= max_new_tokens:
-                    done = True
-                    break
-            tok_arr = jnp.asarray([out[-1]], jnp.int32)
+        if not streaming:
+            # while_loop decode in cache-bucket-sized segments: each segment
+            # is ONE device call filling the current KV bucket, then the
+            # bucket grows — EOS waste stays bounded by the current bucket
+            # and a long generation pays at most log2 extra syncs
+            n_total = min(max_new_tokens - 1, self.max_cache_len - pos - 1)
+            emitted = 0
+            while not done and emitted < n_total:
+                room = kv_len - pos - 1    # writes positions pos .. pos+n
+                if room <= 0:
+                    kv_len = bucket_for(pos + 2, self.max_cache_len)
+                    cache = self._grow(cache, new_len=kv_len)
+                    room = kv_len - pos - 1
+                n_seg = min(n_total - emitted, room)
+                packed, cache, rng, recent = self._decode_until(
+                    self.params, tok_arr, cache, rng, recent,
+                    jnp.asarray(n_seg, jnp.int32), scfg,
+                    bucket_for(n_seg, self.max_cache_len))
+                arr = np.asarray(packed)
+                count = int(arr[0])
+                seg = [int(t) for t in arr[1:1 + count]]
+                out.extend(seg)
+                emitted += count
+                pos += count
+                done = count < n_seg or (bool(seg) and cfg.is_eos(seg[-1]))
+                if not done:
+                    tok_arr = jnp.asarray([out[-1]], jnp.int32)
+        else:
+            # never decode past the cache (full-attn buffers are not rings)
+            budget = self.max_cache_len - len(prompt_ids) - 1 - chunk
+            max_new_tokens = min(max_new_tokens, max(budget, 1))
+            while not done and len(out) < max_new_tokens:
+                if pos + chunk > kv_len:
+                    kv_len = bucket_for(pos + chunk, self.max_cache_len)
+                    cache = self._grow(cache, new_len=kv_len)
+                # Always run the full chunk (one compiled program for all
+                # calls); overshoot past EOS/max_new is discarded on the
+                # host — wasted FLOPs bounded by chunk-1, zero recompiles.
+                toks, cache, rng, recent = self._decode_chunk(
+                    self.params, tok_arr, cache, rng, recent, scfg, chunk)
+                pos += chunk
+                toks_np = np.asarray(toks)
+                for t in toks_np:
+                    tid = int(t)
+                    out.append(tid)
+                    if on_token:
+                        on_token(self._mk_token(tid))
+                    if cfg.is_eos(tid) or len(out) >= max_new_tokens:
+                        done = True
+                        break
+                tok_arr = jnp.asarray([out[-1]], jnp.int32)
         dt = time.monotonic() - t1
         stats = {
             "ttft_s": ttft,

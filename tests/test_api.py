@@ -27,11 +27,13 @@ def with_client(state_or_app, fn):
 
 
 class MockTokenizer:
+    WORDS = {0: "Hello", 1: " world", 2: " !"}
+
     def encode(self, text):
         return list(range(len(text.split())))
 
     def decode(self, ids):
-        return "tok"
+        return "".join(self.WORDS.get(i, "") for i in ids)
 
     def apply_chat(self, messages):
         return " ".join(m["content"] for m in messages)
@@ -45,6 +47,10 @@ class MockTextModel:
         num_hidden_layers = 4
         hidden_size = 64
         vocab_size = 256
+
+        @staticmethod
+        def is_eos(tid):
+            return tid == 99
 
     def __init__(self):
         self.tokenizer = MockTokenizer()

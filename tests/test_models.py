@@ -108,6 +108,42 @@ def test_generate_streams_and_stops():
     assert toks == toks2
 
 
+def test_decode_until_matches_chunked():
+    """The single-device-call while_loop decode (non-streaming path) must
+    emit exactly the chunked streaming path's tokens, greedy and sampled,
+    including EOS stops and cache-bucket growth."""
+    import jax
+    for arch in ("llama", "qwen3_moe"):
+        model = make_model(arch)
+        for scfg in (SamplingConfig(temperature=0.0),
+                     SamplingConfig(temperature=0.9, top_k=16,
+                                    repeat_penalty=1.05)):
+            rng = jax.random.PRNGKey(11)
+            seen = []
+            toks_stream, _ = model.generate(
+                [1, 2, 3], max_new_tokens=40, sampling=scfg,
+                on_token=seen.append, chunk=4, rng=rng)
+            toks_until, stats = model.generate(
+                [1, 2, 3], max_new_tokens=40, sampling=scfg, rng=rng)
+            assert toks_until == toks_stream
+            assert stats["decode_tokens"] == len(toks_until) - 1
+    # max_new_tokens=1 short-circuits before the device call
+    toks1, _ = model.generate([1, 2], max_new_tokens=1)
+    assert len(toks1) == 1
+    # bucket-growth segmentation: tiny segments force several device calls
+    model = make_model("llama")
+    try:
+        model.UNTIL_SEGMENT = 4
+        rng = jax.random.PRNGKey(3)
+        seen = []
+        toks_stream, _ = model.generate([1, 2, 3], max_new_tokens=30,
+                                        on_token=seen.append, chunk=4, rng=rng)
+        toks_until, _ = model.generate([1, 2, 3], max_new_tokens=30, rng=rng)
+        assert toks_until == toks_stream
+    finally:
+        del model.UNTIL_SEGMENT
+
+
 def test_generate_eos_stops():
     model = make_model("llama")
     # token 2 is EOS in tiny_config; force it via a cooked lm_head bias:

@@ -1,0 +1,134 @@
+"""Decode-step component profile on the real chip.
+
+Times the pieces of one decode step (embed+layers, lm_head, sampling,
+while_loop packaging) separately to locate the gap between measured decode
+throughput and the HBM roofline (params_bytes / HBM_BW).
+
+Usage: python benches/profile_decode.py [--steps 64]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models import TextModel, config_from_hf_dict
+from cake_tpu.models.common.layers import (embed_tokens, forward_layers,
+                                           lm_head_logits)
+from __graft_entry__ import FLAGSHIP
+
+
+def timeit(fn, *args, n=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--kv", type=int, default=512)
+    args = ap.parse_args()
+
+    cfg = config_from_hf_dict(FLAGSHIP)
+    model = TextModel(cfg, dtype=jnp.bfloat16, max_cache_len=2048)
+    params = model.params
+
+    n_param = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    print(f"params: {n_param/1e6:.1f}M -> {n_param*2/1e9:.3f} GB read/step (bf16)")
+
+    tok = jnp.asarray([5], jnp.int32)
+
+    def chain(step_fn, n=64, warmup=8):
+        """Chained decode steps (output cache feeds the next call) — honest
+        per-step latency including dispatch, matching real decode."""
+        cache = model.new_cache(1, kv_len=args.kv)
+        _, cache = model.prefill(cache, list(range(100)))
+        out = None
+        for _ in range(warmup):
+            out, cache = step_fn(cache)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out, cache = step_fn(cache)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n
+
+    # full step (logits, no sampling)
+    t_step = chain(lambda c: model._decode_step(params, tok, c))
+    print(f"decode_step (layers+head):  {t_step*1e3:8.3f} ms  -> {1/t_step:7.1f} tok/s")
+
+    # layers only
+    @jax.jit
+    def _layers(params, tok, cache):
+        x = embed_tokens(cfg, params, tok[:, None])
+        x, cache = forward_layers(cfg, params, x, cache, cache["pos"])
+        return x, cache
+
+    t_layers = chain(lambda c: _layers(params, tok, c))
+    print(f"embed+layers only:          {t_layers*1e3:8.3f} ms")
+
+    # head only
+    x = jnp.zeros((1, 1, cfg.hidden_size), jnp.bfloat16)
+
+    @jax.jit
+    def _head(params, x):
+        return lm_head_logits(cfg, params, x)
+
+    t_head = timeit(lambda: _head(params, x))
+    print(f"lm_head only:               {t_head*1e3:8.3f} ms")
+
+    # decode_until (while_loop) — time two budgets and diff so prefill /
+    # fetch fixed costs cancel: per_tok = (T(n2) - T(n1)) / (n2 - n1)
+    from cake_tpu.ops.sampling import SamplingConfig
+    scfg = SamplingConfig(temperature=0.0)
+    rng = jax.random.PRNGKey(0)
+    recent = jnp.full((64,), -1, jnp.int32)
+
+    def until(n_limit, nbuf, reps=5):
+        def run():
+            c = model.new_cache(1, kv_len=args.kv)
+            _, c = model.prefill(c, list(range(100)))
+            packed, c, r, rec = model._decode_until(
+                params, tok, c, rng, recent,
+                jnp.asarray(n_limit, jnp.int32), scfg, nbuf)
+            return np.asarray(packed)   # includes the real host fetch
+        run(); run()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run()
+        return (time.perf_counter() - t0) / reps
+
+    n1, n2 = 8, args.steps
+    t1, t2 = until(n1, args.steps), until(n2, args.steps)
+    per_tok = (t2 - t1) / (n2 - n1)
+    print(f"decode_until diff({n1}->{n2}): {per_tok*1e3:8.3f} ms/tok"
+          f"  -> {1/per_tok:7.1f} tok/s")
+    print(f"  (vs bare chained step: {(per_tok-t_step)*1e3:+.3f} ms/tok)")
+
+    # generate() end to end, as the headline bench measures it
+    out, stats = model.generate(list(range(32)), max_new_tokens=args.steps,
+                                sampling=scfg, chunk=64)
+    out, stats = model.generate(list(range(32)), max_new_tokens=args.steps,
+                                sampling=scfg, chunk=64)
+    print(f"generate(): {stats['tok_per_s']:.1f} tok/s, "
+          f"ttft {stats['ttft_s']*1e3:.1f} ms")
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}")
+
+
+if __name__ == "__main__":
+    main()

@@ -93,7 +93,10 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 def _prompt_token_count(state: ApiState, messages) -> int:
     try:
         from ..models.common.text_model import render_chat
-        enc = state.tokenizer.encode(render_chat(state.tokenizer, messages))
+        # same fallback as the content decode: a model built with its own
+        # tokenizer must yield consistent usage accounting
+        tok = state.tokenizer or getattr(state.model, "tokenizer", None)
+        enc = tok.encode(render_chat(tok, messages))
         return len(enc.ids if hasattr(enc, "ids") else enc)
     except Exception:
         return 0

@@ -144,6 +144,39 @@ def test_decode_until_matches_chunked():
         del model.UNTIL_SEGMENT
 
 
+def test_streaming_blocking_budget_parity_at_cache_end():
+    """Near max_cache_len the streaming path must emit exactly the blocking
+    path's tokens: full chunks while they fit, then the sub-chunk remainder
+    flushed through the while_loop program (a chunk-sized slack clamp here
+    used to make the two chat endpoints disagree)."""
+    model = make_model("llama")   # max_cache_len=64
+    prompt = list(range(1, 41))   # 40 tokens, room for 23 more + first
+    for chunk in (16, 8):
+        rng = jax.random.PRNGKey(2)
+        stream, _ = model.generate(prompt, max_new_tokens=30,
+                                   on_token=lambda t: None, chunk=chunk,
+                                   rng=rng)
+        block, _ = model.generate(prompt, max_new_tokens=30, rng=rng)
+        assert stream == block
+        assert len(block) == 24   # 1 + (64 - 40 - 1), cache-capped
+
+
+def test_streaming_pipeline_depth_equivalence():
+    """STREAM_DEPTH dispatch-ahead must not change emitted tokens vs
+    depth-1 (chunks chain off the device carry either way)."""
+    model = make_model("qwen3")
+    rng = jax.random.PRNGKey(5)
+    base, _ = model.generate([4, 5], max_new_tokens=20,
+                             on_token=lambda t: None, chunk=4, rng=rng)
+    try:
+        model.STREAM_DEPTH = 1
+        one, _ = model.generate([4, 5], max_new_tokens=20,
+                                on_token=lambda t: None, chunk=4, rng=rng)
+    finally:
+        del model.STREAM_DEPTH
+    assert base == one
+
+
 def test_generate_eos_stops():
     model = make_model("llama")
     # token 2 is EOS in tiny_config; force it via a cooked lm_head bias:

@@ -111,7 +111,9 @@ def main():
             run()
         return (time.perf_counter() - t0) / reps
 
-    n1, n2 = 8, args.steps
+    n1, n2 = min(8, max(args.steps // 2, 1)), args.steps
+    if n2 <= n1:
+        n2 = n1 + 8
     t1, t2 = until(n1, args.steps), until(n2, args.steps)
     per_tok = (t2 - t1) / (n2 - n1)
     print(f"decode_until diff({n1}->{n2}): {per_tok*1e3:8.3f} ms/tok"

@@ -370,7 +370,7 @@ class Flux1TextEncoder:
 
         @jax.jit
         def _encode(clip_p, t5_p, clip_ids, t5_ids):
-            _, pooled = clip_text_forward(clip_cfg, clip_p, clip_ids)
+            _, pooled, _ = clip_text_forward(clip_cfg, clip_p, clip_ids)
             txt = t5_encode(t5_cfg, t5_p, t5_ids)
             return txt, pooled
 

@@ -42,9 +42,12 @@ class MMDiTConfig:
     theta: float = 10000.0
 
 
-def timestep_embedding(t, dim: int, max_period: float = 10000.0):
-    """Sinusoidal embedding, t in [0, 1] scaled by 1000 (FLUX convention)."""
-    t = t * 1000.0
+def timestep_embedding(t, dim: int, max_period: float = 10000.0,
+                       scale: float = 1000.0):
+    """Sinusoidal embedding, cos-first (diffusers flip_sin_to_cos); t in
+    [0, 1] scaled by 1000 (FLUX convention) — pass scale=1.0 for raw-valued
+    conditioning scalars (SDXL size/crop time_ids)."""
+    t = t * scale
     half = dim // 2
     freqs = jnp.exp(-math.log(max_period)
                     * jnp.arange(half, dtype=jnp.float32) / half)

@@ -1,8 +1,11 @@
 """Stable Diffusion pipeline: CLIP-style text conditioning -> UNet
-epsilon-prediction denoising (DPM-Solver++, CFG with negative prompts) ->
-VAE decode; img2img via noised init latents
+denoising (epsilon or v-prediction, DPM-Solver++, CFG with negative
+prompts) -> VAE decode; img2img via noised init latents
 (ref: models/sd/sd.rs — v1.5/2.1/XL/Turbo via candle-transformers, img2img,
 intermediate images, tracing hook; here the UNet is implemented natively).
+SD2.x support: per-level head counts (constant 64-dim heads), linear
+spatial-transformer projections, v-prediction (SD2.1-768), OpenCLIP-style
+text encoder (gelu, 1024-hidden) via the hidden_act config.
 
 UNet: conv_in -> down blocks (resnet + cross-attn transformer, downsample)
 -> mid -> up blocks with skip connections -> conv_out. Cross-attention
@@ -37,9 +40,30 @@ class UNetConfig:
     channel_mults: tuple[int, ...] = (1, 2, 4, 4)
     num_res_blocks: int = 2
     attn_levels: tuple[int, ...] = (0, 1, 2)   # levels with cross-attn
-    num_heads: int = 8
+    # int: same head count at every level (SD1.x, attention_head_dim=8);
+    # tuple: per-level head counts (SD2.x, e.g. (5, 10, 20, 20) = constant
+    # 64-dim heads as channels scale — diffusers calls both
+    # `attention_head_dim` but the values are HEAD COUNTS)
+    num_heads: int | tuple[int, ...] = 8
     context_dim: int = 768                     # CLIP hidden size
     time_dim: int = 1280
+    # transformer blocks per spatial transformer: 1 for SD1.x/2.x,
+    # per-level (1, 2, 10) for SDXL
+    transformer_depth: int | tuple[int, ...] = 1
+    # SDXL text_time addition embeddings: input dim of add_embedding.linear_1
+    # (pooled text 1280 + 6 × 256-dim time-id sinusoids = 2816); None = off
+    addition_embed_dim: int | None = None
+    addition_time_embed_dim: int = 256
+
+    def heads_at(self, lvl: int) -> int:
+        if isinstance(self.num_heads, tuple):
+            return self.num_heads[lvl]
+        return self.num_heads
+
+    def depth_at(self, lvl: int) -> int:
+        if isinstance(self.transformer_depth, tuple):
+            return self.transformer_depth[lvl]
+        return self.transformer_depth
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +73,12 @@ class SDPipelineConfig:
                                shift_factor=0.0)
     guidance_default: float = 7.5
     steps_default: int = 20
+    # SD2.1-768 trains with v-prediction; 1.x / 2.1-base with epsilon
+    # (read from scheduler/scheduler_config.json by the loader)
+    prediction_type: str = "epsilon"
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    beta_schedule: str = "scaled_linear"
 
 
 def tiny_sd_config() -> SDPipelineConfig:
@@ -96,12 +126,10 @@ def _w_only(key, o, i, dtype):
     return {"weight": jax.random.normal(key, (o, i), dtype) / (i ** 0.5)}
 
 
-def _xattn_p(ks, c, ctx, dtype):
+def _tblock_p(ks, c, ctx, dtype):
     # q/k/v carry no bias and the feed-forward is GEGLU (value+gate fused
     # in one 8c projection) — the real SD transformer-block layout
     return {
-        "norm": _norm_p(c, dtype),
-        "proj_in": _lin_p(next(ks), c, c, dtype),
         "norm1": _norm_p(c, dtype),
         "self_q": _w_only(next(ks), c, c, dtype),
         "self_k": _w_only(next(ks), c, c, dtype),
@@ -115,6 +143,14 @@ def _xattn_p(ks, c, ctx, dtype):
         "norm3": _norm_p(c, dtype),
         "ff1": _lin_p(next(ks), 8 * c, c, dtype),
         "ff2": _lin_p(next(ks), c, 4 * c, dtype),
+    }
+
+
+def _xattn_p(ks, c, ctx, dtype, depth: int = 1):
+    return {
+        "norm": _norm_p(c, dtype),
+        "proj_in": _lin_p(next(ks), c, c, dtype),
+        "blocks": [_tblock_p(ks, c, ctx, dtype) for _ in range(depth)],
         "proj_out": _lin_p(next(ks), c, c, dtype),
     }
 
@@ -132,6 +168,11 @@ def init_unet_params(cfg: UNetConfig, key, dtype=jnp.float32) -> dict:
         "conv_out": _conv_p(next(ks), cfg.in_channels, cfg.base_channels, 3,
                             dtype),
     }
+    if cfg.addition_embed_dim:
+        # SDXL text_time embedding: [pooled text ; time-id sinusoids] -> MLP
+        p["add_mlp1"] = _lin_p(next(ks), cfg.time_dim,
+                               cfg.addition_embed_dim, dtype)
+        p["add_mlp2"] = _lin_p(next(ks), cfg.time_dim, cfg.time_dim, dtype)
     # encoder
     skips = [cfg.base_channels]
     cin = cfg.base_channels
@@ -139,8 +180,9 @@ def init_unet_params(cfg: UNetConfig, key, dtype=jnp.float32) -> dict:
         blk = {"res": [], "attn": [], "down": None}
         for _ in range(cfg.num_res_blocks):
             blk["res"].append(_resnet_p(ks, cin, c, cfg.time_dim, dtype))
-            blk["attn"].append(_xattn_p(ks, c, cfg.context_dim, dtype)
-                               if lvl in cfg.attn_levels else None)
+            blk["attn"].append(
+                _xattn_p(ks, c, cfg.context_dim, dtype, cfg.depth_at(lvl))
+                if lvl in cfg.attn_levels else None)
             cin = c
             skips.append(c)
         if lvl < len(chs) - 1:
@@ -148,18 +190,21 @@ def init_unet_params(cfg: UNetConfig, key, dtype=jnp.float32) -> dict:
             skips.append(c)
         p["down"].append(blk)
     # mid
+    n_lv = len(chs)
     p["mid_res1"] = _resnet_p(ks, cin, cin, cfg.time_dim, dtype)
-    p["mid_attn"] = _xattn_p(ks, cin, cfg.context_dim, dtype)
+    p["mid_attn"] = _xattn_p(ks, cin, cfg.context_dim, dtype,
+                             cfg.depth_at(n_lv - 1))
     p["mid_res2"] = _resnet_p(ks, cin, cin, cfg.time_dim, dtype)
     # decoder (mirror)
-    for lvl in reversed(range(len(chs))):
+    for lvl in reversed(range(n_lv)):
         c = chs[lvl]
         blk = {"res": [], "attn": [], "up": None}
         for _ in range(cfg.num_res_blocks + 1):
             skip = skips.pop()
             blk["res"].append(_resnet_p(ks, cin + skip, c, cfg.time_dim, dtype))
-            blk["attn"].append(_xattn_p(ks, c, cfg.context_dim, dtype)
-                               if lvl in cfg.attn_levels else None)
+            blk["attn"].append(
+                _xattn_p(ks, c, cfg.context_dim, dtype, cfg.depth_at(lvl))
+                if lvl in cfg.attn_levels else None)
             cin = c
         if lvl > 0:
             blk["up"] = _conv_p(next(ks), c, c, 3, dtype)
@@ -193,14 +238,8 @@ def _mha(q, k, v, heads):
     return jnp.einsum("bhst,bthd->bshd", a, vh).reshape(b, sq, c)
 
 
-def _xattn(p, x, ctx, heads):
-    """Spatial transformer: self-attn + cross-attn + GEGLU-ish FF."""
-    b, c, hh, ww = x.shape
-    resid_sp = x
-    h = group_norm(x, p["norm"]["weight"], p["norm"]["bias"], 32)
-    h = h.reshape(b, c, hh * ww).transpose(0, 2, 1)
-    h = linear(h, p["proj_in"]["weight"], p["proj_in"]["bias"])
-
+def _tblock(p, h, ctx, heads):
+    """One transformer block: self-attn + cross-attn + GEGLU FF."""
     def ln(t, np_):
         return layer_norm(t, np_["weight"], np_["bias"], 1e-5)
 
@@ -218,48 +257,70 @@ def _xattn(p, x, ctx, heads):
                    p["cross_o"]["weight"], p["cross_o"]["bias"])
     hn = ln(h, p["norm3"])
     # GEGLU: one projection yields [value ; gate], output = value * gelu(gate)
+    # (diffusers GEGLU uses the exact erf GELU, not the tanh approximation)
     vg = linear(hn, p["ff1"]["weight"], p["ff1"]["bias"])
     val, gate = jnp.split(vg, 2, axis=-1)
-    h = h + linear(val * jax.nn.gelu(gate, approximate=True),
-                   p["ff2"]["weight"], p["ff2"]["bias"])
+    return h + linear(val * jax.nn.gelu(gate, approximate=False),
+                      p["ff2"]["weight"], p["ff2"]["bias"])
+
+
+def _xattn(p, x, ctx, heads):
+    """Spatial transformer: norm + proj_in, N transformer blocks (1 for
+    SD1.x/2.x, up to 10 at SDXL's deepest level), proj_out + residual."""
+    b, c, hh, ww = x.shape
+    resid_sp = x
+    h = group_norm(x, p["norm"]["weight"], p["norm"]["bias"], 32)
+    h = h.reshape(b, c, hh * ww).transpose(0, 2, 1)
+    h = linear(h, p["proj_in"]["weight"], p["proj_in"]["bias"])
+    for bp in p["blocks"]:
+        h = _tblock(bp, h, ctx, heads)
     h = linear(h, p["proj_out"]["weight"], p["proj_out"]["bias"])
     return resid_sp + h.transpose(0, 2, 1).reshape(b, c, hh, ww)
 
 
-def unet_forward(cfg: UNetConfig, p: dict, x, t, ctx):
-    """x: [B, 4, H/8, W/8]; t: [B] timestep fraction in [0,1]; ctx: [B,S,ctx].
-    Returns epsilon prediction, same shape as x."""
+def unet_forward(cfg: UNetConfig, p: dict, x, t, ctx, added=None):
+    """x: [B, 4, H/8, W/8]; t: [B] timestep fraction in [0,1]; ctx: [B,S,ctx];
+    added: [B, addition_embed_dim] SDXL text_time vector (pooled text ++
+    time-id sinusoids), added to the time embedding through its own MLP.
+    Returns the noise/velocity prediction, same shape as x."""
     # timestep_embedding scales by 1000 internally; t arrives in [0, 1]
     temb = timestep_embedding(t, cfg.base_channels).astype(x.dtype)
     temb = linear(temb, p["time_mlp1"]["weight"], p["time_mlp1"]["bias"])
     temb = linear(jax.nn.silu(temb), p["time_mlp2"]["weight"],
                   p["time_mlp2"]["bias"])
+    if added is not None:
+        aemb = linear(added.astype(x.dtype), p["add_mlp1"]["weight"],
+                      p["add_mlp1"]["bias"])
+        temb = temb + linear(jax.nn.silu(aemb), p["add_mlp2"]["weight"],
+                             p["add_mlp2"]["bias"])
 
     h = conv2d(x, p["conv_in"]["weight"], p["conv_in"]["bias"], padding=1)
+    n_lv = len(cfg.channel_mults)
     skips = [h]
-    for blk in p["down"]:
+    for lvl, blk in enumerate(p["down"]):
         # mapped loads drop structural Nones entirely — treat a missing
         # "attn"/"down" the same as an explicit None
         attns = blk.get("attn") or [None] * len(blk["res"])
         for r, a in zip(blk["res"], attns):
             h = _resnet(r, h, temb)
             if a is not None:
-                h = _xattn(a, h, ctx, cfg.num_heads)
+                h = _xattn(a, h, ctx, cfg.heads_at(lvl))
             skips.append(h)
         if blk.get("down") is not None:
             h = conv2d(h, blk["down"]["weight"], blk["down"]["bias"],
                        stride=2, padding=1)
             skips.append(h)
     h = _resnet(p["mid_res1"], h, temb)
-    h = _xattn(p["mid_attn"], h, ctx, cfg.num_heads)
+    h = _xattn(p["mid_attn"], h, ctx, cfg.heads_at(n_lv - 1))
     h = _resnet(p["mid_res2"], h, temb)
-    for blk in p["up"]:
+    for k, blk in enumerate(p["up"]):
+        lvl = n_lv - 1 - k                  # up_blocks.0 is the deepest level
         attns = blk.get("attn") or [None] * len(blk["res"])
         for r, a in zip(blk["res"], attns):
             h = jnp.concatenate([h, skips.pop()], axis=1)
             h = _resnet(r, h, temb)
             if a is not None:
-                h = _xattn(a, h, ctx, cfg.num_heads)
+                h = _xattn(a, h, ctx, cfg.heads_at(lvl))
         if blk.get("up") is not None:
             b, c, hh, ww = h.shape
             h = jax.image.resize(h, (b, c, hh * 2, ww * 2), "nearest")
@@ -285,13 +346,15 @@ class SDImageModel:
         self.params = params
         self.text_encoder = text_encoder or DummyTextEncoder(
             cfg.unet.context_dim, 1, seq_len=8)
-        self.scheduler = DpmSolverPP.from_betas(prediction_type="epsilon")
+        self.scheduler = DpmSolverPP.from_betas(
+            beta_start=cfg.beta_start, beta_end=cfg.beta_end,
+            schedule=cfg.beta_schedule, prediction_type=cfg.prediction_type)
 
         ucfg, vcfg = cfg.unet, cfg.vae
 
         @jax.jit
-        def _eps(up, x, t, ctx):
-            return unet_forward(ucfg, up, x, t, ctx)
+        def _eps(up, x, t, ctx, added):
+            return unet_forward(ucfg, up, x, t, ctx, added)
 
         @jax.jit
         def _decode(vp, z):
@@ -299,6 +362,15 @@ class SDImageModel:
 
         self._eps = _eps
         self._decode = _decode
+
+    def _encode_prompt(self, prompt: str, negative_prompt: str,
+                       width: int, height: int):
+        """Returns (ctx_cat [2,S,C], added_cat [2,A] | None), uncond first.
+        SDXL overrides this with dual-encoder + text_time conditioning."""
+        ctx_p, _ = self.text_encoder(prompt)
+        ctx_n, _ = self.text_encoder(negative_prompt)
+        return jnp.concatenate([jnp.asarray(ctx_n, self.dtype),
+                                jnp.asarray(ctx_p, self.dtype)], axis=0), None
 
     def generate_image(self, prompt: str, width: int = 512, height: int = 512,
                        steps: int | None = None, guidance: float | None = None,
@@ -312,10 +384,8 @@ class SDImageModel:
         lh, lw = max(height // factor, 8), max(width // factor, 8)
         rng = jax.random.PRNGKey(seed if seed is not None else 0)
 
-        ctx_p, _ = self.text_encoder(prompt)
-        ctx_n, _ = self.text_encoder(negative_prompt or "")
-        ctx_p = jnp.asarray(ctx_p, self.dtype)
-        ctx_n = jnp.asarray(ctx_n, self.dtype)
+        ctx_cat, added_cat = self._encode_prompt(prompt, negative_prompt or "",
+                                                 width, height)
 
         sch = self.scheduler
         sch.reset()
@@ -336,11 +406,11 @@ class SDImageModel:
 
         # batched CFG: one UNet call computes cond+uncond (ref: sd.rs does
         # the standard batch-2 CFG trick) — halves per-step dispatches
-        ctx_cat = jnp.concatenate([ctx_n, ctx_p], axis=0)
         for j, t in enumerate(ts):
             tv = jnp.full((2,), t / sch.T, jnp.float32)
             eps2 = self._eps(self.params["unet"],
-                             jnp.concatenate([x, x], axis=0), tv, ctx_cat)
+                             jnp.concatenate([x, x], axis=0), tv, ctx_cat,
+                             added_cat)
             eps = cfg_combine(eps2[:1], eps2[1:], g)
             t_next = int(ts[j + 1]) if j + 1 < len(ts) else 0
             x = sch.step(eps, int(t), t_next, x)
@@ -349,3 +419,40 @@ class SDImageModel:
 
         img = self._decode(self.params["vae"], x)
         return to_pil(np.asarray(img[0, :, :height, :width]))
+
+
+class SDXLImageModel(SDImageModel):
+    """SDXL pipeline: dual text encoders (CLIP-L + OpenCLIP bigG, both
+    penultimate hidden states concatenated to the 2048-dim context) and
+    text_time addition embeddings (encoder-2 pooled text ++ six 256-dim
+    size/crop sinusoids) through the add_embedding MLP
+    (ref: models/sd/sd.rs XL branch via candle-transformers)."""
+
+    def __init__(self, cfg: SDPipelineConfig, params: dict,
+                 text_encoder, text_encoder2, dtype=jnp.float32,
+                 seed: int = 0):
+        super().__init__(cfg, params=params, text_encoder=text_encoder,
+                         dtype=dtype, seed=seed)
+        self.text_encoder2 = text_encoder2
+
+    def _encode_prompt(self, prompt: str, negative_prompt: str,
+                       width: int, height: int):
+        def enc(p):
+            _, _, pen1 = self.text_encoder.encode3(p)
+            _, pooled2, pen2 = self.text_encoder2.encode3(p)
+            ctx = jnp.concatenate([jnp.asarray(pen1, self.dtype),
+                                   jnp.asarray(pen2, self.dtype)], axis=-1)
+            return ctx, jnp.asarray(pooled2, self.dtype)
+
+        ctx_p, pooled_p = enc(prompt)
+        ctx_n, pooled_n = enc(negative_prompt)
+        # original size, crop top-left, target size (no cropping)
+        time_ids = jnp.asarray([float(height), float(width), 0.0, 0.0,
+                                float(height), float(width)], jnp.float32)
+        d = self.cfg.unet.addition_time_embed_dim
+        tid_emb = timestep_embedding(time_ids, d, scale=1.0).reshape(1, -1)
+        tid_emb = tid_emb.astype(self.dtype)
+        added_p = jnp.concatenate([pooled_p, tid_emb], axis=-1)
+        added_n = jnp.concatenate([pooled_n, tid_emb], axis=-1)
+        return (jnp.concatenate([ctx_n, ctx_p], axis=0),
+                jnp.concatenate([added_n, added_p], axis=0))

@@ -2,12 +2,19 @@
 the format the reference downloads per component, ref: models/sd/sd.rs
 ModelFile::{Clip,Unet,Vae} + subdir() names).
 
-Expected layout (a standard `diffusers` dump of SD v1.5/2.1-class models):
+Expected layout (a standard `diffusers` dump of SD v1.5/2.x-class models):
     model_dir/
       unet/config.json + diffusion_pytorch_model.safetensors
       vae/config.json + diffusion_pytorch_model.safetensors
       text_encoder/model.safetensors          (HF CLIPTextModel)
       tokenizer/tokenizer.json | vocab.json+merges.txt
+      scheduler/scheduler_config.json         (optional: prediction_type)
+
+SD2.x specifics handled here: per-level attention_head_dim lists, linear
+(non-conv) spatial-transformer projections (shape-dispatched transform),
+gelu text encoder, v-prediction from the scheduler config. SDXL is
+detected via text_encoder_2/ and loaded as SDXLImageModel (dual encoders,
+per-level transformer depths, text_time addition embeddings).
 
 Component configs come from the diffusers config.json files; tensor names
 cover both VAE attention-name generations (to_q/... and query/...).
@@ -61,26 +68,30 @@ def sd_unet_mapping(cfg: UNetConfig) -> tuple[dict, dict]:
         if has_shortcut:
             conv(f"{dst}.shortcut", f"{src}.conv_shortcut")
 
-    def xattn(dst, src):
+    def xattn(dst, src, depth):
         conv(f"{dst}.norm", f"{src}.norm")
         for pj in ("proj_in", "proj_out"):
             conv(f"{dst}.{pj}", f"{src}.{pj}")
             tr[f"{dst}.{pj}.weight"] = _squeeze_conv
-        t = f"{src}.transformer_blocks.0"
-        for ours, theirs in (("norm1", "norm1"), ("norm2", "norm2"),
-                             ("norm3", "norm3")):
-            conv(f"{dst}.{ours}", f"{t}.{theirs}")
-        for blk, ours in (("attn1", "self"), ("attn2", "cross")):
-            for proj in ("q", "k", "v"):
-                m[f"{dst}.{ours}_{proj}.weight"] = \
-                    f"{t}.{blk}.to_{proj}.weight"
-            conv(f"{dst}.{ours}_o", f"{t}.{blk}.to_out.0")
-        conv(f"{dst}.ff1", f"{t}.ff.net.0.proj")
-        conv(f"{dst}.ff2", f"{t}.ff.net.2")
+        for d in range(depth):
+            t = f"{src}.transformer_blocks.{d}"
+            b = f"{dst}.blocks.{d}"
+            for ln in ("norm1", "norm2", "norm3"):
+                conv(f"{b}.{ln}", f"{t}.{ln}")
+            for blk, ours in (("attn1", "self"), ("attn2", "cross")):
+                for proj in ("q", "k", "v"):
+                    m[f"{b}.{ours}_{proj}.weight"] = \
+                        f"{t}.{blk}.to_{proj}.weight"
+                conv(f"{b}.{ours}_o", f"{t}.{blk}.to_out.0")
+            conv(f"{b}.ff1", f"{t}.ff.net.0.proj")
+            conv(f"{b}.ff2", f"{t}.ff.net.2")
 
     conv("conv_in", "conv_in")
     conv("time_mlp1", "time_embedding.linear_1")
     conv("time_mlp2", "time_embedding.linear_2")
+    if cfg.addition_embed_dim:
+        conv("add_mlp1", "add_embedding.linear_1")
+        conv("add_mlp2", "add_embedding.linear_2")
     conv("norm_out", "conv_norm_out")
     conv("conv_out", "conv_out")
 
@@ -93,12 +104,13 @@ def sd_unet_mapping(cfg: UNetConfig) -> tuple[dict, dict]:
         for j in range(cfg.num_res_blocks):
             resnet(f"{dst}.res.{j}", f"{src}.resnets.{j}", cin != c)
             if lvl in cfg.attn_levels:
-                xattn(f"{dst}.attn.{j}", f"{src}.attentions.{j}")
+                xattn(f"{dst}.attn.{j}", f"{src}.attentions.{j}",
+                      cfg.depth_at(lvl))
             cin = c
         if lvl < n_lv - 1:
             conv(f"{dst}.down", f"{src}.downsamplers.0.conv")
     resnet("mid_res1", "mid_block.resnets.0", False)
-    xattn("mid_attn", "mid_block.attentions.0")
+    xattn("mid_attn", "mid_block.attentions.0", cfg.depth_at(n_lv - 1))
     resnet("mid_res2", "mid_block.resnets.1", False)
     # decoder: up_blocks.0 runs first (mirror of the deepest level); every
     # up resnet consumes a skip concat, so all have conv_shortcut
@@ -108,7 +120,8 @@ def sd_unet_mapping(cfg: UNetConfig) -> tuple[dict, dict]:
         for j in range(cfg.num_res_blocks + 1):
             resnet(f"{dst}.res.{j}", f"{src}.resnets.{j}", True)
             if lvl in cfg.attn_levels:
-                xattn(f"{dst}.attn.{j}", f"{src}.attentions.{j}")
+                xattn(f"{dst}.attn.{j}", f"{src}.attentions.{j}",
+                      cfg.depth_at(lvl))
         if lvl > 0:
             conv(f"{dst}.up", f"{src}.upsamplers.0.conv")
     return m, tr
@@ -182,24 +195,38 @@ def _load_json(*parts):
 def sd_configs_from_dir(model_dir: str) -> SDPipelineConfig:
     u = _load_json(model_dir, "unet", "config.json")
     v = _load_json(model_dir, "vae", "config.json")
+    add_type = u.get("addition_embed_type")
+    if add_type not in (None, "text_time"):
+        raise NotImplementedError(
+            f"addition_embed_type={add_type!r} is not supported "
+            "(SDXL's 'text_time' and plain SD1.x/2.x load fine)")
     blocks = u["block_out_channels"]
     base = blocks[0]
     attn_levels = tuple(i for i, t in enumerate(u["down_block_types"])
                         if "CrossAttn" in t)
+    # diffusers' `attention_head_dim` historically holds HEAD COUNTS:
+    # SD1.x an int (8 heads everywhere), SD2.x a per-level list
+    # ((5, 10, 20, 20) = constant 64-dim heads as channels scale)
     head_dim = u.get("attention_head_dim", 8)
-    if isinstance(head_dim, list):
-        raise NotImplementedError(
-            "per-level attention_head_dim (SD2.x/XL-style UNet) is not yet "
-            "supported; SD v1.5-class checkpoints load fine")
+    num_heads = tuple(head_dim) if isinstance(head_dim, list) else head_dim
+    if isinstance(num_heads, tuple) and len(num_heads) != len(blocks):
+        raise ValueError(
+            f"attention_head_dim list has {len(num_heads)} entries for "
+            f"{len(blocks)} UNet levels")
+    depth = u.get("transformer_layers_per_block", 1)
     unet = UNetConfig(
         in_channels=u["in_channels"], base_channels=base,
         channel_mults=tuple(c // base for c in blocks),
         num_res_blocks=u.get("layers_per_block", 2),
         attn_levels=attn_levels,
-        # SD1.x convention: attention_head_dim is the HEAD COUNT
-        num_heads=head_dim,
+        num_heads=num_heads,
         context_dim=u["cross_attention_dim"],
         time_dim=base * 4,
+        transformer_depth=tuple(depth) if isinstance(depth, list) else depth,
+        # SDXL: pooled-text + time-id input width of add_embedding.linear_1
+        addition_embed_dim=u.get("projection_class_embeddings_input_dim")
+        if add_type == "text_time" else None,
+        addition_time_embed_dim=u.get("addition_time_embed_dim", 256),
     )
     vbase = v["block_out_channels"][0]
     vae = VaeConfig(
@@ -210,16 +237,31 @@ def sd_configs_from_dir(model_dir: str) -> SDPipelineConfig:
         scaling_factor=v.get("scaling_factor", 0.18215),
         shift_factor=v.get("shift_factor") or 0.0,
     )
-    return SDPipelineConfig(unet=unet, vae=vae)
+    # scheduler config carries the training parameterization: SD2.1-768 is
+    # v-prediction, everything 1.x/2.x-base is epsilon
+    sched_path = os.path.join(model_dir, "scheduler", "scheduler_config.json")
+    sched = {}
+    if os.path.exists(sched_path):
+        sched = _load_json(sched_path)
+    return SDPipelineConfig(
+        unet=unet, vae=vae,
+        prediction_type=sched.get("prediction_type", "epsilon"),
+        beta_start=sched.get("beta_start", 0.00085),
+        beta_end=sched.get("beta_end", 0.012),
+        beta_schedule=sched.get("beta_schedule", "scaled_linear"),
+    )
 
 
 class SDTextEncoder:
-    """prompt -> (CLIP sequence hidden states, pooled) padded to 77."""
+    """prompt -> (CLIP hidden states, pooled, penultimate) padded to 77.
+
+    `__call__` keeps the (hidden, pooled) contract the SD1.x/2.x pipeline
+    uses; `encode3` exposes the penultimate stream for SDXL."""
 
     def __init__(self, cfg: CLIPTextConfig, params: dict, model_dir: str,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, tokenizer_subdir: str = "tokenizer"):
         self.cfg, self.params, self.dtype = cfg, params, dtype
-        tok_json = os.path.join(model_dir, "tokenizer", "tokenizer.json")
+        tok_json = os.path.join(model_dir, tokenizer_subdir, "tokenizer.json")
         if os.path.exists(tok_json):
             from tokenizers import Tokenizer
             self._tok = Tokenizer.from_file(tok_json)
@@ -227,7 +269,7 @@ class SDTextEncoder:
         else:
             from transformers import AutoTokenizer
             self._hf = AutoTokenizer.from_pretrained(
-                os.path.join(model_dir, "tokenizer"))
+                os.path.join(model_dir, tokenizer_subdir))
             self._tok = None
 
         @jax.jit
@@ -236,7 +278,7 @@ class SDTextEncoder:
 
         self._encode = _encode
 
-    def __call__(self, prompt: str):
+    def encode3(self, prompt: str):
         n = self.cfg.max_positions
         if self._tok is not None:
             ids = self._tok.encode(prompt).ids
@@ -246,9 +288,14 @@ class SDTextEncoder:
             ids = ids[:n]
             ids[-1] = self.cfg.eot_token_id
         ids = ids + [self.cfg.eot_token_id] * (n - len(ids))
-        hidden, pooled = self._encode(self.params,
-                                      jnp.asarray([ids], jnp.int32))
-        return hidden.astype(self.dtype), pooled.astype(self.dtype)
+        hidden, pooled, penult = self._encode(self.params,
+                                              jnp.asarray([ids], jnp.int32))
+        return (hidden.astype(self.dtype), pooled.astype(self.dtype),
+                penult.astype(self.dtype))
+
+    def __call__(self, prompt: str):
+        hidden, pooled, _ = self.encode3(prompt)
+        return hidden, pooled
 
 
 def load_sd_image_model(path: str, dtype=jnp.float32):
@@ -282,7 +329,25 @@ def load_sd_image_model(path: str, dtype=jnp.float32):
     assert "post_quant_conv" in params["vae"]
     coverage_report(vae_st, vm, ignore=("encoder.", "quant_conv."))
 
-    te_dir = os.path.join(path, "text_encoder")
+    encoder = _load_clip_encoder(path, "text_encoder", "tokenizer", dtype)
+    if os.path.isdir(os.path.join(path, "text_encoder_2")):
+        from .sd import SDXLImageModel
+        encoder2 = _load_clip_encoder(path, "text_encoder_2", "tokenizer_2",
+                                      dtype, with_projection=True)
+        log.info("loaded SDXL checkpoint: base %d, mults %s, ctx %d, "
+                 "depth %s", cfg.unet.base_channels, cfg.unet.channel_mults,
+                 cfg.unet.context_dim, cfg.unet.transformer_depth)
+        return SDXLImageModel(cfg, params=params, text_encoder=encoder,
+                              text_encoder2=encoder2, dtype=dtype)
+    log.info("loaded SD checkpoint: base %d, mults %s, ctx %d",
+             cfg.unet.base_channels, cfg.unet.channel_mults,
+             cfg.unet.context_dim)
+    return SDImageModel(cfg, params=params, text_encoder=encoder, dtype=dtype)
+
+
+def _load_clip_encoder(path: str, subdir: str, tokenizer_subdir: str,
+                       dtype, with_projection: bool = False) -> SDTextEncoder:
+    te_dir = os.path.join(path, subdir)
     te_cfg_raw = _load_json(te_dir, "config.json") \
         if os.path.exists(os.path.join(te_dir, "config.json")) else {}
     clip_cfg = CLIPTextConfig(
@@ -297,6 +362,10 @@ def load_sd_image_model(path: str, dtype=jnp.float32):
         # ids, which only works because EOT is the highest id
         eot_token_id=te_cfg_raw.get("eot_token_id",
                                     te_cfg_raw.get("vocab_size", 49408) - 1),
+        # SD2.x/XL ship OpenCLIP-converted encoders with exact gelu
+        hidden_act=te_cfg_raw.get("hidden_act", "quick_gelu"),
+        projection_dim=te_cfg_raw.get("projection_dim")
+        if with_projection else None,
     )
     clip_st = TensorStorage.from_model_dir(te_dir)
     cm = clip_mapping(clip_cfg)
@@ -306,8 +375,5 @@ def load_sd_image_model(path: str, dtype=jnp.float32):
             clip_cfg, jax.random.PRNGKey(0), dtype)), dtype)
     coverage_report(clip_st, cm,
                     ignore=("text_model.embeddings.position_ids",))
-    encoder = SDTextEncoder(clip_cfg, clip_params, path, dtype)
-    log.info("loaded SD checkpoint: base %d, mults %s, ctx %d",
-             cfg.unet.base_channels, cfg.unet.channel_mults,
-             cfg.unet.context_dim)
-    return SDImageModel(cfg, params=params, text_encoder=encoder, dtype=dtype)
+    return SDTextEncoder(clip_cfg, clip_params, path, dtype,
+                         tokenizer_subdir=tokenizer_subdir)

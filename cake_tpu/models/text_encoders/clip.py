@@ -11,6 +11,7 @@ vocab).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +29,12 @@ class CLIPTextConfig:
     max_positions: int = 77
     layer_norm_eps: float = 1e-5
     eot_token_id: int = 49407
+    # OpenAI CLIP-L uses quick_gelu; OpenCLIP ViT-H/bigG (SD2.x/XL text
+    # encoders) use exact gelu — diffusers config.json "hidden_act"
+    hidden_act: str = "quick_gelu"
+    # CLIPTextModelWithProjection (SDXL encoder 2): pooled output goes
+    # through a bias-free text_projection to this width
+    projection_dim: int | None = None
 
 
 def tiny_clip_config() -> CLIPTextConfig:
@@ -58,6 +65,11 @@ def init_clip_params(cfg: CLIPTextConfig, key, dtype=jnp.float32) -> dict:
         "layers": [],
         "final_layer_norm": _ln(h, dtype),
     }
+    if cfg.projection_dim:
+        p["text_projection"] = {
+            "weight": jax.random.normal(
+                jax.random.fold_in(key, 7), (cfg.projection_dim, h),
+                dtype) * 0.02}
     for _ in range(cfg.num_layers):
         p["layers"].append({
             "layer_norm1": _ln(h, dtype),
@@ -104,16 +116,28 @@ def quick_gelu(x):
 
 def clip_text_forward(cfg: CLIPTextConfig, params: dict, ids):
     """ids: [B, S] int32 (S <= max_positions).
-    Returns (hidden [B, S, H], pooled [B, H])."""
+    Returns (hidden [B, S, H], pooled [B, H or projection_dim],
+    penultimate [B, S, H]).
+
+    penultimate = residual stream with the LAST layer skipped, no final
+    layer norm — HF `hidden_states[-2]`, the conditioning SDXL uses from
+    both of its encoders. pooled = final-normed hidden at the first EOT
+    position (HF: argmax of ids), through `text_projection` when the
+    params carry one (CLIPTextModelWithProjection — SDXL's encoder 2)."""
     b, s = ids.shape
+    # HF/OpenCLIP "gelu" is the exact erf GELU (jax default is tanh-approx)
+    act = quick_gelu if cfg.hidden_act == "quick_gelu" else \
+        functools.partial(jax.nn.gelu, approximate=False)
     x = params["token_embedding"]["weight"][ids]
     x = x + params["position_embedding"]["weight"][:s][None]
     mask = jnp.tril(jnp.ones((s, s), bool))
+    penult = x
     for lp in params["layers"]:
+        penult = x
         h = _layer_norm(x, lp["layer_norm1"], cfg.layer_norm_eps)
         x = x + _attn(cfg, lp, h, mask)
         h = _layer_norm(x, lp["layer_norm2"], cfg.layer_norm_eps)
-        h = quick_gelu(linear(h, lp["fc1"]["weight"], lp["fc1"]["bias"]))
+        h = act(linear(h, lp["fc1"]["weight"], lp["fc1"]["bias"]))
         x = x + linear(h, lp["fc2"]["weight"], lp["fc2"]["bias"])
     x = _layer_norm(x, params["final_layer_norm"], cfg.layer_norm_eps)
     # pooled = hidden at the first EOT position (HF: argmax of ids)
@@ -121,11 +145,14 @@ def clip_text_forward(cfg: CLIPTextConfig, params: dict, ids):
                                jnp.arange(s, 0, -1, dtype=jnp.int32), 0),
                      axis=1)
     pooled = x[jnp.arange(b), eot]
-    return x, pooled
+    if "text_projection" in params:
+        pooled = linear(pooled, params["text_projection"]["weight"])
+    return x, pooled, penult
 
 
 def clip_mapping(cfg: CLIPTextConfig, prefix: str = "text_model.") -> dict:
-    """pytree path -> HF CLIPTextModel tensor name."""
+    """pytree path -> HF CLIPTextModel tensor name. text_projection lives
+    OUTSIDE the text_model prefix (CLIPTextModelWithProjection)."""
     m = {
         "token_embedding.weight":
             f"{prefix}embeddings.token_embedding.weight",
@@ -134,6 +161,8 @@ def clip_mapping(cfg: CLIPTextConfig, prefix: str = "text_model.") -> dict:
         "final_layer_norm.weight": f"{prefix}final_layer_norm.weight",
         "final_layer_norm.bias": f"{prefix}final_layer_norm.bias",
     }
+    if cfg.projection_dim:
+        m["text_projection.weight"] = "text_projection.weight"
     for i in range(cfg.num_layers):
         src = f"{prefix}encoder.layers.{i}."
         dst = f"layers.{i}."

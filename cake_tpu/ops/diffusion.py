@@ -59,8 +59,14 @@ class DpmSolverPP:
                    schedule="scaled_linear", **kw):
         if schedule == "scaled_linear":
             betas = np.linspace(beta_start ** 0.5, beta_end ** 0.5, n) ** 2
-        else:
+        elif schedule == "linear":
             betas = np.linspace(beta_start, beta_end, n)
+        elif schedule == "squaredcos_cap_v2":
+            return cls.from_cosine(n=n, **kw)
+        else:
+            # a silently-wrong noise schedule produces garbage images with
+            # no diagnostic — reject instead
+            raise NotImplementedError(f"beta schedule {schedule!r}")
         return cls(np.cumprod(1.0 - betas), **kw)
 
     @classmethod

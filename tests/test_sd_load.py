@@ -176,13 +176,222 @@ def test_sd_runtime_detection(tmp_path):
     assert type(model).__name__ == "SDImageModel"
 
 
-def test_sd2_per_level_heads_clear_error(tmp_path):
+def synth_sd2_dir(tmp_path):
+    """SD2.x-shaped synth: per-level head counts, linear spatial-transformer
+    projections (use_linear_projection), gelu text encoder, v-prediction
+    scheduler config."""
+    unet2 = UNetConfig(base_channels=32, channel_mults=(1, 2),
+                       num_res_blocks=1, attn_levels=(0, 1), num_heads=(2, 4),
+                       context_dim=32, time_dim=128)
+    rng = jax.random.PRNGKey(7)
+    ks = jax.random.split(rng, 3)
+
+    os.makedirs(tmp_path / "unet")
+    u_params = init_unet_params(unet2, ks[0], jnp.float32)
+    um, _ = sd_unet_mapping(unet2)
+    flat = flatten_tree(u_params)
+    # use_linear_projection: proj_in/out stored 2D, no conv expansion
+    tensors = {name: np.asarray(flat[path], np.float32)
+               for path, name in um.items()}
+    save_safetensors(str(tmp_path / "unet" /
+                         "diffusion_pytorch_model.safetensors"), tensors)
+    with open(tmp_path / "unet" / "config.json", "w") as f:
+        json.dump({
+            "in_channels": 4, "block_out_channels": [32, 64],
+            "layers_per_block": 1, "cross_attention_dim": 32,
+            "attention_head_dim": [2, 4], "use_linear_projection": True,
+            "down_block_types": ["CrossAttnDownBlock2D",
+                                 "CrossAttnDownBlock2D"],
+            "up_block_types": ["CrossAttnUpBlock2D", "CrossAttnUpBlock2D"],
+        }, f)
+
+    os.makedirs(tmp_path / "vae")
+    v_params = init_vae_decoder_params(TINY_VAE, ks[1], jnp.float32)
+    v_params["post_quant_conv"] = {
+        "weight": np.random.default_rng(0).standard_normal(
+            (4, 4, 1, 1)).astype(np.float32) * 0.1,
+        "bias": np.zeros((4,), np.float32)}
+    vm, _ = sd_vae_decoder_mapping({"decoder.mid_block.attentions.0.to_q.weight": 1},
+                                   TINY_VAE)   # new-style names
+    flatv = flatten_tree(v_params)
+    tensors = {}
+    for path, name in vm.items():
+        arr = np.asarray(flatv[path], np.float32)
+        if path.startswith("mid_attn") and arr.ndim == 4:
+            arr = arr.reshape(arr.shape[0], arr.shape[1])
+        tensors[name] = arr
+    save_safetensors(str(tmp_path / "vae" /
+                         "diffusion_pytorch_model.safetensors"), tensors)
+    with open(tmp_path / "vae" / "config.json", "w") as f:
+        json.dump({"latent_channels": 4, "block_out_channels": [32, 64],
+                   "layers_per_block": 1, "scaling_factor": 0.18215}, f)
+
+    os.makedirs(tmp_path / "scheduler")
+    with open(tmp_path / "scheduler" / "scheduler_config.json", "w") as f:
+        json.dump({"prediction_type": "v_prediction",
+                   "beta_start": 0.00085, "beta_end": 0.012,
+                   "beta_schedule": "scaled_linear"}, f)
+
+    os.makedirs(tmp_path / "text_encoder")
+    from cake_tpu.models.text_encoders import CLIPTextConfig
+    clip_cfg = CLIPTextConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                              num_heads=2, intermediate_size=64,
+                              max_positions=16, eot_token_id=95,
+                              hidden_act="gelu")
+    c_params = init_clip_params(clip_cfg, ks[2], jnp.float32)
+    flat_c = flatten_tree(c_params)
+    tensors = {name: np.asarray(flat_c[path], np.float32)
+               for path, name in clip_mapping(clip_cfg).items()}
+    save_safetensors(str(tmp_path / "text_encoder" / "model.safetensors"),
+                     tensors)
+    with open(tmp_path / "text_encoder" / "config.json", "w") as f:
+        json.dump({"vocab_size": 96, "hidden_size": 32,
+                   "num_hidden_layers": 2, "num_attention_heads": 2,
+                   "intermediate_size": 64, "max_position_embeddings": 16,
+                   "eot_token_id": 95, "hidden_act": "gelu"}, f)
+
+    os.makedirs(tmp_path / "tokenizer")
+    _word_level_tokenizer_json(tmp_path / "tokenizer" / "tokenizer.json", 96)
+
+
+def test_sd2_load_and_generate(tmp_path):
+    synth_sd2_dir(tmp_path)
+    model = load_sd_image_model(str(tmp_path), dtype=jnp.float32)
+    assert model.cfg.unet.num_heads == (2, 4)
+    assert model.cfg.prediction_type == "v_prediction"
+    assert model.scheduler.prediction_type == "v_prediction"
+    img = model.generate_image("w1 w2", width=32, height=32, steps=2, seed=0)
+    assert img.size == (32, 32)
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_sd2_gelu_text_encoder_differs_from_quick_gelu(tmp_path):
+    """hidden_act must actually change the activation: same weights, the
+    two activations give different hidden states."""
+    from cake_tpu.models.text_encoders import CLIPTextConfig, clip_text_forward
+    import dataclasses as dc
+    cfg = CLIPTextConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64, max_positions=16,
+                         eot_token_id=95, hidden_act="gelu")
+    params = init_clip_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    ids = jnp.asarray([[1, 2, 3, 95]], jnp.int32)
+    h_gelu, _, _ = clip_text_forward(cfg, params, ids)
+    h_quick, _, _ = clip_text_forward(dc.replace(cfg, hidden_act="quick_gelu"),
+                                      params, ids)
+    assert not np.allclose(np.asarray(h_gelu), np.asarray(h_quick))
+
+
+def _synth_clip_dir(tmp_path, subdir, key, hidden_act="quick_gelu",
+                    projection_dim=None):
+    from cake_tpu.models.text_encoders import CLIPTextConfig
+    cfg = CLIPTextConfig(vocab_size=96, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64, max_positions=16,
+                         eot_token_id=95, hidden_act=hidden_act,
+                         projection_dim=projection_dim)
+    os.makedirs(tmp_path / subdir)
+    params = init_clip_params(cfg, key, jnp.float32)
+    flat = flatten_tree(params)
+    tensors = {name: np.asarray(flat[path], np.float32)
+               for path, name in clip_mapping(cfg).items()}
+    save_safetensors(str(tmp_path / subdir / "model.safetensors"), tensors)
+    raw = {"vocab_size": 96, "hidden_size": 32, "num_hidden_layers": 2,
+           "num_attention_heads": 2, "intermediate_size": 64,
+           "max_position_embeddings": 16, "eot_token_id": 95,
+           "hidden_act": hidden_act}
+    if projection_dim:
+        raw["projection_dim"] = projection_dim
+    with open(tmp_path / subdir / "config.json", "w") as f:
+        json.dump(raw, f)
+
+
+def synth_sdxl_dir(tmp_path):
+    """SDXL-shaped synth: dual text encoders (encoder 2 with
+    text_projection), per-level transformer depth, text_time addition
+    embeddings, 2048-style concat context (here 32+32=64)."""
+    unet_xl = UNetConfig(base_channels=32, channel_mults=(1, 2),
+                         num_res_blocks=1, attn_levels=(1,), num_heads=(2, 4),
+                         context_dim=64, time_dim=128,
+                         transformer_depth=(1, 2),
+                         addition_embed_dim=16 + 6 * 8,
+                         addition_time_embed_dim=8)
+    rng = jax.random.PRNGKey(11)
+    ks = jax.random.split(rng, 4)
+
+    os.makedirs(tmp_path / "unet")
+    u_params = init_unet_params(unet_xl, ks[0], jnp.float32)
+    um, _ = sd_unet_mapping(unet_xl)
+    flat = flatten_tree(u_params)
+    tensors = {name: np.asarray(flat[path], np.float32)
+               for path, name in um.items()}
+    save_safetensors(str(tmp_path / "unet" /
+                         "diffusion_pytorch_model.safetensors"), tensors)
+    with open(tmp_path / "unet" / "config.json", "w") as f:
+        json.dump({
+            "in_channels": 4, "block_out_channels": [32, 64],
+            "layers_per_block": 1, "cross_attention_dim": 64,
+            "attention_head_dim": [2, 4], "use_linear_projection": True,
+            "transformer_layers_per_block": [1, 2],
+            "addition_embed_type": "text_time",
+            "addition_time_embed_dim": 8,
+            "projection_class_embeddings_input_dim": 16 + 6 * 8,
+            "down_block_types": ["DownBlock2D", "CrossAttnDownBlock2D"],
+            "up_block_types": ["CrossAttnUpBlock2D", "UpBlock2D"],
+        }, f)
+
+    os.makedirs(tmp_path / "vae")
+    v_params = init_vae_decoder_params(TINY_VAE, ks[1], jnp.float32)
+    v_params["post_quant_conv"] = {
+        "weight": np.random.default_rng(0).standard_normal(
+            (4, 4, 1, 1)).astype(np.float32) * 0.1,
+        "bias": np.zeros((4,), np.float32)}
+    vm, _ = sd_vae_decoder_mapping(
+        {"decoder.mid_block.attentions.0.to_q.weight": 1}, TINY_VAE)
+    flatv = flatten_tree(v_params)
+    tensors = {}
+    for path, name in vm.items():
+        arr = np.asarray(flatv[path], np.float32)
+        if path.startswith("mid_attn") and arr.ndim == 4:
+            arr = arr.reshape(arr.shape[0], arr.shape[1])
+        tensors[name] = arr
+    save_safetensors(str(tmp_path / "vae" /
+                         "diffusion_pytorch_model.safetensors"), tensors)
+    with open(tmp_path / "vae" / "config.json", "w") as f:
+        json.dump({"latent_channels": 4, "block_out_channels": [32, 64],
+                   "layers_per_block": 1, "scaling_factor": 0.13025}, f)
+
+    _synth_clip_dir(tmp_path, "text_encoder", ks[2])
+    _synth_clip_dir(tmp_path, "text_encoder_2", ks[3], hidden_act="gelu",
+                    projection_dim=16)
+    os.makedirs(tmp_path / "tokenizer")
+    _word_level_tokenizer_json(tmp_path / "tokenizer" / "tokenizer.json", 96)
+    os.makedirs(tmp_path / "tokenizer_2")
+    _word_level_tokenizer_json(tmp_path / "tokenizer_2" / "tokenizer.json", 96)
+
+
+def test_sdxl_load_and_generate(tmp_path):
+    synth_sdxl_dir(tmp_path)
+    model = load_sd_image_model(str(tmp_path), dtype=jnp.float32)
+    assert type(model).__name__ == "SDXLImageModel"
+    assert model.cfg.unet.transformer_depth == (1, 2)
+    assert model.cfg.unet.addition_embed_dim == 64
+    assert "add_mlp1" in model.params["unet"]
+    assert "text_projection" in model.text_encoder2.params
+    # pooled of encoder 2 must be projected to projection_dim
+    _, pooled2, pen2 = model.text_encoder2.encode3("w1 w2")
+    assert pooled2.shape == (1, 16)
+    assert pen2.shape[-1] == 32
+    img = model.generate_image("w1 w2", width=32, height=32, steps=2, seed=0)
+    assert img.size == (32, 32)
+    assert np.isfinite(np.asarray(img)).all()
+
+
+def test_sdxl_unknown_addition_embed_clear_error(tmp_path):
     synth_sd_dir(tmp_path)
     cfg_path = tmp_path / "unet" / "config.json"
     with open(cfg_path) as f:
         cfg = json.load(f)
-    cfg["attention_head_dim"] = [5, 10]
+    cfg["addition_embed_type"] = "image_time"
     with open(cfg_path, "w") as f:
         json.dump(cfg, f)
-    with pytest.raises(NotImplementedError, match="attention_head_dim"):
+    with pytest.raises(NotImplementedError, match="addition_embed_type"):
         load_sd_image_model(str(tmp_path))

@@ -58,6 +58,10 @@ class VibeVoiceConfig:
     vae_ratios: tuple[int, ...] = (8, 5, 5, 4, 2, 2)   # hop = 3200 @24kHz
     vae_depths: tuple[int, ...] = (3, 3, 3, 3, 3, 3, 8)
     vae_eps: float = 1e-6
+    # encoder side (raw-wav voice cloning); None = mirror the decoder
+    # (ref: vae_encoder.rs parse_depths / config.rs encoder_* fields)
+    enc_n_filters: int | None = None
+    enc_depths: tuple[int, ...] | None = None
     sample_rate: int = 24000
     cfg_scale: float = 1.3
 
@@ -72,6 +76,20 @@ class VibeVoiceConfig:
         n = len(self.vae_ratios) + 1
         return tuple(self.vae_n_filters * (1 << (n - 1 - i))
                      for i in range(n))
+
+    @property
+    def enc_channels(self) -> tuple[int, ...]:
+        """Encoder doubles channels per stage: n_filters * 2^i
+        (ref: vae_encoder.rs channel progression)."""
+        n = len(self.vae_ratios) + 1
+        f = self.enc_n_filters or self.vae_n_filters
+        return tuple(f * (1 << i) for i in range(n))
+
+    @property
+    def enc_depths_resolved(self) -> tuple[int, ...]:
+        """Per-stage encoder block counts; the decoder's depths reversed
+        when the config carries no explicit encoder_depths."""
+        return self.enc_depths or tuple(reversed(self.vae_depths))
 
     @property
     def hop(self) -> int:
@@ -120,6 +138,9 @@ def vibevoice_config_from_hf(raw: dict) -> VibeVoiceConfig:
         or ac["encoder_n_filters"],
         vae_ratios=ratios, vae_depths=depths,
         vae_eps=ac.get("layernorm_eps", 1e-6),
+        enc_n_filters=ac.get("encoder_n_filters"),
+        enc_depths=tuple(int(x) for x in ac["encoder_depths"].split("-"))
+        if ac.get("encoder_depths") else None,
     )
 
 
@@ -240,6 +261,32 @@ def eos_probability(p: dict, cond):
 # -- acoustic sigma-VAE decoder (ref: vae_decoder.rs) ------------------------
 
 
+def _vae_conv_p(k, co, ci, kk, dtype):
+    return {"weight": jax.random.normal(k, (co, ci, kk), dtype) * 0.05,
+            "bias": jnp.zeros((co,), dtype)}
+
+
+def _vae_block_p(ks, c, dtype):
+    """ConvNeXt-style block params — the encoder blocks are architecturally
+    identical to the decoder's (ref: vae_encoder.rs EncoderBlock doc)."""
+    inner = 4 * c
+    return {
+        "norm": {"weight": jnp.ones((c,), dtype)},
+        "gamma": jnp.full((c,), 0.1, dtype),
+        "mixer": {"weight": jax.random.normal(next(ks), (c, 1, 7),
+                                              dtype) * 0.1,
+                  "bias": jnp.zeros((c,), dtype)},
+        "ffn_norm": {"weight": jnp.ones((c,), dtype)},
+        "ffn_gamma": jnp.full((c,), 0.1, dtype),
+        "ffn1": {"weight": jax.random.normal(next(ks), (inner, c),
+                                             dtype) * 0.05,
+                 "bias": jnp.zeros((inner,), dtype)},
+        "ffn2": {"weight": jax.random.normal(next(ks), (c, inner),
+                                             dtype) * 0.05,
+                 "bias": jnp.zeros((c,), dtype)},
+    }
+
+
 def init_vae_decoder_params(cfg: VibeVoiceConfig, key,
                             dtype=jnp.float32) -> dict:
     chans = cfg.vae_channels
@@ -247,26 +294,10 @@ def init_vae_decoder_params(cfg: VibeVoiceConfig, key,
                                + 8 * sum(cfg.vae_depths)))
 
     def conv_p(k, co, ci, kk):
-        return {"weight": jax.random.normal(k, (co, ci, kk), dtype) * 0.05,
-                "bias": jnp.zeros((co,), dtype)}
+        return _vae_conv_p(k, co, ci, kk, dtype)
 
     def block_p(c):
-        inner = 4 * c
-        return {
-            "norm": {"weight": jnp.ones((c,), dtype)},
-            "gamma": jnp.full((c,), 0.1, dtype),
-            "mixer": {"weight": jax.random.normal(next(ks), (c, 1, 7),
-                                                  dtype) * 0.1,
-                      "bias": jnp.zeros((c,), dtype)},
-            "ffn_norm": {"weight": jnp.ones((c,), dtype)},
-            "ffn_gamma": jnp.full((c,), 0.1, dtype),
-            "ffn1": {"weight": jax.random.normal(next(ks), (inner, c),
-                                                 dtype) * 0.05,
-                     "bias": jnp.zeros((inner,), dtype)},
-            "ffn2": {"weight": jax.random.normal(next(ks), (c, inner),
-                                                 dtype) * 0.05,
-                     "bias": jnp.zeros((c,), dtype)},
-        }
+        return _vae_block_p(ks, c, dtype)
 
     p: dict = {"up": [conv_p(next(ks), chans[0], cfg.acoustic_dim, 7)]}
     for i, r in enumerate(cfg.vae_ratios):
@@ -314,6 +345,69 @@ def vae_decode_frames(cfg: VibeVoiceConfig, p: dict, latents):
             x = _decoder_block(cfg, blk, x)
     x = conv1d(_causal_pad(x, 6), p["head"]["weight"], p["head"]["bias"])
     return x[:, 0]
+
+
+# -- acoustic sigma-VAE encoder (ref: vae_encoder.rs) ------------------------
+# 24kHz waveform -> latent frames, for raw-wav voice cloning (ref:
+# vibevoice_1_5b.rs encode_voice_reference). Inference is deterministic:
+# the sigma-VAE has a fixed sigma, so encode() output IS the latent mean.
+
+
+def init_vae_encoder_params(cfg: VibeVoiceConfig, key,
+                            dtype=jnp.float32) -> dict:
+    chans = cfg.enc_channels
+    depths = cfg.enc_depths_resolved
+    ks = iter(jax.random.split(key, 4 + 2 * len(chans) + 8 * sum(depths)))
+    # downsample convs: stem 1->c0 k7 s1, then c_i->c_{i+1} k=2r stride r
+    # (encoder ratios are the REVERSE of the config's decoder-order ratios,
+    # ref: vae_encoder.rs load)
+    p: dict = {"down": [_vae_conv_p(next(ks), chans[0], 1, 7, dtype)]}
+    for i, r in enumerate(reversed(cfg.vae_ratios)):
+        p["down"].append(_vae_conv_p(next(ks), chans[i + 1], chans[i],
+                                     2 * r, dtype))
+    p["stages"] = [[_vae_block_p(ks, chans[i], dtype) for _ in range(d)]
+                   for i, d in enumerate(depths)]
+    p["head"] = _vae_conv_p(next(ks), cfg.acoustic_dim, chans[-1], 7, dtype)
+    return p
+
+
+def _encoder_frames(cfg: VibeVoiceConfig, n_samples: int) -> int:
+    """Frame count vae_encode_wav produces for an UNPADDED clip of
+    n_samples — the same causal-pad + stride-grid arithmetic, host-side,
+    so bucket-padded silence frames can be sliced off the output."""
+    length = n_samples
+    for s in (1,) + tuple(reversed(cfg.vae_ratios)):
+        k = 7 if s == 1 else 2 * s
+        length += k - s
+        if s > 1:
+            n = (length - k) // s + 1
+            length = max(length, n * s + k)
+        length = (length - k) // s + 1
+    return length        # head conv is k7 s1 causal: length-preserving
+
+
+def vae_encode_wav(cfg: VibeVoiceConfig, p: dict, audio):
+    """audio: [B, S] f32 24kHz mono -> latents [B, T, acoustic_dim].
+
+    Each downsample conv is causally left-padded by (kernel - stride) and
+    right-aligned to the stride grid exactly like the reference
+    (vae_encoder.rs encode), so frame counts match its output."""
+    x = audio[:, None, :]
+    strides = (1,) + tuple(reversed(cfg.vae_ratios))
+    for i, dp in enumerate(p["down"]):
+        k, s = dp["weight"].shape[2], strides[i]
+        x = _causal_pad(x, k - s)
+        if s > 1:
+            length = x.shape[2]
+            n = (length - k) // s + 1
+            ideal = n * s + k
+            if ideal > length:
+                x = jnp.pad(x, ((0, 0), (0, 0), (0, ideal - length)))
+        x = conv1d(x, dp["weight"], dp["bias"], stride=s)
+        for blk in p["stages"][i]:
+            x = _decoder_block(cfg, blk, x)
+    x = conv1d(_causal_pad(x, 6), p["head"]["weight"], p["head"]["bias"])
+    return x.transpose(0, 2, 1)
 
 
 # -- voice prompt (precomputed KV caches, ref: voice_prompt.rs) --------------
@@ -388,6 +482,7 @@ class VibeVoiceTTS:
                 "connector": init_connector_params(cfg, ks[4], dtype),
                 "eos": init_eos_params(cfg, ks[5], dtype),
                 "vae": init_vae_decoder_params(cfg, ks[6], dtype),
+                "vae_enc": init_vae_encoder_params(cfg, ks[7], dtype),
                 "speech_scaling_factor": jnp.asarray(1.0, jnp.float32),
                 "speech_bias_factor": jnp.asarray(0.0, jnp.float32),
             }
@@ -415,6 +510,7 @@ class VibeVoiceTTS:
         self._decode = jax.jit(lambda p, l: vae_decode_frames(cfg, p, l))
         self._connector = jax.jit(
             lambda p, l: connector_forward(cfg, p, l))
+        self._encode_audio = jax.jit(lambda p, a: vae_encode_wav(cfg, p, a))
 
     # -- internals ----------------------------------------------------------
 
@@ -472,8 +568,13 @@ class VibeVoiceTTS:
                 import logging
                 logging.getLogger("cake_tpu.vibevoice").warning(
                     "voice %r is not a voice-prompt file; ignoring", voice)
+        # raw-wav cloning: encode the reference BEFORE sizing caches (its
+        # frames occupy positions 0..T-1 in all three streams)
+        clone_emb = None
+        if vp is None and voice_wav is not None:
+            clone_emb = self._voice_embeds(voice_wav)
         vseq = max((kv[0].shape[2] for kv in vp["tts_lm"]), default=0) \
-            if vp else 0
+            if vp else (clone_emb.shape[1] if clone_emb is not None else 0)
         # rounded up so jitted LM stages compile per 64-bucket, not per text
         cache_len = -(-max(64, vseq + len(token_ids) + max_frames + 80)
                       // 64) * 64
@@ -488,12 +589,18 @@ class VibeVoiceTTS:
             neg_cache = inject_voice_kv(neg_cache, vp["neg_tts_lm"],
                                         self.dtype)
             neg_cond = jnp.asarray(vp["neg_hidden"][:, -1]).astype(self.dtype)
-        elif voice_wav is not None:
-            # no VAE encoder in the realtime variant: approximate speaker
-            # conditioning by folding prompt samples into latent frames
-            # (documented deviation; precomputed prompts give parity)
-            base_cache, tts_cache = self._approx_voice(voice_wav, base_cache,
-                                                       tts_cache)
+        elif clone_emb is not None:
+            # real voice cloning (ref: vibevoice_1_5b.rs generate): the
+            # speech-type reference embeddings prefill the base and
+            # positive TTS streams only — the CFG negative stays
+            # UNCONDITIONAL (the reference seeds neg_cache with just the
+            # speech-start token), so guidance amplifies the voice
+            # direction instead of subtracting it out
+            emb = clone_emb + self._type_embed(0).astype(self.dtype)
+            _, base_cache = self._base_fwd(self.params["base"], emb,
+                                           base_cache, base_cache["pos"])
+            _, tts_cache = self._tts_fwd(self.params["tts"], emb, tts_cache,
+                                         tts_cache["pos"])
 
         text_type = self._type_embed(1)
         speech_type = self._type_embed(0)
@@ -566,23 +673,52 @@ class VibeVoiceTTS:
         return [(zlib.crc32(f"{text}:{i}".encode()) % (v - 4)) + 2
                 for i in range(min(32, max(4, len(text) // 3)))]
 
-    def _approx_voice(self, voice_wav: bytes, base_cache, tts_cache):
-        from ...utils.wav import decode_wav
+    def encode_voice_reference(self, samples: np.ndarray):
+        """Raw 24kHz mono f32 samples -> (features [1,T,D], connected
+        [1,T,hidden]) — features = (latents + bias) * scale, connected
+        through the acoustic connector (ref: vibevoice_1_5b.rs
+        encode_voice_reference)."""
+        if "vae_enc" not in self.params:
+            raise ValueError(
+                "this checkpoint has no acoustic encoder, so raw-wav voice "
+                "cloning is unavailable — pass a precomputed voice-prompt "
+                "file instead")
         cfg = self.cfg
-        samples, _ = decode_wav(voice_wav)
-        n = max(1, min(8, len(samples) // max(cfg.hop, 1)))
-        need = n * cfg.acoustic_dim
+        samples = np.asarray(samples, np.float32)
+        # pad to an 8-hop grid so the jitted encoder compiles per bucket,
+        # not per reference-clip length; the padded tail's all-silence
+        # frames are sliced off below so conditioning covers exactly the
+        # clip. The reference encodes the exact length: vs that, the final
+        # ~2 kept frames can deviate ~1% (their conv windows reach past the
+        # clip into bucket padding instead of the exact encode's alignment
+        # zeros) — accepted to keep the compile count bounded.
+        n_true = _encoder_frames(cfg, max(len(samples), 1))
+        grid = max(cfg.hop, 1) * 8
+        need = max(-(-len(samples) // grid) * grid, grid)
         if len(samples) < need:
             samples = np.pad(samples, (0, need - len(samples)))
-        frames = jnp.asarray(samples[:need].reshape(1, n, cfg.acoustic_dim),
-                             self.dtype)
-        emb = self._connector(self.params["connector"], frames)
-        emb = emb + self._type_embed(0).astype(self.dtype)
-        _, base_cache = self._base_fwd(self.params["base"], emb, base_cache,
-                                       base_cache["pos"])
-        _, tts_cache = self._tts_fwd(self.params["tts"], emb, tts_cache,
-                                     tts_cache["pos"])
-        return base_cache, tts_cache
+        lat = self._encode_audio(self.params["vae_enc"],
+                                 jnp.asarray(samples[None], self.dtype))
+        lat = lat[:, :n_true]
+        sf = self.params["speech_scaling_factor"].astype(self.dtype)
+        bf = self.params["speech_bias_factor"].astype(self.dtype)
+        features = (lat + bf) * sf
+        connected = self._connector(self.params["connector"], features)
+        return features, connected
+
+    def _voice_embeds(self, voice_wav: bytes):
+        from ...utils.wav import decode_wav
+        cfg = self.cfg
+        samples, sr = decode_wav(voice_wav)
+        if sr != cfg.sample_rate and len(samples) > 1:
+            # linear resample to the model rate — the encoder's hop/ratios
+            # are trained at cfg.sample_rate (24kHz)
+            n_out = int(len(samples) * cfg.sample_rate / sr)
+            samples = np.interp(
+                np.linspace(0, len(samples) - 1, max(n_out, 2)),
+                np.arange(len(samples)), samples).astype(np.float32)
+        _, connected = self.encode_voice_reference(samples)
+        return connected
 
 
 def load_voice_prompt(path: str) -> dict:

@@ -29,12 +29,14 @@ from ...utils.quant import NoQuantization
 from ...utils.safetensors_io import TensorStorage
 from .vibevoice import (VibeVoiceConfig, VibeVoiceTTS, init_connector_params,
                         init_eos_params, init_head_params,
-                        init_vae_decoder_params, vibevoice_config_from_hf)
+                        init_vae_decoder_params, init_vae_encoder_params,
+                        vibevoice_config_from_hf)
 
 log = logging.getLogger("cake_tpu.vibevoice_loader")
 
 HEAD_PREFIX = "model.prediction_head."
 VAE_PREFIX = "model.acoustic_tokenizer.decoder."
+ENC_PREFIX = "model.acoustic_tokenizer.encoder."
 CONNECTOR_PREFIX = "model.acoustic_connector."
 EOS_PREFIX = "tts_eos_classifier."
 
@@ -72,6 +74,37 @@ def vae_decoder_mapping(cfg: VibeVoiceConfig,
         m[f"up.{i + 1}.weight"] = f"{src}.weight"
         m[f"up.{i + 1}.bias"] = f"{src}.bias"
     for i, depth in enumerate(cfg.vae_depths):
+        for j in range(depth):
+            src = f"{prefix}stages.{i}.{j}."
+            dst = f"stages.{i}.{j}."
+            m[f"{dst}norm.weight"] = f"{src}norm.weight"
+            m[f"{dst}gamma"] = f"{src}gamma"
+            m[f"{dst}mixer.weight"] = f"{src}mixer.conv.conv.conv.weight"
+            m[f"{dst}mixer.bias"] = f"{src}mixer.conv.conv.conv.bias"
+            m[f"{dst}ffn_norm.weight"] = f"{src}ffn_norm.weight"
+            m[f"{dst}ffn_gamma"] = f"{src}ffn_gamma"
+            m[f"{dst}ffn1.weight"] = f"{src}ffn.linear1.weight"
+            m[f"{dst}ffn1.bias"] = f"{src}ffn.linear1.bias"
+            m[f"{dst}ffn2.weight"] = f"{src}ffn.linear2.weight"
+            m[f"{dst}ffn2.bias"] = f"{src}ffn.linear2.bias"
+    return m
+
+
+def vae_encoder_mapping(cfg: VibeVoiceConfig,
+                        prefix: str = ENC_PREFIX) -> dict[str, str]:
+    """model.acoustic_tokenizer.encoder.* names (ref: vae_encoder.rs load:
+    downsample_layers.N.0.conv.conv, stages.i.j, head.conv.conv)."""
+    m = {
+        "down.0.weight": f"{prefix}downsample_layers.0.0.conv.conv.weight",
+        "down.0.bias": f"{prefix}downsample_layers.0.0.conv.conv.bias",
+        "head.weight": f"{prefix}head.conv.conv.weight",
+        "head.bias": f"{prefix}head.conv.conv.bias",
+    }
+    for i in range(len(cfg.vae_ratios)):
+        src = f"{prefix}downsample_layers.{i + 1}.0.conv.conv"
+        m[f"down.{i + 1}.weight"] = f"{src}.weight"
+        m[f"down.{i + 1}.bias"] = f"{src}.bias"
+    for i, depth in enumerate(cfg.enc_depths_resolved):
         for j in range(depth):
             src = f"{prefix}stages.{i}.{j}."
             dst = f"stages.{i}.{j}."
@@ -163,6 +196,19 @@ def load_vibevoice(model_dir: str, dtype=jnp.float32,
         st, vm, jax.eval_shape(lambda: init_vae_decoder_params(
             cfg, jax.random.PRNGKey(0), jnp.float32)), jnp.float32)
     coverage_report(st, vm, VAE_PREFIX)
+
+    # acoustic encoder (raw-wav voice cloning) — present in the 1.5B
+    # checkpoints; realtime-only dumps may omit it
+    if ENC_PREFIX + "head.conv.conv.weight" in st:
+        em2 = vae_encoder_mapping(cfg)
+        params["vae_enc"] = load_mapped_params(
+            st, em2, jax.eval_shape(lambda: init_vae_encoder_params(
+                cfg, jax.random.PRNGKey(0), jnp.float32)), jnp.float32)
+        coverage_report(st, em2, ENC_PREFIX)
+    else:
+        log.warning("checkpoint has no acoustic encoder — raw-wav voice "
+                    "cloning unavailable (precomputed voice prompts still "
+                    "work)")
 
     if tokenizer is None:
         tok_json = os.path.join(model_dir, "tokenizer.json")

@@ -15,11 +15,13 @@ from cake_tpu.models.audio import (detect_vibevoice_checkpoint,
 from cake_tpu.models.audio.vibevoice import (init_connector_params,
                                              init_eos_params,
                                              init_head_params,
-                                             init_vae_decoder_params)
+                                             init_vae_decoder_params,
+                                             init_vae_encoder_params)
 from cake_tpu.models.audio.vibevoice_loader import (connector_mapping,
                                                     eos_mapping,
                                                     head_mapping,
-                                                    vae_decoder_mapping)
+                                                    vae_decoder_mapping,
+                                                    vae_encoder_mapping)
 from cake_tpu.utils.mapping import flatten_tree
 from cake_tpu.utils.safetensors_io import save_safetensors
 
@@ -63,7 +65,9 @@ def synth_vibevoice_dir(tmp_path):
              connector_mapping(True)),
             (init_eos_params(cfg, ks[4], jnp.float32), eos_mapping()),
             (init_vae_decoder_params(cfg, ks[5], jnp.float32),
-             vae_decoder_mapping(cfg))):
+             vae_decoder_mapping(cfg)),
+            (init_vae_encoder_params(cfg, ks[7], jnp.float32),
+             vae_encoder_mapping(cfg))):
         flat = flatten_tree(pytree)
         for path, name in mapping.items():
             tensors[name] = np.asarray(flat[path], np.float32)
@@ -138,6 +142,13 @@ EXPECTED_NAMES = [
     ".weight",
     "model.acoustic_tokenizer.decoder.stages.2.0.ffn.linear1.weight",
     "model.acoustic_tokenizer.decoder.head.conv.conv.weight",
+    "model.acoustic_tokenizer.encoder.downsample_layers.0.0.conv.conv"
+    ".weight",
+    "model.acoustic_tokenizer.encoder.downsample_layers.1.0.conv.conv"
+    ".weight",
+    "model.acoustic_tokenizer.encoder.stages.0.0.mixer.conv.conv.conv"
+    ".weight",
+    "model.acoustic_tokenizer.encoder.head.conv.conv.weight",
     "model.speech_scaling_factor",
 ]
 
@@ -191,3 +202,63 @@ def test_runtime_detection(tmp_path):
     from cake_tpu.runtime import build_audio_model
     tts = build_audio_model(str(tmp_path), dtype="f32")
     assert type(tts).__name__ == "VibeVoiceTTS"
+
+
+def test_vae_encoder_frame_count(tmp_path):
+    """Encoder frame count matches the reference's stride-grid arithmetic
+    and the encode->scale->connector chain produces hidden-width embeds."""
+    cfg = synth_vibevoice_dir(tmp_path)
+    tts = load_vibevoice(str(tmp_path), dtype=jnp.float32, max_frames=4)
+    assert "vae_enc" in tts.params
+    samples = np.sin(np.linspace(0, 40, cfg.hop * 8)).astype(np.float32)
+    feats, connected = tts.encode_voice_reference(samples)
+    assert feats.shape[0] == 1 and feats.shape[2] == cfg.acoustic_dim
+    # alignment right-padding can add a frame per strided conv, never drop
+    assert feats.shape[1] >= 8
+    assert connected.shape == (1, feats.shape[1], cfg.hidden)
+    assert np.isfinite(np.asarray(connected)).all()
+    # scaling applied: features = (latents + bias) * scale with the
+    # checkpoint's scalars
+    lat = tts._encode_audio(tts.params["vae_enc"],
+                            jnp.asarray(samples[None]))
+    np.testing.assert_allclose(np.asarray(feats),
+                               np.asarray((lat + 0.1) * 1.5), rtol=1e-5)
+    # a clip shorter than the compile grid: bucket-padding silence frames
+    # are sliced off, and (causal convs) the kept frames equal the frames
+    # of an exact-length encode
+    short = samples[:cfg.hop * 5 + 13]
+    feats_s, _ = tts.encode_voice_reference(short)
+    from cake_tpu.models.audio.vibevoice import _encoder_frames
+    assert feats_s.shape[1] == _encoder_frames(cfg, len(short))
+    lat_exact = tts._encode_audio(tts.params["vae_enc"],
+                                  jnp.asarray(short[None]))
+    assert lat_exact.shape[1] == feats_s.shape[1]
+    # frames whose conv windows stay inside the clip match the exact-length
+    # encode; the last ~2 frames may deviate ~1% (documented bucket-padding
+    # boundary effect)
+    np.testing.assert_allclose(np.asarray(feats_s)[:, :-2],
+                               np.asarray((lat_exact + 0.1) * 1.5)[:, :-2],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(feats_s),
+                               np.asarray((lat_exact + 0.1) * 1.5),
+                               rtol=0.15, atol=0.02)
+
+
+def test_raw_wav_voice_cloning(tmp_path):
+    """generate_speech(voice_wav=...) must condition on the encoded
+    reference: output differs from the no-voice path, and the encoder
+    missing from the checkpoint raises a clear error."""
+    cfg = synth_vibevoice_dir(tmp_path)
+    tts = load_vibevoice(str(tmp_path), dtype=jnp.float32, max_frames=4)
+    from cake_tpu.utils.wav import encode_wav
+    wav = encode_wav(np.sin(np.linspace(0, 60, cfg.hop * 8))
+                     .astype(np.float32), cfg.sample_rate)
+    a = tts.generate_speech("hi", max_frames=2, steps=2)
+    b = tts.generate_speech("hi", voice_wav=wav, max_frames=2, steps=2)
+    assert len(b.samples) > 0
+    assert not np.allclose(a.samples, b.samples)
+    # clear error when the encoder is absent
+    del tts.params["vae_enc"]
+    import pytest
+    with pytest.raises(ValueError, match="acoustic encoder"):
+        tts.generate_speech("hi", voice_wav=wav, max_frames=2, steps=2)

@@ -32,6 +32,9 @@ def _add_common_model_args(p: argparse.ArgumentParser):
     p.add_argument("--fp8-native", action="store_true",
                    help="keep FP8 weights 1 byte/param in HBM, dequant "
                         "per layer (FP8 checkpoints only)")
+    p.add_argument("--tp", default=None,
+                   help="in-host tensor parallelism: 'auto' shards over all "
+                        "local devices, N over the first N (default: 1 chip)")
 
 
 def _add_sampling_args(p: argparse.ArgumentParser):
@@ -56,7 +59,8 @@ def _build(args):
         max_cache_len=args.max_cache_len, seed=args.seed,
         cluster_key=args.cluster_key, topology_path=args.topology,
         download=not args.no_download,
-        fp8_native=getattr(args, "fp8_native", False))
+        fp8_native=getattr(args, "fp8_native", False),
+        tp=getattr(args, "tp", None))
 
 
 def cmd_run(args) -> int:
@@ -117,7 +121,7 @@ def cmd_worker(args) -> int:
               file=sys.stderr)
         return 2
     run_worker(args.name, args.cluster_key, port=args.port,
-               model_dir=args.model_dir)
+               model_dir=args.model_dir, tp=args.tp)
     return 0
 
 
@@ -226,6 +230,9 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=10128)
     p.add_argument("--model-dir", default=None,
                    help="pre-provisioned weights (from `cake-tpu split`)")
+    p.add_argument("--tp", default=None,
+                   help="in-host tensor parallelism over this worker's "
+                        "local devices ('auto' = all)")
     p.set_defaults(fn=cmd_worker)
 
     p = sub.add_parser("pull", help="download a model")

@@ -55,13 +55,17 @@ class DistributedTextModel:
 
     def __init__(self, cfg: ModelConfig, master_params: dict,
                  stages: list[Stage], tokenizer=None, dtype=jnp.bfloat16,
-                 max_cache_len: int = 2048, seed: int = 42):
+                 max_cache_len: int = 2048, seed: int = 42, mesh=None):
         self.cfg = cfg
-        self.params = master_params       # embed + head (+ local stage params)
         self.stages = stages
         self.tokenizer = tokenizer
         self.dtype = dtype
         self.max_cache_len = max_cache_len
+        self.mesh = mesh
+        # embed + head replicate over the in-host tp mesh so the hidden
+        # state entering/leaving the sharded local stages is replicated
+        from ..parallel.sharding import shard_params
+        self.params = shard_params(master_params, mesh)  # embed + head
         self._rng = jax.random.PRNGKey(seed)
 
         @jax.jit
@@ -81,10 +85,12 @@ class DistributedTextModel:
     # -- lifecycle ----------------------------------------------------------
 
     def reset(self):
+        from ..parallel.sharding import shard_cache
         for s in self.stages:
             if s.kind == "local":
-                s.cache = init_cache(self.cfg, 1, self.max_cache_len,
-                                     self.dtype, (s.start, s.end))
+                s.cache = shard_cache(
+                    init_cache(self.cfg, 1, self.max_cache_len,
+                               self.dtype, (s.start, s.end)), self.mesh)
             else:
                 s.runner.goodbye()
 
@@ -222,7 +228,7 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
                  dtype_str: str = "bf16", max_cache_len: int = 2048,
                  push_weights: bool = True,
                  master_device_fraction_reserved: float = 0.1,
-                 fp8_native: bool = False) -> MasterSetup:
+                 fp8_native: bool = False, mesh=None) -> MasterSetup:
     """Connect/auth/assign/push to each worker; build the stage chain.
 
     workers: discovery replies ({"name", "host", "port", "caps"}).
@@ -312,8 +318,10 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
             p = load_model_params(cfg, model_dir, dtype, quant=quant,
                                   layer_range=(lo, hi),
                                   include_embed=False, include_head=False)
-            runner = LocalStage(cfg, p, lo, hi)
-            cache = init_cache(cfg, 1, max_cache_len, dtype, (lo, hi))
+            from ..parallel.sharding import shard_cache
+            runner = LocalStage(cfg, p, lo, hi, mesh=mesh)
+            cache = shard_cache(init_cache(cfg, 1, max_cache_len, dtype,
+                                           (lo, hi)), mesh)
             stages.append(Stage("local", lo, hi, runner, cache))
         else:
             stages.append(Stage("remote", lo, hi, runner))

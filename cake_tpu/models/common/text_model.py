@@ -86,10 +86,21 @@ class Token:
 
 
 class LocalStage:
-    """A contiguous range of layers resident on this host's TPU."""
+    """A contiguous range of layers resident on this host's TPU(s).
 
-    def __init__(self, cfg: ModelConfig, params: dict, lo: int, hi: int):
-        self.cfg, self.params, self.lo, self.hi = cfg, params, lo, hi
+    With a mesh, params are tp-sharded in place (GSPMD inserts the
+    collectives inside the one compiled range) — the product-path
+    replacement for the reference's intra-worker multi-GPU layer split
+    (ref: worker.rs:126-229)."""
+
+    def __init__(self, cfg: ModelConfig, params: dict, lo: int, hi: int,
+                 mesh=None):
+        from ...parallel.sharding import check_tp_divisibility, shard_params
+        if mesh is not None:
+            check_tp_divisibility(cfg, mesh)
+        self.cfg, self.lo, self.hi = cfg, lo, hi
+        self.params = shard_params(params, mesh)
+        self.mesh = mesh
 
         @functools.partial(jax.jit,
                            static_argnames=("padded", "flash_mode"),
@@ -120,14 +131,21 @@ class TextModel:
 
     def __init__(self, cfg: ModelConfig, params: dict | None = None,
                  tokenizer=None, dtype=jnp.bfloat16, seed: int = 42,
-                 max_cache_len: int | None = None):
+                 max_cache_len: int | None = None, mesh=None):
         self.cfg = cfg
         self.dtype = dtype
         self.tokenizer = tokenizer
+        self.mesh = mesh
         self.max_cache_len = min(max_cache_len or cfg.max_seq_len, cfg.max_seq_len)
         if params is None:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype)
-        self.params = params
+        # in-host tensor parallelism on the product path: shard the weights
+        # once, let GSPMD insert the psum after the row x col matmul pairs
+        # in every compiled program below (no-op without a mesh)
+        from ...parallel.sharding import check_tp_divisibility, shard_params
+        if mesh is not None:
+            check_tp_divisibility(cfg, mesh)
+        self.params = shard_params(params, mesh)
         self._rng = jax.random.PRNGKey(seed)
         self._build()
 
@@ -242,8 +260,17 @@ class TextModel:
     def new_cache(self, batch: int = 1, kv_len: int | None = None):
         """kv_len bounds the KV buffers (cache-length bucket); defaults to
         the full max_cache_len (distributed master / parity-test paths)."""
-        return init_cache(self.cfg, batch, kv_len or self.max_cache_len,
-                          self.dtype)
+        from ...parallel.sharding import shard_cache
+        return shard_cache(init_cache(self.cfg, batch,
+                                      kv_len or self.max_cache_len,
+                                      self.dtype), self.mesh)
+
+    def _grow_to(self, cache, new_len: int):
+        """Grow the KV bucket; re-pin shardings on the grown buffers (the
+        jitted grow propagates input shardings, but pinning keeps the KV
+        head axis split explicit rather than propagation-dependent)."""
+        from ...parallel.sharding import shard_cache
+        return shard_cache(self._grow(cache, new_len=new_len), self.mesh)
 
     # -- inference ----------------------------------------------------------
 
@@ -321,7 +348,7 @@ class TextModel:
                 room = kv_len - pos - 1    # writes positions pos .. pos+n
                 if room <= 0:
                     kv_len = bucket_for(pos + 2, self.max_cache_len)
-                    cache = self._grow(cache, new_len=kv_len)
+                    cache = self._grow_to(cache, new_len=kv_len)
                     room = kv_len - pos - 1
                 n_seg = min(n_total - emitted, room)
                 packed, cache, rng, recent = self._decode_until(
@@ -357,7 +384,7 @@ class TextModel:
                 while len(inflight) < self.STREAM_DEPTH and disp < max_chunks:
                     if pos + chunk > kv_len:
                         kv_len = bucket_for(pos + chunk, self.max_cache_len)
-                        cache = self._grow(cache, new_len=kv_len)
+                        cache = self._grow_to(cache, new_len=kv_len)
                     toks, cache, rng, recent = self._decode_chunk(
                         self.params, tok_arr, cache, rng, recent, scfg, chunk)
                     tok_arr = toks[-1:]     # device-side chain, no fetch
@@ -381,7 +408,7 @@ class TextModel:
                 # cache-end tail smaller than a chunk: one while_loop call
                 if pos + remainder > kv_len:
                     kv_len = bucket_for(pos + remainder, self.max_cache_len)
-                    cache = self._grow(cache, new_len=kv_len)
+                    cache = self._grow_to(cache, new_len=kv_len)
                 packed, cache, rng, recent = self._decode_until(
                     self.params, tok_arr, cache, rng, recent,
                     jnp.asarray(remainder, jnp.int32), scfg,

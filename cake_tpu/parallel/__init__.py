@@ -3,7 +3,8 @@ ring-attention sequence parallelism (sp), and a sharded train step.
 
 Host-level pipeline parallelism (layer-range sharding over the LAN) lives
 in cluster/ — the same split the reference makes (SURVEY §2g)."""
-from .mesh import axis_size, make_mesh, named, single_device_mesh
+from .mesh import (axis_size, make_mesh, named, serving_mesh,
+                   single_device_mesh)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .sharding import (cache_shardings, check_tp_divisibility,
                        params_shardings, shard_cache, shard_params)

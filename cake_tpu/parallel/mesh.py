@@ -35,6 +35,32 @@ def single_device_mesh() -> Mesh:
     return Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("tp",))
 
 
+def serving_mesh(tp: int | str | None) -> Mesh | None:
+    """Mesh for the serve/run/worker product path (the in-host tensor
+    parallelism the reference approximates with its multi-GPU layer split,
+    ref: worker.rs:126-229).
+
+    tp: None/0/1 -> None (single device, no mesh);
+        "auto"   -> all local devices;
+        int N    -> first N local devices (error if fewer exist).
+    """
+    devices = jax.devices()
+    if tp in (None, 0, 1, "1"):
+        return None
+    if tp == "auto":
+        n = len(devices)
+        if n == 1:
+            return None
+    else:
+        n = int(tp)
+        if n > len(devices):
+            raise ValueError(
+                f"--tp {n}: only {len(devices)} local device(s) available")
+        if n <= 1:
+            return None
+    return make_mesh({"tp": n}, devices=devices[:n])
+
+
 def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
 

@@ -85,26 +85,43 @@ def params_shardings(params, mesh: Mesh):
 def cache_shardings(cache, mesh: Mesh):
     dp, tp = _ax(mesh, "dp"), _ax(mesh, "tp")
 
+    def _fit(leaf, spec: P) -> P:
+        """Drop axes the leaf's dims can't be divided by (batch=1 under dp,
+        GDN conv channels not a tp multiple): replicate rather than fail —
+        these states are small relative to the weights."""
+        parts = []
+        for dim, ax in enumerate(spec):
+            if ax is not None and leaf.shape[dim] % mesh.shape[ax]:
+                ax = None
+            parts.append(ax)
+        return P(*parts)
+
     def f(path, leaf):
         name = str(getattr(path[-1], "key", getattr(path[-1], "idx", "")))
         ndim = getattr(leaf, "ndim", 0)
+        spec = P()
         if ndim == 4 and name in ("k", "v"):
-            return NamedSharding(mesh, P(dp, None, tp, None))
-        if ndim == 4 and name == "state":       # GDN [B, Hv, Dk, Dv]
-            return NamedSharding(mesh, P(dp, tp, None, None))
-        if ndim == 3 and name == "conv":        # GDN conv state [B, C, K-1]
-            return NamedSharding(mesh, P(dp, tp, None))
-        if ndim == 2 and name == "pos":
-            return NamedSharding(mesh, P(dp, None))
-        return NamedSharding(mesh, P())
+            spec = P(dp, None, tp, None)
+        elif ndim == 4 and name == "state":     # GDN [B, Hv, Dk, Dv]
+            spec = P(dp, tp, None, None)
+        elif ndim == 3 and name == "conv":      # GDN conv state [B, C, K-1]
+            spec = P(dp, tp, None)
+        elif ndim == 2 and name == "pos":
+            spec = P(dp, None)
+        return NamedSharding(mesh, _fit(leaf, spec))
     return jax.tree_util.tree_map_with_path(f, cache)
 
 
-def shard_params(params, mesh: Mesh):
+def shard_params(params, mesh: Mesh | None):
+    """No-op without a mesh so product call sites need no guard."""
+    if mesh is None:
+        return params
     return jax.device_put(params, params_shardings(params, mesh))
 
 
-def shard_cache(cache, mesh: Mesh):
+def shard_cache(cache, mesh: Mesh | None):
+    if mesh is None:
+        return cache
     return jax.device_put(cache, cache_shardings(cache, mesh))
 
 

@@ -147,16 +147,31 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                      cluster_key: str | None = None,
                      topology_path: str | None = None,
                      discovery_timeout: float = 3.0,
-                     download: bool = True, fp8_native: bool = False):
+                     download: bool = True, fp8_native: bool = False,
+                     tp: int | str | None = None):
     """Returns (generator, tokenizer, model_id, topology|None).
 
     With a cluster key: discover workers (or use the topology file), run
     master_setup, return a DistributedTextModel. Otherwise a fully-local
     TextModel (ref: cake-cli run_as_master / all-local fallback
     sharding/mod.rs:209-212).
+
+    tp: in-host tensor parallelism — "auto" uses every local device, an int
+    uses that many; weights/KV shard over a {"tp": N} mesh and GSPMD inserts
+    the collectives inside the same compiled programs the single-chip path
+    runs (the product wiring for parallel/sharding.py; the reference's
+    analog is the intra-worker multi-GPU layer split, worker.rs:126-229).
+    Applies to the local model and to the master's local stages alike.
     """
+    from .parallel import serving_mesh
+    mesh = serving_mesh(tp)
     model_dir = resolve_model(model, download=download)
     cfg, quant, raw = load_config_and_quant(model_dir, arch)
+    if mesh is not None:
+        # fail on tp/head indivisibility now, from the config alone —
+        # before any multi-GB weight load or worker weight streaming
+        from .parallel import check_tp_divisibility
+        check_tp_divisibility(cfg, mesh)
     if fp8_native:
         from .utils.quant import Fp8Quantization, fp8_native_quant
         if not isinstance(quant, Fp8Quantization):
@@ -194,10 +209,11 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
         setup = master_setup(model_dir, cluster_key, cfg, workers,
                              assignments=assignments, dtype_str=dtype,
                              max_cache_len=max_cache_len,
-                             fp8_native=fp8_native)
+                             fp8_native=fp8_native, mesh=mesh)
         gen = DistributedTextModel(cfg, setup.master_params, setup.stages,
                                    tokenizer=tokenizer, dtype=dt,
-                                   max_cache_len=max_cache_len, seed=seed)
+                                   max_cache_len=max_cache_len, seed=seed,
+                                   mesh=mesh)
         return gen, tokenizer, model_id, setup.topology
 
     # fully local
@@ -213,5 +229,5 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
         from .utils.loaders import load_model_params
         params = load_model_params(cfg, model_dir, dt, quant=quant)
     gen = TextModel(cfg, params, tokenizer=tokenizer, dtype=dt, seed=seed,
-                    max_cache_len=max_cache_len)
+                    max_cache_len=max_cache_len, mesh=mesh)
     return gen, tokenizer, model_id, None

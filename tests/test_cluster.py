@@ -272,7 +272,7 @@ def cluster_model_dir(tmp_path):
     return cfg, params, str(mdir), str(tmp_path / "wcache")
 
 
-def _start_worker_thread(name, key, cache_root, ready):
+def _start_worker_thread(name, key, cache_root, ready, tp=None):
     """Run a WorkerServer on its own event loop thread; returns (thread,
     port holder, stop fn)."""
     from cake_tpu.cluster.worker import WorkerServer
@@ -281,7 +281,7 @@ def _start_worker_thread(name, key, cache_root, ready):
     def run():
         async def main():
             server = WorkerServer(name, key, port=0, cache_root=cache_root,
-                                  advertise=False)
+                                  advertise=False, tp=tp)
             await server.start()
             holder["port"] = server.port
             holder["server"] = server

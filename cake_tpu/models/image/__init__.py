@@ -1,5 +1,11 @@
 from .flux import (COMPONENT_NAMES, DummyTextEncoder, FluxImageModel,
                    FluxPipelineConfig, tiny_flux_config)
+from .flux2 import (Flux2Config, Flux2ImageModel, Flux2PipelineConfig,
+                    Flux2TextEncoder, flux2_forward, flux2_schedule,
+                    init_flux2_params, tiny_flux2_config)
+from .flux2_loader import (detect_flux2_checkpoint, flux2_transformer_mapping,
+                           flux2_vae_mapping, infer_flux2_configs,
+                           load_flux2_image_model)
 from .mmdit import MMDiTConfig, init_mmdit_params, mmdit_forward
 from .vae import (VaeConfig, init_vae_decoder_params, latents_to_patches,
                   patches_to_latents, vae_decode)

@@ -1,6 +1,7 @@
-"""FLUX image generation pipeline: text encode -> flow-matching denoise ->
-VAE decode (ref: models/flux/{flux1.rs,flux1_model.rs,flux2_model.rs};
-call stack SURVEY §3.4).
+"""FLUX.1 image generation pipeline: text encode -> flow-matching denoise ->
+VAE decode (ref: models/flux/{flux1.rs,flux1_model.rs};
+call stack SURVEY §3.4). FLUX.2-klein lives in flux2.py (shared-modulation
+transformer, Qwen3 encoder, 32-ch VAE).
 
 Component sharding names mirror the reference's FluxShardable routing
 ("flux_text_encoder" | "flux_transformer" | "flux_vae" —
@@ -8,9 +9,8 @@ ref: flux/flux_shardable.rs:29-35): each component can be resident or a
 RemoteStage-like forwarder, so image models shard at component granularity
 over the cluster rather than per layer.
 
-FLUX.2-klein uses a Qwen3 text encoder (our TextModel machinery re-used as
-an encoder via forward_train hidden states); FLUX.1-dev uses CLIP-L pooled +
-T5-XXL sequence embeddings — both are pluggable TextEncoder callables here.
+FLUX.1-dev uses CLIP-L pooled + T5-XXL sequence embeddings — text encoders
+are pluggable callables here.
 """
 from __future__ import annotations
 
@@ -40,7 +40,6 @@ class FluxPipelineConfig:
     vae: VaeConfig = VaeConfig()
     guidance_default: float = 3.5
     shift_mu: float = 1.15           # resolution timestep shift
-    variant: str = "flux1-dev"       # "flux1-dev" | "flux2-klein"
 
 
 def tiny_flux_config() -> FluxPipelineConfig:

@@ -1,0 +1,463 @@
+"""FLUX.2-klein: flow-matching MMDiT with shared modulation and a Qwen3
+text encoder (ref: models/flux/flux2_model.rs:1-627 transformer,
+flux2_vae.rs:1-303 32-ch VAE, text_encoder.rs:1-394 Qwen3-as-encoder,
+flux.rs:95-322 pipeline).
+
+Differences from FLUX.1 (mmdit.py) that make this its own forward:
+  * modulation is computed ONCE at model level from the timestep embedding
+    and shared by every block (double_stream_modulation_img/txt [6h],
+    single_stream_modulation [3h]) — FLUX.1 has per-block mod projections;
+  * conditioning is timestep-only (no CLIP pooled vector, no guidance
+    embedding — klein is guidance-distilled);
+  * double blocks use separate per-stream q/k/v/o projections (diffusers
+    naming) and SiLU-gated MLPs (fused gate||up linear_in -> silu*up ->
+    linear_out) — FLUX.1 fuses qkv and uses GELU;
+  * single blocks fuse qkv||mlp-gate||mlp-up into one to_qkv_mlp_proj and
+    project [attn ; silu*up] with one to_out;
+  * no biases anywhere in the transformer;
+  * 4-axis RoPE (T, H, W, L), theta 2000: images index (0, y, x, 0) and
+    text tokens (0, 0, 0, seq_pos);
+  * the text context is the concatenation of THREE Qwen3 hidden states
+    (layers 8/17/26 zero-indexed for klein-4B: 3 x 2560 = 7680).
+
+TPU-first: one jitted velocity program per latent shape; the Qwen3 encoder
+reuses the exact config-driven decoder blocks from models/common/layers.py
+in stateless mode (cache=None, valid_len padding mask) and only runs layers
+0..27 — the reference computes all 36 then discards the top 9
+(text_encoder.rs:384-389); skipping them is output-identical.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import adaln_modulate, linear, rms_norm, silu_mul
+from ..common.config import ModelConfig
+from ..common.layers import embed_tokens, forward_layers
+from .mmdit import (_joint_attention, _ln, rope_2d, timestep_embedding)
+from .vae import (VaeConfig, init_vae_decoder_params, patches_to_latents,
+                  vae_decode)
+
+log = logging.getLogger("cake_tpu.flux2")
+
+
+@dataclasses.dataclass(frozen=True)
+class Flux2Config:
+    """Transformer dims (ref: flux2_model.rs Flux2Config::klein_4b)."""
+    in_channels: int = 128           # packed latents: 32ch VAE x 2x2 patch
+    hidden_size: int = 3072
+    num_heads: int = 24
+    head_dim: int = 128
+    mlp_ratio: float = 3.0
+    depth_double: int = 5
+    depth_single: int = 20
+    context_in_dim: int = 7680       # 3 concatenated Qwen3 hidden states
+    axes_dims: tuple[int, ...] = (32, 32, 32, 32)   # (T, H, W, L)
+    theta: float = 2000.0
+
+    @property
+    def mlp_hidden(self) -> int:
+        return int(self.hidden_size * self.mlp_ratio)
+
+
+@dataclasses.dataclass(frozen=True)
+class Flux2PipelineConfig:
+    transformer: Flux2Config = Flux2Config()
+    vae: VaeConfig = VaeConfig(latent_channels=32, base_channels=128,
+                               channel_mults=(1, 2, 4, 4), num_res_blocks=3,
+                               scaling_factor=1.0, shift_factor=0.0)
+    max_txt_len: int = 512           # klein pads prompts to exactly 512
+    steps_default: int = 20
+
+
+def tiny_flux2_config() -> Flux2PipelineConfig:
+    return Flux2PipelineConfig(
+        transformer=Flux2Config(in_channels=16, hidden_size=64, num_heads=4,
+                                head_dim=16, depth_double=2, depth_single=2,
+                                context_in_dim=96, axes_dims=(4, 4, 4, 4)),
+        vae=VaeConfig(latent_channels=4, base_channels=32,
+                      channel_mults=(1, 2), num_res_blocks=1,
+                      scaling_factor=1.0, shift_factor=0.0),
+        max_txt_len=16)
+
+
+# ---------------------------------------------------------------------------
+# Transformer params + forward
+# ---------------------------------------------------------------------------
+
+
+def _w(key, dout, din, dtype):
+    return {"weight": jax.random.normal(key, (dout, din), dtype) * 0.02}
+
+
+def init_flux2_params(cfg: Flux2Config, key, dtype=jnp.bfloat16) -> dict:
+    h, m, hd = cfg.hidden_size, cfg.mlp_hidden, cfg.head_dim
+    keys = iter(jax.random.split(key, 16 + 14 * (cfg.depth_double
+                                                 + cfg.depth_single)))
+
+    def qknorm():
+        return {"weight": jnp.ones((hd,), dtype)}
+
+    def attn_stream(pfx=""):
+        return {
+            "q": _w(next(keys), h, h, dtype), "k": _w(next(keys), h, h, dtype),
+            "v": _w(next(keys), h, h, dtype), "o": _w(next(keys), h, h, dtype),
+            "q_norm": qknorm(), "k_norm": qknorm(),
+        }
+
+    def gated_mlp():
+        return {"linear_in": _w(next(keys), 2 * m, h, dtype),
+                "linear_out": _w(next(keys), h, m, dtype)}
+
+    p: dict = {
+        "x_embedder": _w(next(keys), h, cfg.in_channels, dtype),
+        "context_embedder": _w(next(keys), h, cfg.context_in_dim, dtype),
+        "time_mlp": {"in": _w(next(keys), h, 256, dtype),
+                     "out": _w(next(keys), h, h, dtype)},
+        "double_mod_img": _w(next(keys), 6 * h, h, dtype),
+        "double_mod_txt": _w(next(keys), 6 * h, h, dtype),
+        "single_mod": _w(next(keys), 3 * h, h, dtype),
+        "norm_out": _w(next(keys), 2 * h, h, dtype),
+        "proj_out": _w(next(keys), cfg.in_channels, h, dtype),
+        "double": [{"img_attn": attn_stream(), "txt_attn": attn_stream(),
+                    "ff": gated_mlp(), "ff_context": gated_mlp()}
+                   for _ in range(cfg.depth_double)],
+        "single": [{"to_qkv_mlp": _w(next(keys), 3 * h + 2 * m, h, dtype),
+                    "to_out": _w(next(keys), h, h + m, dtype),
+                    "q_norm": qknorm(), "k_norm": qknorm()}
+                   for _ in range(cfg.depth_single)],
+    }
+    return p
+
+
+def _heads(cfg, x):
+    b, s, _ = x.shape
+    return x.reshape(b, s, cfg.num_heads, cfg.head_dim)
+
+
+def _stream_qkv(cfg, p, x):
+    """Separate q/k/v projections + per-head RMS QK-norm (eps 1e-6,
+    ref: flux2_model.rs QkNorm + reshape_norm)."""
+    q = rms_norm(_heads(cfg, linear(x, p["q"]["weight"])),
+                 p["q_norm"]["weight"], 1e-6)
+    k = rms_norm(_heads(cfg, linear(x, p["k"]["weight"])),
+                 p["k_norm"]["weight"], 1e-6)
+    v = _heads(cfg, linear(x, p["v"]["weight"]))
+    return q, k, v
+
+
+def _gated_mlp(p, x):
+    fused = linear(x, p["linear_in"]["weight"])
+    gate, up = jnp.split(fused, 2, axis=-1)
+    return linear(silu_mul(gate, up), p["linear_out"]["weight"])
+
+
+def flux2_double_block(cfg, p, img, txt, img_mod, txt_mod, cos, sin):
+    """img_mod/txt_mod: [B, 1, 6, h] shared across blocks
+    (ref: flux2_model.rs DoubleStreamBlock::forward)."""
+    img_h = adaln_modulate(_ln(img), img_mod[:, :, 0], img_mod[:, :, 1])
+    txt_h = adaln_modulate(_ln(txt), txt_mod[:, :, 0], txt_mod[:, :, 1])
+    qi, ki, vi = _stream_qkv(cfg, p["img_attn"], img_h)
+    qt, kt, vt = _stream_qkv(cfg, p["txt_attn"], txt_h)
+    st = txt.shape[1]
+    q = jnp.concatenate([qt, qi], axis=1)
+    k = jnp.concatenate([kt, ki], axis=1)
+    v = jnp.concatenate([vt, vi], axis=1)
+    attn = _joint_attention(cfg, q, k, v, cos, sin)
+    attn = attn.reshape(attn.shape[0], attn.shape[1], -1)
+    attn_t, attn_i = attn[:, :st], attn[:, st:]
+
+    img = img + img_mod[:, :, 2] * linear(attn_i, p["img_attn"]["o"]["weight"])
+    txt = txt + txt_mod[:, :, 2] * linear(attn_t, p["txt_attn"]["o"]["weight"])
+
+    img_h = adaln_modulate(_ln(img), img_mod[:, :, 3], img_mod[:, :, 4])
+    img = img + img_mod[:, :, 5] * _gated_mlp(p["ff"], img_h)
+    txt_h = adaln_modulate(_ln(txt), txt_mod[:, :, 3], txt_mod[:, :, 4])
+    txt = txt + txt_mod[:, :, 5] * _gated_mlp(p["ff_context"], txt_h)
+    return img, txt
+
+
+def flux2_single_block(cfg, p, x, mod, cos, sin):
+    """mod: [B, 1, 3, h] shared (ref: flux2_model.rs SingleStreamBlock)."""
+    b, s, h = x.shape
+    m = cfg.mlp_hidden
+    xh = adaln_modulate(_ln(x), mod[:, :, 0], mod[:, :, 1])
+    fused = linear(xh, p["to_qkv_mlp"]["weight"])
+    q = rms_norm(_heads(cfg, fused[..., :h]), p["q_norm"]["weight"], 1e-6)
+    k = rms_norm(_heads(cfg, fused[..., h:2 * h]), p["k_norm"]["weight"], 1e-6)
+    v = _heads(cfg, fused[..., 2 * h:3 * h])
+    gate, up = fused[..., 3 * h:3 * h + m], fused[..., 3 * h + m:]
+    attn = _joint_attention(cfg, q, k, v, cos, sin).reshape(b, s, -1)
+    merged = jnp.concatenate([attn, silu_mul(gate, up)], axis=-1)
+    return x + mod[:, :, 2] * linear(merged, p["to_out"]["weight"])
+
+
+def flux2_forward(cfg: Flux2Config, params: dict, img, img_ids, txt, txt_ids,
+                  t):
+    """img: [B, S_img, in_ch] packed latents; txt: [B, S_txt, context_dim];
+    ids: [B, S, 4]; t: [B] in [0, 1]. Returns velocity [B, S_img, in_ch]
+    (ref: flux2_model.rs Flux2Transformer::forward)."""
+    b = img.shape[0]
+    h = cfg.hidden_size
+
+    img_h = linear(img, params["x_embedder"]["weight"])
+    txt_h = linear(txt.astype(img.dtype),
+                   params["context_embedder"]["weight"])
+
+    emb = timestep_embedding(t, 256).astype(img.dtype)
+    vec = linear(jax.nn.silu(linear(emb, params["time_mlp"]["in"]["weight"])),
+                 params["time_mlp"]["out"]["weight"])
+
+    ids = jnp.concatenate([txt_ids, img_ids], axis=1)
+    cos, sin = rope_2d(ids, cfg.axes_dims, cfg.theta)
+
+    vec_silu = jax.nn.silu(vec)
+    img_mod = linear(vec_silu,
+                     params["double_mod_img"]["weight"]).reshape(b, 1, 6, h)
+    txt_mod = linear(vec_silu,
+                     params["double_mod_txt"]["weight"]).reshape(b, 1, 6, h)
+    single_mod = linear(vec_silu,
+                        params["single_mod"]["weight"]).reshape(b, 1, 3, h)
+
+    for blk in params["double"]:
+        img_h, txt_h = flux2_double_block(cfg, blk, img_h, txt_h, img_mod,
+                                          txt_mod, cos, sin)
+    x = jnp.concatenate([txt_h, img_h], axis=1)
+    for blk in params["single"]:
+        x = flux2_single_block(cfg, blk, x, single_mod, cos, sin)
+    x = x[:, txt.shape[1]:]
+
+    final = linear(vec_silu, params["norm_out"]["weight"])
+    shift, scale = jnp.split(final[:, None, :], 2, axis=-1)
+    x = adaln_modulate(_ln(x), shift, scale)
+    return linear(x, params["proj_out"]["weight"])
+
+
+# ---------------------------------------------------------------------------
+# Position ids + schedule
+# ---------------------------------------------------------------------------
+
+
+def make_img_ids4(h_half: int, w_half: int, batch: int = 1):
+    """4-axis image ids [T=0, H=y, W=x, L=0] (ref: flux.rs:183-197)."""
+    ys, xs = np.meshgrid(np.arange(h_half), np.arange(w_half), indexing="ij")
+    ids = np.stack([np.zeros_like(ys), ys, xs, np.zeros_like(ys)],
+                   axis=-1).reshape(-1, 4)
+    return jnp.asarray(np.broadcast_to(ids[None], (batch, ids.shape[0], 4)))
+
+
+def make_txt_ids4(seq_len: int, batch: int = 1):
+    """Text ids [0, 0, 0, seq_pos] (ref: flux.rs:199-208)."""
+    ids = np.zeros((seq_len, 4), np.int32)
+    ids[:, 3] = np.arange(seq_len)
+    return jnp.asarray(np.broadcast_to(ids[None], (batch, seq_len, 4)))
+
+
+def empirical_mu(image_seq_len: int, num_steps: int) -> float:
+    """diffusers compute_empirical_mu for FLUX.2 dynamic shifting
+    (ref: flux.rs:216-230)."""
+    seq = float(image_seq_len)
+    a1, b1 = 8.73809524e-05, 1.89833333
+    a2, b2 = 0.00016927, 0.45666666
+    if seq > 4300.0:
+        return a2 * seq + b2
+    m_200 = a2 * seq + b2
+    m_10 = a1 * seq + b1
+    a = (m_200 - m_10) / 190.0
+    b = m_200 - 200.0 * a
+    return a * num_steps + b
+
+
+def flux2_schedule(num_steps: int, mu: float) -> np.ndarray:
+    """FlowMatchEulerDiscreteScheduler timesteps: linspace(1, 0, N) through
+    the exponential time shift, with terminal 0 appended — N+1 values
+    (ref: flux.rs:231-257)."""
+    base = np.linspace(1.0, 0.0, num_steps)
+    e = math.exp(mu)
+    shifted = np.where(base <= 1e-10, base, e / (e + (1.0 / np.maximum(
+        base, 1e-12) - 1.0)))
+    return np.concatenate([shifted, [0.0]])
+
+
+# ---------------------------------------------------------------------------
+# Qwen3 text encoder
+# ---------------------------------------------------------------------------
+
+
+def default_output_layers(num_layers: int) -> tuple[int, int, int]:
+    """klein-4B captures blocks 8/17/26 of 36 — quarters minus one
+    (ref: text_encoder.rs:379 OUTPUT_LAYERS)."""
+    q = num_layers // 4
+    return (q - 1, 2 * q - 1, 3 * q - 1)
+
+
+class Flux2TextEncoder:
+    """prompt -> [1, max_len, 3*hidden] concatenated Qwen3 hidden states.
+
+    The prompt goes through the Qwen-ChatML template the reference
+    hardcodes (flux.rs:98-101), is padded to max_len with <|endoftext|>,
+    and runs through the standard config-driven decoder blocks in
+    stateless mode — causal attention with the pads masked out via
+    valid_len (layers.py kv_pos=-1 path, matching text_encoder.rs's
+    causal+padding mask). Only layers up to the last capture run.
+    """
+
+    CHAT_TEMPLATE = ("<|im_start|>user\n{}<|im_end|>\n"
+                     "<|im_start|>assistant\n<think>\n\n</think>\n\n")
+
+    def __init__(self, cfg: ModelConfig, params: dict, tokenizer=None,
+                 max_len: int = 512,
+                 output_layers: tuple[int, ...] | None = None,
+                 pad_id: int = 151643, dtype=jnp.bfloat16):
+        self.cfg, self.params, self.tokenizer = cfg, params, tokenizer
+        self.max_len, self.pad_id, self.dtype = max_len, pad_id, dtype
+        self.output_layers = tuple(output_layers or default_output_layers(
+            cfg.num_hidden_layers))
+        hi = max(self.output_layers) + 1
+        if len(params["layers"]) < hi:
+            raise ValueError(
+                f"encoder has {len(params['layers'])} layers loaded but "
+                f"capture layers {self.output_layers} need {hi}")
+        outs = self.output_layers
+
+        @jax.jit
+        def _encode(params, ids, valid_len):
+            x = embed_tokens(cfg, params, ids)
+            captured = []
+            lo = 0
+            for out_layer in outs:
+                x, _ = forward_layers(cfg, params, x, None,
+                                      jnp.asarray(0, jnp.int32),
+                                      layer_range=(lo, out_layer + 1),
+                                      valid_len=valid_len)
+                captured.append(x)
+                lo = out_layer + 1
+            return jnp.concatenate(captured, axis=-1)
+
+        self._encode = _encode
+
+    def token_ids(self, prompt: str) -> tuple[np.ndarray, int]:
+        text = self.CHAT_TEMPLATE.format(prompt)
+        ids = self.tokenizer.encode(text, add_special_tokens=False)
+        ids = ids.ids if hasattr(ids, "ids") else list(ids)
+        real = min(len(ids), self.max_len)
+        ids = ids[:self.max_len] + [self.pad_id] * (self.max_len - len(ids))
+        return np.asarray([ids], np.int32), real
+
+    def __call__(self, prompt: str):
+        ids, real = self.token_ids(prompt)
+        txt = self._encode(self.params, jnp.asarray(ids),
+                           jnp.asarray(real, jnp.int32))
+        return txt.astype(self.dtype)
+
+
+class DummyFlux2TextEncoder:
+    """Hash-seeded context for random-weight demo/test runs."""
+
+    def __init__(self, context_dim: int, seq_len: int = 16):
+        self.context_dim, self.seq_len = context_dim, seq_len
+
+    def __call__(self, prompt: str):
+        import zlib
+        k = jax.random.PRNGKey(zlib.crc32(prompt.encode()))
+        return jax.random.normal(k, (1, self.seq_len, self.context_dim))
+
+
+# ---------------------------------------------------------------------------
+# Pipeline facade
+# ---------------------------------------------------------------------------
+
+
+class Flux2ImageModel:
+    """ImageGenerator facade for FLUX.2-klein (ref: flux.rs generate path).
+
+    bn_stats: (running_mean, running_var) arrays of len in_channels from the
+    VAE checkpoint's `bn.*` — packed latents denormalize through them before
+    unpatchify+decode (ref: vae.rs:60-75). Defaults to identity for
+    random-weight runs.
+    """
+
+    def __init__(self, cfg: Flux2PipelineConfig, params: dict | None = None,
+                 text_encoder=None, bn_stats=None, dtype=jnp.bfloat16,
+                 seed: int = 42):
+        self.cfg = cfg
+        self.dtype = dtype
+        if params is None:
+            k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+            params = {
+                "transformer": init_flux2_params(cfg.transformer, k1, dtype),
+                "vae": init_vae_decoder_params(cfg.vae, k2, jnp.float32),
+            }
+        self.params = params
+        self.text_encoder = text_encoder or DummyFlux2TextEncoder(
+            cfg.transformer.context_in_dim,
+            seq_len=min(cfg.max_txt_len, 16))
+        ic = cfg.transformer.in_channels
+        if bn_stats is None:
+            bn_stats = (np.zeros((ic,), np.float32),
+                        np.ones((ic,), np.float32))
+        self.bn_mean = jnp.asarray(bn_stats[0], jnp.float32)
+        self.bn_std = jnp.sqrt(jnp.asarray(bn_stats[1], jnp.float32) + 1e-4)
+
+        t_cfg, v_cfg = cfg.transformer, cfg.vae
+
+        @jax.jit
+        def _velocity(tp, img, img_ids, txt, txt_ids, t):
+            return flux2_forward(t_cfg, tp, img, img_ids, txt, txt_ids, t)
+
+        def _decode(vp, packed, bn_mean, bn_std, h_half, w_half):
+            # BN denorm in packed space, then unpatchify c-major
+            # (ref: vae.rs:61-75 — matches patches_to_latents layout)
+            z = packed.astype(jnp.float32) * bn_std + bn_mean
+            z = patches_to_latents(z, 2 * h_half, 2 * w_half)
+            return vae_decode(v_cfg, vp, z)
+
+        self._velocity = _velocity
+        self._decode = jax.jit(_decode, static_argnames=("h_half", "w_half"))
+
+    def generate_image(self, prompt: str, width: int = 1024,
+                       height: int = 1024, steps: int | None = None,
+                       guidance: float | None = None, seed: int | None = None,
+                       negative_prompt: str | None = None, on_step=None):
+        del negative_prompt, guidance    # klein is distilled: no CFG
+        cfg = self.cfg
+        steps = steps or cfg.steps_default
+        ic = cfg.transformer.in_channels
+        # latent-patch granularity: one 2x VAE upsample per channel-mult
+        # step (8 for klein's (1,2,4,4)) times the 2x2 packing = 16
+        factor = 2 * 2 ** (len(cfg.vae.channel_mults) - 1)
+        h_half = -(-height // factor)
+        w_half = -(-width // factor)
+        seq = h_half * w_half
+        rng = jax.random.PRNGKey(seed if seed is not None else 0)
+        img = jax.random.normal(rng, (1, seq, ic), self.dtype)
+
+        txt = jnp.asarray(self.text_encoder(prompt), self.dtype)
+        img_ids = make_img_ids4(h_half, w_half)
+        txt_ids = make_txt_ids4(txt.shape[1])
+
+        ts = flux2_schedule(steps, empirical_mu(seq, steps))
+        t0 = time.monotonic()
+        for i in range(steps):
+            t = jnp.asarray([ts[i]], jnp.float32)
+            v = self._velocity(self.params["transformer"], img, img_ids, txt,
+                               txt_ids, t)
+            # Euler: img += v * (t_next - t_curr); python floats to avoid
+            # promoting bf16 latents
+            img = img + v.astype(img.dtype) * (float(ts[i + 1]) - float(ts[i]))
+            if on_step:
+                on_step(i + 1, steps)
+        log.info("flux2 denoise: %d steps in %.1fs", steps,
+                 time.monotonic() - t0)
+
+        image = self._decode(self.params["vae"], img, self.bn_mean,
+                             self.bn_std, h_half=h_half, w_half=w_half)
+        from .flux import to_pil
+        # decoder output covers 16*h_half x 16*w_half; crop to request
+        return to_pil(np.asarray(image[0, :, :height, :width]))

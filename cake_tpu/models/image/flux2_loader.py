@@ -1,0 +1,334 @@
+"""FLUX.2-klein release-checkpoint loading (diffusers repo layout).
+
+Expected directory (the published black-forest-labs/FLUX.2-klein layout the
+reference's FluxModelFile paths point at — ref: flux/config.rs,
+flux2_model.rs weight names, flux2_vae.rs, text_encoder.rs:342-371):
+
+    model_index.json              {"_class_name": "Flux2Pipeline", ...}
+    transformer/*.safetensors     diffusers Flux2Transformer2DModel names
+                                  (transformer_blocks.N.attn.to_q., ...)
+    vae/*.safetensors             AutoencoderKLFlux2 (decoder.*, bn.*)
+    text_encoder/                 standard Qwen3 HF checkpoint
+    tokenizer/tokenizer.json      Qwen tokenizer
+
+Configs are inferred from tensor shapes; an optional `flux_config.json`
+sidecar ({"flux2": {...}, "vae": {...}, "encoder": {...}}) overrides the
+non-shape-derivable fields (rope axes split, capture layers) for
+non-standard checkpoints and tiny test fixtures.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.mapping import coverage_report, load_mapped_params
+from ...utils.safetensors_io import TensorStorage
+from ..common.config import config_from_hf_dict
+from .flux2 import (Flux2Config, Flux2ImageModel, Flux2PipelineConfig,
+                    Flux2TextEncoder, default_output_layers,
+                    init_flux2_params)
+from .vae import VaeConfig, init_vae_decoder_params
+
+log = logging.getLogger("cake_tpu.flux2_loader")
+
+
+@dataclasses.dataclass
+class Flux2Checkpoint:
+    transformer: TensorStorage
+    vae: TensorStorage
+    text_encoder_dir: str
+    tokenizer_path: str
+    model_dir: str
+
+
+def detect_flux2_checkpoint(path: str) -> Flux2Checkpoint | None:
+    """Sniff a diffusers FLUX.2 pipeline directory; None if not one."""
+    if not os.path.isdir(path):
+        return None
+    tdir = os.path.join(path, "transformer")
+    vdir = os.path.join(path, "vae")
+    edir = os.path.join(path, "text_encoder")
+    if not (os.path.isdir(tdir) and os.path.isdir(vdir)
+            and os.path.isdir(edir)):
+        return None
+    try:
+        tst = TensorStorage.from_model_dir(tdir)
+    except FileNotFoundError:
+        return None
+    # the shared-modulation tensors are unique to the FLUX.2 transformer
+    if not any(n.startswith("double_stream_modulation_img.")
+               for n in tst.names()):
+        mi = os.path.join(path, "model_index.json")
+        is_flux2 = False
+        if os.path.exists(mi):
+            with open(mi) as f:
+                is_flux2 = json.load(f).get("_class_name") == "Flux2Pipeline"
+        if not is_flux2:
+            tst.close()
+            return None
+    try:
+        vst = TensorStorage.from_model_dir(vdir)
+    except FileNotFoundError:
+        tst.close()      # vae/ exists but has no weights: not loadable
+        return None
+    tok = os.path.join(path, "tokenizer", "tokenizer.json")
+    if not os.path.exists(tok):
+        tok = os.path.join(edir, "tokenizer.json")
+    return Flux2Checkpoint(
+        transformer=tst, vae=vst,
+        text_encoder_dir=edir, tokenizer_path=tok, model_dir=path)
+
+
+# ---------------------------------------------------------------------------
+# Name mappings (pytree path -> diffusers tensor name)
+# ---------------------------------------------------------------------------
+
+
+def flux2_transformer_mapping(cfg: Flux2Config) -> dict[str, str]:
+    """Diffusers Flux2Transformer2DModel names
+    (ref: flux2_model.rs load paths)."""
+    m = {
+        "x_embedder.weight": "x_embedder.weight",
+        "context_embedder.weight": "context_embedder.weight",
+        "time_mlp.in.weight":
+            "time_guidance_embed.timestep_embedder.linear_1.weight",
+        "time_mlp.out.weight":
+            "time_guidance_embed.timestep_embedder.linear_2.weight",
+        "double_mod_img.weight": "double_stream_modulation_img.linear.weight",
+        "double_mod_txt.weight": "double_stream_modulation_txt.linear.weight",
+        "single_mod.weight": "single_stream_modulation.linear.weight",
+        "norm_out.weight": "norm_out.linear.weight",
+        "proj_out.weight": "proj_out.weight",
+    }
+    for i in range(cfg.depth_double):
+        src = f"transformer_blocks.{i}."
+        dst = f"double.{i}."
+        for ours, theirs in (("img_attn.q", "attn.to_q"),
+                             ("img_attn.k", "attn.to_k"),
+                             ("img_attn.v", "attn.to_v"),
+                             ("img_attn.o", "attn.to_out.0"),
+                             ("txt_attn.q", "attn.add_q_proj"),
+                             ("txt_attn.k", "attn.add_k_proj"),
+                             ("txt_attn.v", "attn.add_v_proj"),
+                             ("txt_attn.o", "attn.to_add_out"),
+                             ("ff.linear_in", "ff.linear_in"),
+                             ("ff.linear_out", "ff.linear_out"),
+                             ("ff_context.linear_in", "ff_context.linear_in"),
+                             ("ff_context.linear_out",
+                              "ff_context.linear_out")):
+            m[f"{dst}{ours}.weight"] = f"{src}{theirs}.weight"
+        for ours, theirs in (("img_attn.q_norm", "attn.norm_q"),
+                             ("img_attn.k_norm", "attn.norm_k"),
+                             ("txt_attn.q_norm", "attn.norm_added_q"),
+                             ("txt_attn.k_norm", "attn.norm_added_k")):
+            m[f"{dst}{ours}.weight"] = f"{src}{theirs}.weight"
+    for i in range(cfg.depth_single):
+        src = f"single_transformer_blocks.{i}."
+        dst = f"single.{i}."
+        m[f"{dst}to_qkv_mlp.weight"] = f"{src}attn.to_qkv_mlp_proj.weight"
+        m[f"{dst}to_out.weight"] = f"{src}attn.to_out.weight"
+        m[f"{dst}q_norm.weight"] = f"{src}attn.norm_q.weight"
+        m[f"{dst}k_norm.weight"] = f"{src}attn.norm_k.weight"
+    return m
+
+
+def flux2_vae_mapping(cfg: VaeConfig) -> tuple[dict[str, str], dict]:
+    """Diffusers AutoencoderKLFlux2 decoder names (ref: flux2_vae.rs).
+
+    Unlike the BFL layout (flux_loader.vae_decoder_mapping), up_blocks are
+    indexed in PROCESSING order and the mid attention uses linear
+    projections — returned transforms reshape them to our 1x1-conv layout.
+    """
+    def conv(dst, src):
+        return {f"{dst}.weight": f"{src}.weight", f"{dst}.bias": f"{src}.bias"}
+
+    def resnet(dst, src, has_shortcut):
+        mm = {}
+        for ours, theirs in (("norm1", "norm1"), ("conv1", "conv1"),
+                             ("norm2", "norm2"), ("conv2", "conv2")):
+            mm.update(conv(f"{dst}.{ours}", f"{src}.{theirs}"))
+        if has_shortcut:
+            mm.update(conv(f"{dst}.shortcut", f"{src}.conv_shortcut"))
+        return mm
+
+    d = "decoder."
+    chs = [cfg.base_channels * mlt for mlt in cfg.channel_mults]
+    n_lv = len(chs)
+    m: dict[str, str] = {}
+    transforms: dict = {}
+    m.update(conv("post_quant_conv", "post_quant_conv"))
+    m.update(conv("conv_in", f"{d}conv_in"))
+    m.update(resnet("mid_res1", f"{d}mid_block.resnets.0", False))
+    m.update(resnet("mid_res2", f"{d}mid_block.resnets.1", False))
+    attn = f"{d}mid_block.attentions.0"
+    for ours, theirs in (("q", "to_q"), ("k", "to_k"), ("v", "to_v"),
+                         ("proj", "to_out.0")):
+        m.update(conv(f"mid_attn.{ours}", f"{attn}.{theirs}"))
+        # linear (c, c) -> our 1x1 conv (c, c, 1, 1)
+        transforms[f"mid_attn.{ours}.weight"] = \
+            lambda a: a.reshape(*a.shape, 1, 1)
+    m.update(conv("mid_attn.norm", f"{attn}.group_norm"))
+    cin = chs[-1]
+    for k, c in enumerate(reversed(chs)):
+        src = f"{d}up_blocks.{k}"
+        for j in range(cfg.num_res_blocks):
+            m.update(resnet(f"ups.{k}.res.{j}", f"{src}.resnets.{j}",
+                            has_shortcut=(cin != c)))
+            cin = c
+        if k < n_lv - 1:
+            m.update(conv(f"ups.{k}.upsample", f"{src}.upsamplers.0.conv"))
+    m.update(conv("norm_out", f"{d}conv_norm_out"))
+    m.update(conv("conv_out", f"{d}conv_out"))
+    return m, transforms
+
+
+# ---------------------------------------------------------------------------
+# Config inference
+# ---------------------------------------------------------------------------
+
+
+def infer_flux2_configs(ckpt: Flux2Checkpoint) -> dict:
+    over: dict = {}
+    sidecar = os.path.join(ckpt.model_dir, "flux_config.json")
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            over = json.load(f)
+
+    def count(storage, fmt):
+        i = 0
+        while fmt.format(i) in storage:
+            i += 1
+        return i
+
+    rec = ckpt.transformer.records
+    hidden, in_ch = rec["x_embedder.weight"].shape
+    head_dim = rec["transformer_blocks.0.attn.norm_q.weight"].shape[0]
+    mlp2 = rec["transformer_blocks.0.ff.linear_in.weight"].shape[0]
+    t = dict(
+        in_channels=in_ch, hidden_size=hidden,
+        num_heads=hidden // head_dim, head_dim=head_dim,
+        mlp_ratio=(mlp2 // 2) / hidden,
+        depth_double=count(ckpt.transformer,
+                           "transformer_blocks.{}.attn.to_q.weight"),
+        depth_single=count(
+            ckpt.transformer,
+            "single_transformer_blocks.{}.attn.to_qkv_mlp_proj.weight"),
+        context_in_dim=rec["context_embedder.weight"].shape[1],
+        axes_dims=(head_dim // 4,) * 4,           # klein: (32,32,32,32)/128
+        theta=2000.0,
+    )
+    t.update(over.get("flux2", {}))
+    t["axes_dims"] = tuple(t["axes_dims"])
+
+    vrec = ckpt.vae.records
+    n_lv = count(ckpt.vae, "decoder.up_blocks.{}.resnets.0.conv1.weight")
+    base = vrec["decoder.conv_out.weight"].shape[1]
+    # up_blocks run in processing order (high channels first) — our
+    # channel_mults list low-first, so reverse the per-block out channels
+    outs = [vrec[f"decoder.up_blocks.{k}.resnets.0.conv2.weight"].shape[0]
+            for k in range(n_lv)]
+    vae = dict(
+        latent_channels=vrec["decoder.conv_in.weight"].shape[1],
+        base_channels=base,
+        channel_mults=tuple(c // base for c in reversed(outs)),
+        num_res_blocks=count(ckpt.vae,
+                             "decoder.up_blocks.0.resnets.{}.conv1.weight"),
+        scaling_factor=1.0, shift_factor=0.0,
+    )
+    vae.update(over.get("vae", {}))
+    vae["channel_mults"] = tuple(vae["channel_mults"])
+
+    return {"flux2": Flux2Config(**t), "vae": VaeConfig(**vae),
+            "encoder_over": over.get("encoder", {})}
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def load_flux2_image_model(path: str | Flux2Checkpoint, dtype=jnp.bfloat16,
+                           max_txt_len: int = 512):
+    """Load a FLUX.2-klein pipeline directory (or an already-detected
+    Flux2Checkpoint, so callers that sniffed first don't re-open every
+    shard) into a ready Flux2ImageModel (ref: flux.rs component loads)."""
+    ckpt = path if isinstance(path, Flux2Checkpoint) \
+        else detect_flux2_checkpoint(path)
+    if ckpt is None:
+        raise ValueError(
+            f"{path!r} is not a FLUX.2 pipeline directory (expected "
+            "transformer/ + vae/ + text_encoder/ subdirs with "
+            "double_stream_modulation_img.* transformer tensors or a "
+            "Flux2Pipeline model_index.json)")
+    cfgs = infer_flux2_configs(ckpt)
+    t_cfg, v_cfg = cfgs["flux2"], cfgs["vae"]
+
+    tmap = flux2_transformer_mapping(t_cfg)
+    params = {"transformer": load_mapped_params(
+        ckpt.transformer, tmap,
+        jax.eval_shape(lambda: init_flux2_params(t_cfg, jax.random.PRNGKey(0),
+                                                 dtype)), dtype)}
+    coverage_report(ckpt.transformer, tmap)
+
+    vmap, vtrans = flux2_vae_mapping(v_cfg)
+    vae_shapes = jax.eval_shape(lambda: init_vae_decoder_params(
+        v_cfg, jax.random.PRNGKey(0), jnp.float32))
+    # post_quant_conv is a diffusers-only leaf the init template doesn't
+    # have; without it here load_mapped_params would silently drop it
+    lc = v_cfg.latent_channels
+    vae_shapes["post_quant_conv"] = {
+        "weight": jax.ShapeDtypeStruct((lc, lc, 1, 1), jnp.float32),
+        "bias": jax.ShapeDtypeStruct((lc,), jnp.float32)}
+    params["vae"] = load_mapped_params(ckpt.vae, vmap, vae_shapes,
+                                       jnp.float32, transforms=vtrans)
+    coverage_report(ckpt.vae, vmap, ignore=("encoder.", "quant_conv.", "bn."))
+    bn = None
+    if "bn.running_mean" in ckpt.vae:
+        bn = (ckpt.vae.read("bn.running_mean").astype(np.float32),
+              ckpt.vae.read("bn.running_var").astype(np.float32))
+
+    # Qwen3 text encoder: standard HF checkpoint through the standard text
+    # loader, truncated at the last capture layer (output-identical to the
+    # reference running all 36 — text_encoder.rs:384-389)
+    with open(os.path.join(ckpt.text_encoder_dir, "config.json")) as f:
+        enc_raw = json.load(f)
+    enc_cfg = config_from_hf_dict(enc_raw)
+    enc_over = cfgs["encoder_over"]
+    output_layers = tuple(enc_over.get(
+        "output_layers", default_output_layers(enc_cfg.num_hidden_layers)))
+    if t_cfg.context_in_dim != len(output_layers) * enc_cfg.hidden_size:
+        raise ValueError(
+            f"transformer context dim {t_cfg.context_in_dim} != "
+            f"{len(output_layers)} captures x encoder hidden "
+            f"{enc_cfg.hidden_size}")
+    from ...utils.loaders import load_model_params
+    enc_params = load_model_params(
+        enc_cfg, ckpt.text_encoder_dir, dtype,
+        layer_range=(0, max(output_layers) + 1),
+        include_embed=True, include_head=False)
+
+    from tokenizers import Tokenizer
+    tokenizer = Tokenizer.from_file(ckpt.tokenizer_path)
+    pad_id = tokenizer.token_to_id("<|endoftext|>")
+    encoder = Flux2TextEncoder(
+        enc_cfg, enc_params, tokenizer=tokenizer, max_len=max_txt_len,
+        output_layers=output_layers,
+        pad_id=151643 if pad_id is None else pad_id, dtype=dtype)
+
+    ckpt.transformer.close()
+    ckpt.vae.close()
+    pipe_cfg = Flux2PipelineConfig(transformer=t_cfg, vae=v_cfg,
+                                   max_txt_len=max_txt_len)
+    model = Flux2ImageModel(pipe_cfg, params=params, text_encoder=encoder,
+                            bn_stats=bn, dtype=dtype)
+    log.info("loaded FLUX.2 checkpoint: %d double + %d single blocks, "
+             "hidden %d, encoder %d layers (captures %s)",
+             t_cfg.depth_double, t_cfg.depth_single, t_cfg.hidden_size,
+             max(output_layers) + 1, output_layers)
+    return model

@@ -98,12 +98,15 @@ def build_image_model(model: str, dtype: str = "bf16"):
     full pipelines on random weights (zero-egress environments); any other
     value is a release-checkpoint path (FLUX.1 ComfyUI bundle / BFL split
     layout — see models/image/flux_loader; ref: flux1.rs load path)."""
-    from .models.image import (FluxImageModel, SDImageModel,
-                               detect_sd_checkpoint, load_flux_image_model,
-                               load_sd_image_model, tiny_flux_config,
-                               tiny_sd_config)
+    from .models.image import (Flux2ImageModel, FluxImageModel, SDImageModel,
+                               detect_flux2_checkpoint, detect_sd_checkpoint,
+                               load_flux2_image_model, load_flux_image_model,
+                               load_sd_image_model, tiny_flux2_config,
+                               tiny_flux_config, tiny_sd_config)
     if model == "demo:sd":
         return SDImageModel(tiny_sd_config(), dtype=parse_dtype(dtype))
+    if model == "demo:flux2":
+        return Flux2ImageModel(tiny_flux2_config(), dtype=parse_dtype(dtype))
     if model.startswith("demo:"):
         return FluxImageModel(tiny_flux_config(), dtype=parse_dtype(dtype))
     # local path (dir or single bundle file) passes through; otherwise
@@ -111,6 +114,9 @@ def build_image_model(model: str, dtype: str = "bf16"):
     path = os.path.expanduser(model)
     if not os.path.exists(path):
         path = resolve_model(model)
+    flux2_ckpt = detect_flux2_checkpoint(path)
+    if flux2_ckpt is not None:
+        return load_flux2_image_model(flux2_ckpt, dtype=parse_dtype(dtype))
     if detect_sd_checkpoint(path):
         return load_sd_image_model(path, dtype=parse_dtype(dtype))
     return load_flux_image_model(path, dtype=parse_dtype(dtype))

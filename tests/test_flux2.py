@@ -1,0 +1,283 @@
+"""FLUX.2-klein: transformer forward, schedule/ids vs the reference
+formulas, the Qwen3 encoder's capture+padding semantics, and end-to-end
+loading of a synthetic diffusers-layout checkpoint through the public
+runtime path (ref: flux2_model.rs, flux2_vae.rs, text_encoder.rs, flux.rs).
+"""
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models import init_params, tiny_config
+from cake_tpu.models.common.layers import embed_tokens, forward_layers
+from cake_tpu.models.image import (Flux2ImageModel, Flux2TextEncoder,
+                                   detect_flux2_checkpoint, flux2_forward,
+                                   flux2_schedule, flux2_transformer_mapping,
+                                   flux2_vae_mapping, init_flux2_params,
+                                   load_flux2_image_model, tiny_flux2_config)
+from cake_tpu.models.image.flux2 import (default_output_layers, empirical_mu,
+                                         make_img_ids4, make_txt_ids4)
+from cake_tpu.models.image.vae import init_vae_decoder_params
+from cake_tpu.utils.export import params_to_hf_tensors
+from cake_tpu.utils.mapping import flatten_tree
+from cake_tpu.utils.safetensors_io import save_safetensors
+
+
+def test_empirical_mu_matches_reference_formula():
+    # ref flux.rs:216-230 — both branches
+    for seq, steps in ((4096, 20), (64, 4), (8192, 50)):
+        a1, b1 = 8.73809524e-05, 1.89833333
+        a2, b2 = 0.00016927, 0.45666666
+        if seq > 4300:
+            want = a2 * seq + b2
+        else:
+            m200, m10 = a2 * seq + b2, a1 * seq + b1
+            a = (m200 - m10) / 190.0
+            b = m200 - 200.0 * a
+            want = a * steps + b
+        assert empirical_mu(seq, steps) == pytest.approx(want)
+
+
+def test_schedule_matches_reference_formula():
+    mu = empirical_mu(4096, 20)
+    ts = flux2_schedule(20, mu)
+    assert len(ts) == 21
+    assert ts[0] == pytest.approx(math.exp(mu) / (math.exp(mu) + 0.0), abs=1e-9)
+    assert ts[-1] == 0.0
+    # spot-check an interior value against the scalar formula
+    t = 1.0 - 7 / 19.0
+    e = math.exp(mu)
+    assert ts[7] == pytest.approx(e / (e + (1.0 / t - 1.0)), rel=1e-9)
+    # non-increasing; linspace already ends at 0 and the reference appends
+    # a terminal 0 on top (flux.rs:254-255), so the tail is [0, 0]
+    assert np.all(np.diff(ts) <= 0) and ts[-2] == 0.0
+
+
+def test_ids_layout():
+    img = np.asarray(make_img_ids4(2, 3))
+    assert img.shape == (1, 6, 4)
+    assert (img[0, :, 0] == 0).all() and (img[0, :, 3] == 0).all()
+    assert img[0, 4].tolist() == [0, 1, 1, 0]    # row-major (y,x)=(1,1)
+    txt = np.asarray(make_txt_ids4(5))
+    assert txt.shape == (1, 5, 4)
+    assert txt[0, :, 3].tolist() == [0, 1, 2, 3, 4]
+    assert (txt[0, :, :3] == 0).all()
+
+
+def test_default_output_layers():
+    assert default_output_layers(36) == (8, 17, 26)   # klein-4B
+    assert default_output_layers(4) == (0, 1, 2)
+
+
+def test_flux2_forward_shapes():
+    cfg = tiny_flux2_config().transformer
+    params = init_flux2_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    img = jax.random.normal(jax.random.PRNGKey(1), (1, 6, cfg.in_channels))
+    txt = jax.random.normal(jax.random.PRNGKey(2), (1, 5, cfg.context_in_dim))
+    v = flux2_forward(cfg, params, img, make_img_ids4(2, 3), txt,
+                      make_txt_ids4(5), jnp.asarray([0.5]))
+    assert v.shape == (1, 6, cfg.in_channels)
+    arr = np.asarray(v)
+    assert np.isfinite(arr).all() and arr.std() > 0
+
+
+@pytest.fixture
+def enc_setup():
+    cfg = tiny_config("qwen3", hidden_size=32, intermediate_size=64,
+                      num_attention_heads=4, num_key_value_heads=2)
+    params = init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    return cfg, params
+
+
+def test_encoder_captures_match_manual(enc_setup):
+    cfg, params = enc_setup
+    enc = Flux2TextEncoder(cfg, params, max_len=8, output_layers=(0, 1, 2),
+                           dtype=jnp.float32)
+    ids = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    got = enc._encode(params, ids, jnp.asarray(8, jnp.int32))
+    # manual: full stateless forward capturing after each block
+    x = embed_tokens(cfg, params, ids)
+    outs = []
+    for i in range(3):
+        x, _ = forward_layers(cfg, params, x, None, jnp.asarray(0, jnp.int32),
+                              layer_range=(i, i + 1),
+                              valid_len=jnp.asarray(8, jnp.int32))
+        outs.append(x)
+    want = jnp.concatenate(outs, axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    assert got.shape == (1, 8, 3 * cfg.hidden_size)
+
+
+def test_encoder_padding_mask(enc_setup):
+    """Real-token hidden states must be invariant to pad-slot content —
+    the causal+padding mask of text_encoder.rs:161-190."""
+    cfg, params = enc_setup
+    enc = Flux2TextEncoder(cfg, params, max_len=8, output_layers=(0, 1, 2),
+                           dtype=jnp.float32)
+    a = jnp.asarray([[1, 2, 3, 9, 9, 9, 9, 9]], jnp.int32)
+    b = jnp.asarray([[1, 2, 3, 7, 7, 7, 7, 7]], jnp.int32)
+    va = np.asarray(enc._encode(params, a, jnp.asarray(3, jnp.int32)))
+    vb = np.asarray(enc._encode(params, b, jnp.asarray(3, jnp.int32)))
+    np.testing.assert_allclose(va[:, :3], vb[:, :3], atol=1e-6)
+    assert not np.allclose(va[:, 3:], vb[:, 3:])   # pads do differ
+
+
+# ---------------------------------------------------------------------------
+# Synthetic diffusers-layout checkpoint
+# ---------------------------------------------------------------------------
+
+# literal spot-checks so a systematic mapping bug cannot hide behind
+# synthesize-with-the-same-map
+EXPECTED_NAMES = [
+    "x_embedder.weight",
+    "time_guidance_embed.timestep_embedder.linear_1.weight",
+    "double_stream_modulation_img.linear.weight",
+    "single_stream_modulation.linear.weight",
+    "transformer_blocks.0.attn.to_q.weight",
+    "transformer_blocks.0.attn.add_k_proj.weight",
+    "transformer_blocks.0.attn.norm_added_q.weight",
+    "transformer_blocks.1.ff_context.linear_in.weight",
+    "single_transformer_blocks.0.attn.to_qkv_mlp_proj.weight",
+    "single_transformer_blocks.1.attn.to_out.weight",
+    "norm_out.linear.weight",
+    "proj_out.weight",
+]
+EXPECTED_VAE_NAMES = [
+    "post_quant_conv.weight",
+    "decoder.conv_in.weight",
+    "decoder.mid_block.resnets.0.norm1.weight",
+    "decoder.mid_block.attentions.0.to_q.weight",
+    "decoder.mid_block.attentions.0.group_norm.weight",
+    "decoder.up_blocks.0.resnets.0.conv1.weight",
+    "decoder.up_blocks.0.upsamplers.0.conv.weight",
+    "decoder.up_blocks.1.resnets.0.conv_shortcut.weight",
+    "decoder.conv_norm_out.weight",
+    "decoder.conv_out.weight",
+]
+
+
+def _qwen_tokenizer_json(path):
+    vocab = {f"w{i}": i for i in range(200)}
+    vocab["<unk>"] = 200
+    vocab["<|endoftext|>"] = 201
+    tok = {"version": "1.0", "truncation": None, "padding": None,
+           "added_tokens": [], "normalizer": None,
+           "pre_tokenizer": {"type": "Whitespace"}, "post_processor": None,
+           "decoder": None,
+           "model": {"type": "WordLevel", "vocab": vocab,
+                     "unk_token": "<unk>"}}
+    with open(path, "w") as f:
+        json.dump(tok, f)
+
+
+@pytest.fixture
+def flux2_dir(tmp_path):
+    pipe = tiny_flux2_config()
+    root = tmp_path / "flux2"
+    for sub in ("transformer", "vae", "text_encoder", "tokenizer"):
+        (root / sub).mkdir(parents=True)
+
+    tmap = flux2_transformer_mapping(pipe.transformer)
+    tparams = init_flux2_params(pipe.transformer, jax.random.PRNGKey(0),
+                                jnp.float32)
+    flat = flatten_tree(tparams)
+    save_safetensors(str(root / "transformer" / "model.safetensors"),
+                     {name: np.asarray(flat[path], np.float32)
+                      for path, name in tmap.items()})
+
+    vmap, vtrans = flux2_vae_mapping(pipe.vae)
+    vparams = init_vae_decoder_params(pipe.vae, jax.random.PRNGKey(1),
+                                      jnp.float32)
+    lc = pipe.vae.latent_channels
+    vparams["post_quant_conv"] = {
+        "weight": np.eye(lc, dtype=np.float32).reshape(lc, lc, 1, 1),
+        "bias": np.zeros((lc,), np.float32)}
+    vflat = flatten_tree(vparams)
+    vtensors = {}
+    for path, name in vmap.items():
+        arr = np.asarray(vflat[path], np.float32)
+        if path in vtrans:          # inverse of the linear->conv reshape
+            arr = arr.reshape(arr.shape[0], arr.shape[1])
+        vtensors[name] = arr
+    ic = pipe.transformer.in_channels
+    vtensors["bn.running_mean"] = np.full((ic,), 0.1, np.float32)
+    vtensors["bn.running_var"] = np.full((ic,), 0.9, np.float32)
+    save_safetensors(str(root / "vae" / "model.safetensors"), vtensors)
+
+    enc_cfg = tiny_config("qwen3", hidden_size=32, intermediate_size=64,
+                          num_attention_heads=4, num_key_value_heads=2)
+    enc_params = init_params(enc_cfg, jax.random.PRNGKey(2), jnp.float32)
+    save_safetensors(str(root / "text_encoder" / "model.safetensors"),
+                     params_to_hf_tensors(enc_cfg, enc_params))
+    (root / "text_encoder" / "config.json").write_text(json.dumps(dict(
+        architectures=["Qwen3ForCausalLM"], vocab_size=256, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, rms_norm_eps=1e-5, rope_theta=10000.0,
+        max_position_embeddings=128, eos_token_id=2)))
+    _qwen_tokenizer_json(root / "tokenizer" / "tokenizer.json")
+    (root / "model_index.json").write_text(json.dumps(
+        {"_class_name": "Flux2Pipeline"}))
+    # tiny axes don't follow the head_dim//4 rule (sum must == head_dim)
+    (root / "flux_config.json").write_text(json.dumps(
+        {"flux2": {"axes_dims": list(pipe.transformer.axes_dims)}}))
+    return str(root), pipe
+
+
+def test_detect_flux2(flux2_dir, tmp_path):
+    root, _ = flux2_dir
+    ckpt = detect_flux2_checkpoint(root)
+    assert ckpt is not None
+    assert os.path.isdir(ckpt.text_encoder_dir)
+    assert detect_flux2_checkpoint(str(tmp_path)) is None
+
+
+def test_synth_names_literal(flux2_dir):
+    """The synthesized checkpoint must contain the published diffusers
+    names verbatim."""
+    root, _ = flux2_dir
+    from cake_tpu.utils.safetensors_io import index_file
+    tnames = set(index_file(os.path.join(root, "transformer",
+                                         "model.safetensors")).keys())
+    for n in EXPECTED_NAMES:
+        assert n in tnames, n
+    vnames = set(index_file(os.path.join(root, "vae",
+                                         "model.safetensors")).keys())
+    for n in EXPECTED_VAE_NAMES:
+        assert n in vnames, n
+
+
+def test_load_and_generate_end_to_end(flux2_dir):
+    root, pipe = flux2_dir
+    model = load_flux2_image_model(root, dtype=jnp.float32, max_txt_len=8)
+    assert isinstance(model, Flux2ImageModel)
+    # loaded weights equal the synthesized originals
+    want = init_flux2_params(pipe.transformer, jax.random.PRNGKey(0),
+                             jnp.float32)
+    got = model.params["transformer"]
+    np.testing.assert_allclose(
+        np.asarray(got["double"][0]["img_attn"]["q"]["weight"]),
+        np.asarray(want["double"][0]["img_attn"]["q"]["weight"]), atol=1e-6)
+    # bn stats picked up
+    assert model.bn_mean[0] == pytest.approx(0.1)
+    img = model.generate_image("a tiny test prompt", width=32, height=32,
+                               steps=2, seed=3)
+    assert img.size == (32, 32)
+    assert np.asarray(img).std() > 0
+
+
+def test_runtime_dispatch_flux2(flux2_dir):
+    from cake_tpu.runtime import build_image_model
+    root, _ = flux2_dir
+    model = build_image_model(root, dtype="f32")
+    assert isinstance(model, Flux2ImageModel)
+
+
+def test_runtime_demo_flux2():
+    from cake_tpu.runtime import build_image_model
+    model = build_image_model("demo:flux2", dtype="f32")
+    img = model.generate_image("demo", width=16, height=16, steps=1)
+    assert img.size == (16, 16)

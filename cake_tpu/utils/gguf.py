@@ -3,10 +3,12 @@ extraction, `blk.N.attn_q` -> HF name mapping, and dequantization of the
 common K-quant formats at load (ref: utils/gguf.rs:1-26 + dispatch in
 cake/mod.rs:237-263).
 
-Supported tensor types: F32, F16, BF16, Q4_0, Q8_0, Q4_K, Q6_K — the set a
-Q4_K_M model actually contains (Q4_K for bulk weights, Q6_K for a few,
-F32 norms). Dequant formulas follow the public ggml block layouts,
-vectorized with numpy.
+Supported tensor types: F32, F16, BF16, Q4_0, Q5_0, Q5_1, Q8_0, Q2_K,
+Q3_K, Q4_K, Q5_K, Q6_K — covering the llama.cpp quant mixes in common HF
+uploads (Q4_K_M, Q5_K_M, Q3_K_M, Q2_K, Q5_0/Q5_1 legacy). Dequant formulas
+follow the public ggml block layouts (ggml-common.h / dequantize_row_*),
+vectorized with numpy; tests pin each against a literal scalar
+transcription of the C loops.
 """
 from __future__ import annotations
 
@@ -23,8 +25,8 @@ _T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32, _T_F32, _T_BOOL, _T_STR, \
 
 # tensor dtype tags (ggml_type)
 GGML_F32, GGML_F16 = 0, 1
-GGML_Q4_0, GGML_Q8_0 = 2, 8
-GGML_Q4_K, GGML_Q6_K = 12, 14
+GGML_Q4_0, GGML_Q5_0, GGML_Q5_1, GGML_Q8_0 = 2, 6, 7, 8
+GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 10, 11, 12, 13, 14
 GGML_BF16 = 30
 
 QK_K = 256
@@ -105,10 +107,20 @@ class GgufReader:
             data = np.frombuffer(self._raw(t, 2 * n), jnp.dtype(jnp.bfloat16))
         elif t.ggml_type == GGML_Q4_0:
             data = dequant_q4_0(self._raw(t, n // 32 * 18), n)
+        elif t.ggml_type == GGML_Q5_0:
+            data = dequant_q5_0(self._raw(t, n // 32 * 22), n)
+        elif t.ggml_type == GGML_Q5_1:
+            data = dequant_q5_1(self._raw(t, n // 32 * 24), n)
         elif t.ggml_type == GGML_Q8_0:
             data = dequant_q8_0(self._raw(t, n // 32 * 34), n)
+        elif t.ggml_type == GGML_Q2_K:
+            data = dequant_q2_k(self._raw(t, n // QK_K * 84), n)
+        elif t.ggml_type == GGML_Q3_K:
+            data = dequant_q3_k(self._raw(t, n // QK_K * 110), n)
         elif t.ggml_type == GGML_Q4_K:
             data = dequant_q4_k(self._raw(t, n // QK_K * 144), n)
+        elif t.ggml_type == GGML_Q5_K:
+            data = dequant_q5_k(self._raw(t, n // QK_K * 176), n)
         elif t.ggml_type == GGML_Q6_K:
             data = dequant_q6_k(self._raw(t, n // QK_K * 210), n)
         else:
@@ -130,12 +142,92 @@ def dequant_q4_0(raw: bytes, n: int) -> np.ndarray:
     return (q * d).reshape(-1)
 
 
+def dequant_q5_0(raw: bytes, n: int) -> np.ndarray:
+    """Block = f16 scale + u32 high-bit mask + 32x4bit; w = d*(q5-16).
+    Element j takes qh bit j, element j+16 takes qh bit j+16."""
+    nb = n // 32
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 22)
+    d = b[:, :2].copy().view(np.float16).astype(np.float32)       # [nb,1]
+    qh = b[:, 2:6].copy().view(np.uint32)                         # [nb,1]
+    qs = b[:, 6:]
+    j = np.arange(16, dtype=np.uint32)
+    hlo = (((qh >> j) & 1) << 4).astype(np.uint8)                 # [nb,16]
+    hhi = (((qh >> (j + 16)) & 1) << 4).astype(np.uint8)
+    lo = (qs & 0xF) | hlo
+    hi = (qs >> 4) | hhi
+    q = np.concatenate([lo, hi], axis=1).astype(np.float32) - 16.0
+    return (q * d).reshape(-1)
+
+
+def dequant_q5_1(raw: bytes, n: int) -> np.ndarray:
+    """Block = f16 scale + f16 min + u32 high bits + 32x4bit; w = d*q5 + m."""
+    nb = n // 32
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 24)
+    d = b[:, 0:2].copy().view(np.float16).astype(np.float32)      # [nb,1]
+    m = b[:, 2:4].copy().view(np.float16).astype(np.float32)
+    qh = b[:, 4:8].copy().view(np.uint32)
+    qs = b[:, 8:]
+    j = np.arange(16, dtype=np.uint32)
+    hlo = (((qh >> j) & 1) << 4).astype(np.uint8)
+    hhi = (((qh >> (j + 16)) & 1) << 4).astype(np.uint8)
+    lo = (qs & 0xF) | hlo
+    hi = (qs >> 4) | hhi
+    q = np.concatenate([lo, hi], axis=1).astype(np.float32)
+    return (q * d + m).reshape(-1)
+
+
 def dequant_q8_0(raw: bytes, n: int) -> np.ndarray:
     nb = n // 32
     b = np.frombuffer(raw, np.uint8).reshape(nb, 34)
     d = b[:, :2].copy().view(np.float16).astype(np.float32)
     q = b[:, 2:].copy().view(np.int8).astype(np.float32)
     return (q * d).reshape(-1)
+
+
+def dequant_q2_k(raw: bytes, n: int) -> np.ndarray:
+    """Super-block 256 = 16B scales(4bit sc|min) + 64B 2-bit qs + d + dmin;
+    w = d*(sc&0xF)*q - dmin*(sc>>4), 16 groups of 16. The C loop walks two
+    128-halves, 4 shift steps of 2 bits, two 16-groups per step."""
+    nb = n // QK_K
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 84)
+    scales = b[:, :16]
+    qs = b[:, 16:80].reshape(nb, 2, 1, 32)                        # [nb,half,1,l]
+    d = b[:, 80:82].copy().view(np.float16).astype(np.float32)    # [nb,1]
+    dmin = b[:, 82:84].copy().view(np.float16).astype(np.float32)
+    shift = (np.arange(4, dtype=np.uint8) * 2)[None, None, :, None]
+    q = ((qs >> shift) & 3).astype(np.float32)                    # [nb,2,4,32]
+    sel = scales.reshape(nb, 2, 4, 2)[..., np.arange(32) // 16]   # [nb,2,4,32]
+    dl = d[:, :, None, None] * (sel & 0xF).astype(np.float32)
+    ml = dmin[:, :, None, None] * (sel >> 4).astype(np.float32)
+    return (dl * q - ml).reshape(-1)
+
+
+def dequant_q3_k(raw: bytes, n: int) -> np.ndarray:
+    """Super-block 256 = 32B hmask + 64B 2-bit qs + 12B 6-bit scales + d;
+    w = d*(sc-32)*(q2 - (hmask bit ? 0 : 4)). Scale unpack follows the
+    kmask1/kmask2 word shuffle in ggml dequantize_row_q3_K."""
+    nb = n // QK_K
+    b = np.frombuffer(raw, np.uint8).reshape(nb, 110)
+    hm = b[:, :32]                                                # [nb,32]
+    qs = b[:, 32:96].reshape(nb, 2, 1, 32)
+    a = b[:, 96:108].copy().view(np.uint32)                       # [nb,3]
+    d = b[:, 108:110].copy().view(np.float16).astype(np.float32)  # [nb,1]
+    k1, k2 = np.uint32(0x03030303), np.uint32(0x0F0F0F0F)
+    a0, a1, a2 = a[:, 0], a[:, 1], a[:, 2]
+    words = np.stack([
+        (a0 & k2) | (((a2 >> np.uint32(0)) & k1) << np.uint32(4)),
+        (a1 & k2) | (((a2 >> np.uint32(2)) & k1) << np.uint32(4)),
+        ((a0 >> np.uint32(4)) & k2) | (((a2 >> np.uint32(4)) & k1) << np.uint32(4)),
+        ((a1 >> np.uint32(4)) & k2) | (((a2 >> np.uint32(6)) & k1) << np.uint32(4)),
+    ], axis=1)                                                    # [nb,4] u32
+    sc = np.ascontiguousarray(words).view(np.int8).astype(np.float32) - 32.0  # [nb,16]
+    shift = (np.arange(4, dtype=np.uint8) * 2)[None, None, :, None]
+    q2 = ((qs >> shift) & 3).astype(np.float32)                   # [nb,2,4,32]
+    mbit = (np.arange(2)[:, None] * 4 + np.arange(4)[None, :]).astype(np.uint8)
+    hbit = (hm[:, None, None, :] >> mbit[None, :, :, None]) & 1   # [nb,2,4,32]
+    q = q2 - 4.0 * (1 - hbit).astype(np.float32)
+    sel = sc.reshape(nb, 2, 4, 2)[..., np.arange(32) // 16]       # [nb,2,4,32]
+    return (d[:, :, None, None] * sel * q).reshape(-1)
 
 
 def _k4_scale_min(scales: np.ndarray):

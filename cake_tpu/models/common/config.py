@@ -33,6 +33,7 @@ class LayerSpec:
     """Resolved per-layer behavior, consumed by the generic decoder block."""
     kind: str = "full"            # 'full' | 'swa' | 'linear'
     use_rope: bool = True
+    local_rope_table: bool = False  # Gemma3 SWA layers: rope_local_base_freq
     window: int | None = None     # sliding-window size when kind == 'swa'
     is_moe: bool = False
     norm_style: str = "pre"       # 'pre' | 'post' (OLMo2) | 'sandwich' (Gemma3)
@@ -66,7 +67,12 @@ class ModelConfig:
     sliding_window: int | None = None
     global_layers: tuple[bool, ...] = ()   # per-layer global flag (Gemma3/EXAONE4)
     global_rope: bool = True       # EXAONE4 global layers: NoPE
-    local_rope: bool = True        # Gemma3 local layers: no RoPE (reference parity)
+    # Gemma3 SWA layers apply RoPE at rope_local_base_freq with no scaling,
+    # while global layers use rope_theta + rope_scaling (HF ground truth,
+    # pinned by tests/test_hf_parity.py; the reference skips RoPE on local
+    # layers entirely — gemma3/block.rs:62 — which diverges from the HF
+    # semantics real checkpoints were trained with, so we follow HF).
+    local_rope_theta: float | None = None
     hidden_act: str = "silu"       # 'silu' | 'gelu_tanh'
     embed_scale: float | None = None
     model_prefix: str = "model"
@@ -100,7 +106,8 @@ class ModelConfig:
                 return LayerSpec(kind="full", use_rope=self.global_rope,
                                  is_moe=self._layer_is_moe(i),
                                  norm_style=self.norm_style)
-            return LayerSpec(kind="swa", use_rope=self.local_rope,
+            return LayerSpec(kind="swa", use_rope=True,
+                             local_rope_table=self.local_rope_theta is not None,
                              window=self.sliding_window,
                              is_moe=self._layer_is_moe(i),
                              norm_style=self.norm_style)
@@ -235,7 +242,8 @@ def _gemma3(d):
         rope_theta=float(d.get("rope_theta", 10000.0)),
         qk_norm=True, residual_rms_norm=True, norm_style="sandwich",
         sliding_window=int(d.get("sliding_window", 1024)),
-        global_layers=global_layers, local_rope=False,
+        global_layers=global_layers,
+        local_rope_theta=float(d.get("rope_local_base_freq", 10000.0)),
         hidden_act="gelu_tanh",
         embed_scale=float(d["hidden_size"]) ** 0.5,
         tie_word_embeddings=True,
@@ -255,13 +263,23 @@ def _olmo2(d):
 
 
 def _exaone4(d):
-    """EXAONE 4.0: 3 local(SWA+RoPE) : 1 global(full, NoPE), QK-norm
-    (ref: exaone4/config.rs into_config, exaone4/block.rs:55-67)."""
+    """EXAONE 4.0: 3 local(SWA+RoPE) : 1 global(full, NoPE), QK-norm,
+    POST-norm residuals — post_attention_layernorm / post_feedforward_
+    layernorm applied to the sublayer output before the residual add (HF
+    Exaone4DecoderLayer ground truth, pinned by tests/test_hf_parity.py;
+    the reference's exaone4/block.rs:55-67 uses pre-norm with an
+    input_layernorm tensor real EXAONE4 checkpoints don't ship)."""
     n = int(d["num_hidden_layers"])
-    period = int(d.get("global_layer_period") or 4)
-    global_layers = tuple((i + 1) % period == 0 for i in range(n))
+    pattern = d.get("sliding_window_pattern") or d.get("global_layer_period") or 4
+    if isinstance(pattern, str):
+        # HF documents the string form "LLLG" (L=local/sliding, G=global),
+        # which released EXAONE-4.0 configs ship
+        global_layers = tuple(pattern[i % len(pattern)].upper() == "G"
+                              for i in range(n))
+    else:
+        global_layers = tuple((i + 1) % int(pattern) == 0 for i in range(n))
     return ModelConfig(**_base(
-        d, "exaone4", qk_norm=True,
+        d, "exaone4", qk_norm=True, norm_style="post",
         sliding_window=int(d.get("sliding_window", 4096)),
         global_layers=global_layers, global_rope=False,
     ))
@@ -271,7 +289,12 @@ def _qwen3_5_common(d, arch, **over):
     """Qwen3.5 wraps the text fields in text_config; hybrid GDN linear
     attention from layer_types (ref: qwen3_5/config.rs:95-160)."""
     tc = d.get("text_config", d)
+    # Qwen3.5 nests rope fields in rope_parameters; Qwen3-Next ships them
+    # flat at the top level (verified against transformers Qwen3NextConfig)
     rp = tc.get("rope_parameters") or {}
+    rope_theta = float(rp.get("rope_theta", tc.get("rope_theta", 10000.0)))
+    partial_rotary = float(rp.get(
+        "partial_rotary_factor", tc.get("partial_rotary_factor", 0.25)))
     layer_types = tuple(tc.get("layer_types", ()))
     linear = None
     if layer_types:
@@ -285,8 +308,8 @@ def _qwen3_5_common(d, arch, **over):
         )
     base = _base(
         tc, arch,
-        rope_theta=float(rp.get("rope_theta", 10000.0)),
-        partial_rotary_factor=float(rp.get("partial_rotary_factor", 0.25)),
+        rope_theta=rope_theta,
+        partial_rotary_factor=partial_rotary,
         residual_rms_norm=True,
         model_prefix="model.language_model",
         linear_attn=linear,
@@ -306,6 +329,16 @@ def _qwen3_5(d):
     return _qwen3_5_common(d, "qwen3_5")
 
 
+def _qwen3_next(d):
+    """Qwen3-Next (HF Qwen3NextForCausalLM): same GDN-hybrid compute as
+    Qwen3.5 but a flat config (no text_config wrapper) and plain `model.`
+    prefix; MoE when num_experts > 0 (numerics pinned vs transformers in
+    tests/test_hf_parity.py)."""
+    arch = "qwen3_5_moe" if int(d.get("num_experts") or 0) > 0 else "qwen3_5"
+    cfg = _qwen3_5_moe(d) if arch == "qwen3_5_moe" else _qwen3_5(d)
+    return dataclasses.replace(cfg, model_prefix="model")
+
+
 def _qwen3_5_moe(d):
     tc = d.get("text_config", d)
     return _qwen3_5_common(
@@ -315,7 +348,9 @@ def _qwen3_5_moe(d):
         moe_intermediate_size=int(tc["moe_intermediate_size"]),
         norm_topk_prob=bool(tc.get("norm_topk_prob", True)),
         shared_expert_intermediate_size=tc.get("shared_expert_intermediate_size"),
-        moe_gate_act="sigmoid",
+        # router is softmax like Qwen3-MoE; sigmoid gates only the shared
+        # expert (ref: qwen3_5_moe/moe.rs:10-14; HF Qwen3NextSparseMoeBlock)
+        moe_gate_act="softmax",
         decoder_sparse_step=int(tc.get("decoder_sparse_step", 1)),
         mlp_only_layers=tuple(tc.get("mlp_only_layers", ())),
     )
@@ -330,6 +365,7 @@ ARCH_ADAPTERS = {
     "Qwen3MoeForCausalLM": _qwen3_moe,
     "Qwen3_5ForConditionalGeneration": _qwen3_5,
     "Qwen3_5MoeForConditionalGeneration": _qwen3_5_moe,
+    "Qwen3NextForCausalLM": _qwen3_next,
     "Phi3ForCausalLM": _phi4,
     "Phi4ForCausalLM": _phi4,
     "MistralForCausalLM": _mistral,

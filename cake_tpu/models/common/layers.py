@@ -153,7 +153,14 @@ def init_params(cfg: ModelConfig, key, dtype=jnp.bfloat16,
 def make_rope(cfg: ModelConfig) -> dict:
     cos, sin = rope_tables(cfg.max_seq_len, cfg.rotary_dim, cfg.rope_theta,
                            cfg.rope_scaling)
-    return {"cos": cos, "sin": sin}
+    rope = {"cos": cos, "sin": sin}
+    if cfg.local_rope_theta is not None:
+        # Gemma3 SWA layers: separate table at rope_local_base_freq, never
+        # scaled (HF rotary_emb_local; pinned by tests/test_hf_parity.py)
+        lcos, lsin = rope_tables(cfg.max_seq_len, cfg.rotary_dim,
+                                 cfg.local_rope_theta)
+        rope["cos_local"], rope["sin_local"] = lcos, lsin
+    return rope
 
 
 # ---------------------------------------------------------------------------
@@ -197,8 +204,10 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
 
     positions = pos0 + jnp.arange(s, dtype=jnp.int32)
     if spec.use_rope:
-        q = apply_rope(q, rope["cos"], rope["sin"], positions, cfg.rotary_dim)
-        k = apply_rope(k, rope["cos"], rope["sin"], positions, cfg.rotary_dim)
+        suf = "_local" if spec.local_rope_table else ""
+        cos, sin = rope["cos" + suf], rope["sin" + suf]
+        q = apply_rope(q, cos, sin, positions, cfg.rotary_dim)
+        k = apply_rope(k, cos, sin, positions, cfg.rotary_dim)
 
     # Attend over [previous cache ; in-pass K/V]. In-pass keys must be
     # presented in full (not through the ring): with a window-sized ring,

@@ -148,6 +148,51 @@ def gdn_forward(cfg, p, x, layer_cache, pos0, valid_len=None):
 # -- checkpoint IO -----------------------------------------------------------
 
 
+def flat_from_hf_qkvz_ba(cfg, qkvz, ba):
+    """HF Qwen3Next `in_proj_qkvz`/`in_proj_ba` weights -> our fused
+    [Q|K|V|a|b|z] row order.
+
+    HF packs rows per key head as [q(dk), k(dk), v(n*dv), z(n*dv)] and
+    [b(n), a(n)] with n = Hv/Hk (Qwen3NextGatedDeltaNet.
+    fix_query_key_value_ordering); we keep flat Q/K/V blocks so the conv
+    channels and scan heads slice without a gather per step.
+    """
+    import numpy as np
+    la, key_dim, value_dim, conv_dim, total = _dims(cfg)
+    ng, hv = la.num_key_heads, la.num_value_heads
+    n, dk, dv = hv // ng, la.key_head_dim, la.value_head_dim
+    h = qkvz.shape[-1]
+    qkvz = np.asarray(qkvz).reshape(ng, 2 * dk + 2 * n * dv, h)
+    q, k = qkvz[:, :dk], qkvz[:, dk:2 * dk]
+    v, z = qkvz[:, 2 * dk:2 * dk + n * dv], qkvz[:, 2 * dk + n * dv:]
+    ba = np.asarray(ba).reshape(ng, 2 * n, h)
+    b, a = ba[:, :n], ba[:, n:]
+    return np.concatenate([
+        q.reshape(key_dim, h), k.reshape(key_dim, h),
+        v.reshape(value_dim, h), a.reshape(hv, h), b.reshape(hv, h),
+        z.reshape(value_dim, h)], axis=0)
+
+
+def hf_qkvz_ba_from_flat(cfg, in_proj):
+    """Inverse of flat_from_hf_qkvz_ba (test + export use)."""
+    import numpy as np
+    la, key_dim, value_dim, conv_dim, total = _dims(cfg)
+    ng, hv = la.num_key_heads, la.num_value_heads
+    n, dk, dv = hv // ng, la.key_head_dim, la.value_head_dim
+    w = np.asarray(in_proj)
+    h = w.shape[-1]
+    q = w[:key_dim].reshape(ng, dk, h)
+    k = w[key_dim:2 * key_dim].reshape(ng, dk, h)
+    v = w[2 * key_dim:conv_dim].reshape(ng, n * dv, h)
+    a = w[conv_dim:conv_dim + hv].reshape(ng, n, h)
+    b = w[conv_dim + hv:conv_dim + 2 * hv].reshape(ng, n, h)
+    z = w[conv_dim + 2 * hv:].reshape(ng, n * dv, h)
+    qkvz = np.concatenate([q, k, v, z], axis=1).reshape(
+        2 * key_dim + 2 * value_dim, h)
+    ba = np.concatenate([b, a], axis=1).reshape(2 * hv, h)
+    return qkvz, ba
+
+
 def load_gdn_params(loader, lp: str):
     """lp = '<prefix>.layers.<i>'; weights under `.linear_attn.`
     (ref: qwen3_5 weight names; fused in_proj or split
@@ -159,6 +204,11 @@ def load_gdn_params(loader, lp: str):
     g = loader._get_dense      # concat/transpose below need dense arrays
     if loader._has(f"{base}.in_proj.weight"):
         in_proj = g(f"{base}.in_proj.weight")
+    elif loader._has(f"{base}.in_proj_qkvz.weight"):
+        # HF Qwen3Next layout: per-key-head interleaved qkvz + ba
+        in_proj = flat_from_hf_qkvz_ba(
+            cfg, g(f"{base}.in_proj_qkvz.weight"),
+            g(f"{base}.in_proj_ba.weight"))
     else:
         in_proj = np.concatenate([
             g(f"{base}.in_proj_qkv.weight"), g(f"{base}.in_proj_a.weight"),

@@ -32,8 +32,14 @@ def inv_frequencies(rotary_dim: int, theta: float,
     """Per-pair inverse frequencies, with optional llama3 smoothing
     (ref: cache.rs:49-80)."""
     inv = 1.0 / (theta ** (np.arange(0, rotary_dim, 2, dtype=np.float64) / rotary_dim))
-    if scaling is not None and (scaling.rope_type in (None, "llama3", "default")) \
-            and scaling.factor and scaling.factor != 1.0:
+    if scaling is None or not scaling.factor or scaling.factor == 1.0:
+        return inv.astype(np.float64)
+    if scaling.rope_type == "linear":
+        # uniform position interpolation (HF "linear"; Gemma3 global layers)
+        inv = inv / scaling.factor
+    elif scaling.rope_type == "default":
+        pass                        # HF "default" ignores the factor
+    elif scaling.rope_type in (None, "llama3"):
         low_wavelen = scaling.original_max_position_embeddings / scaling.low_freq_factor
         high_wavelen = scaling.original_max_position_embeddings / scaling.high_freq_factor
         wavelen = 2.0 * np.pi / inv
@@ -44,6 +50,14 @@ def inv_frequencies(rotary_dim: int, theta: float,
         mid = (1.0 - smooth) * inv / scaling.factor + smooth * inv
         is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
         inv = np.where(is_mid, mid, scaled)
+    else:
+        # unimplemented scaling flavors (yarn, dynamic, ...) degrade to
+        # unscaled RoPE with a warning — same tolerance posture as the
+        # unknown-architecture fallback (config.py ARCH_ADAPTERS)
+        import logging
+        logging.getLogger(__name__).warning(
+            "rope_type %r not implemented; using unscaled RoPE",
+            scaling.rope_type)
     return inv.astype(np.float64)
 
 

@@ -54,7 +54,13 @@ class CakeTokenizer:
         if self._hf is not None:
             return self._hf.encode(text,
                                    add_special_tokens=add_special_tokens)
-        raise RuntimeError("no tokenizer available")
+        # tokenizer-less model dir (synthetic checkpoints, smoke drives):
+        # accept a whitespace-separated raw token-id prompt
+        parts = text.split()
+        if parts and all(p.isdigit() for p in parts):
+            return [int(p) for p in parts]
+        raise RuntimeError(
+            "no tokenizer available (pass raw token ids, e.g. '11 23 5')")
 
     def encode_chat_prompt(self, prompt: str) -> list[int]:
         """Templated chat strings already contain their special tokens —
@@ -65,7 +71,9 @@ class CakeTokenizer:
     def decode(self, ids) -> str:
         if self._tok is not None:
             return self._tok.decode(list(ids), skip_special_tokens=False)
-        return self._hf.decode(list(ids))
+        if self._hf is not None:
+            return self._hf.decode(list(ids))
+        return " ".join(str(int(i)) for i in ids)   # tokenizer-less fallback
 
     def apply_chat(self, messages: list[dict]) -> str:
         if self._hf is not None and self.chat_template:

@@ -68,13 +68,18 @@ def test_mistral_sliding_window():
 
 
 def test_gemma3_pattern():
-    """Every 6th layer global; local = SWA + no RoPE (reference parity)."""
+    """Every 6th layer global; local = SWA + RoPE at rope_local_base_freq
+    (HF ground truth — tests/test_hf_parity.py; the reference skips local
+    RoPE, which real Gemma3 checkpoints were not trained with)."""
     c = config_from_hf_dict(base_dict(
         architectures=["Gemma3ForCausalLM"], num_hidden_layers=12,
-        sliding_window=1024, query_pre_attn_scalar=256))
+        sliding_window=1024, query_pre_attn_scalar=256,
+        rope_local_base_freq=10000.0, rope_theta=1_000_000.0))
     specs = c.layer_specs()
     assert [s.kind for s in specs] == (["swa"] * 5 + ["full"]) * 2
-    assert not specs[0].use_rope and specs[5].use_rope
+    assert specs[0].use_rope and specs[0].local_rope_table
+    assert specs[5].use_rope and not specs[5].local_rope_table
+    assert c.local_rope_theta == 10000.0 and c.rope_theta == 1_000_000.0
     assert c.norm_style == "sandwich" and c.residual_rms_norm
     assert c.hidden_act == "gelu_tanh" and c.tie_word_embeddings
     assert abs(c.embed_scale - 4096 ** 0.5) < 1e-6
@@ -96,6 +101,30 @@ def test_exaone4_pattern():
     assert specs[7].kind == "full" and specs[30].kind == "swa"
     assert specs[0].use_rope and not specs[3].use_rope   # global = NoPE
     assert c.qk_norm
+    # HF Exaone4DecoderLayer is post-norm (tests/test_hf_parity.py)
+    assert c.norm_style == "post"
+
+
+def test_exaone4_string_pattern():
+    """Released EXAONE-4.0 configs ship sliding_window_pattern='LLLG'."""
+    c = config_from_hf_dict(base_dict(
+        architectures=["Exaone4ForCausalLM"], num_hidden_layers=8,
+        sliding_window=4096, sliding_window_pattern="LLLG"))
+    assert c.global_layers == (False, False, False, True) * 2
+
+
+def test_qwen3_next_flat_rope_fields():
+    """Qwen3-Next ships rope_theta / partial_rotary_factor flat at the top
+    level (no rope_parameters dict) — they must not fall back to defaults."""
+    c = config_from_hf_dict(base_dict(
+        architectures=["Qwen3NextForCausalLM"], rope_theta=10_000_000.0,
+        partial_rotary_factor=0.5, head_dim=16,
+        layer_types=["linear_attention", "full_attention"] * 2,
+        linear_num_key_heads=2, linear_key_head_dim=16,
+        linear_num_value_heads=4, linear_value_head_dim=16))
+    assert c.rope_theta == 10_000_000.0
+    assert c.partial_rotary_factor == 0.5
+    assert c.model_prefix == "model"
 
 
 def test_qwen3_5_nested_text_config():

@@ -3,7 +3,8 @@ python - <<'PY'
 import os
 if os.environ.get("CAKE_BENCH_CPU") == "1":
     import jax; jax.config.update("jax_platforms", "cpu")
-import json, time, jax, jax.numpy as jnp
+import json, time
+import numpy as np, jax, jax.numpy as jnp
 from cake_tpu.ops.fp8 import quant_fp8_blockwise
 from cake_tpu.ops.linear import linear
 k = jax.random.PRNGKey(0)
@@ -13,10 +14,10 @@ x = jax.random.normal(k, (1, 16, 1024), jnp.bfloat16)
 f8 = jax.jit(lambda x: linear(x, {"fp8": wq, "scale_inv": si}))
 fb = jax.jit(lambda x, w: linear(x, w))
 wb = w.astype(jnp.bfloat16)
-f8(x).block_until_ready(); fb(x, wb).block_until_ready()
+np.asarray(f8(x)); np.asarray(fb(x, wb))
 def t(f, *a):
     t0 = time.perf_counter()
-    for _ in range(20): f(*a).block_until_ready()
+    for _ in range(20): np.asarray(f(*a))
     return (time.perf_counter() - t0) / 20 * 1e3
 print(json.dumps({"fp8_matmul_ms": round(t(f8, x), 4),
                   "bf16_matmul_ms": round(t(fb, x, wb), 4)}))

@@ -3,18 +3,21 @@ python - <<'PY'
 import os
 if os.environ.get("CAKE_BENCH_CPU") == "1":
     import jax; jax.config.update("jax_platforms", "cpu")
-import json, time, numpy as np, jax.numpy as jnp
+import json, time
+import numpy as np, jax.numpy as jnp
 from __graft_entry__ import FLAGSHIP
-from cake_tpu.models import TextModel, config_from_hf_dict
-cfg = config_from_hf_dict(FLAGSHIP)
-m = TextModel(cfg, dtype=jnp.bfloat16, max_cache_len=2048)
+from cake_tpu.models import TextModel, config_from_hf_dict, tiny_config
+import jax
+cpu = jax.default_backend() != "tpu"
+cfg = tiny_config("qwen3") if cpu else config_from_hf_dict(FLAGSHIP)
+m = TextModel(cfg, dtype=jnp.bfloat16, max_cache_len=128 if cpu else 2048)
 out = {}
-for n in (512, 2048):
+for n in ((32, 64) if cpu else (512, 2048)):
     toks = list(np.random.default_rng(0).integers(0, 1000, n))
     m.prefill(m.new_cache(), toks)                    # compile
     t0 = time.perf_counter()
     for _ in range(3):
-        m.prefill(m.new_cache(), toks)[0].block_until_ready()
+        np.asarray(m.prefill(m.new_cache(), toks)[0])
     out[f"ttft_{n}_ms"] = round((time.perf_counter() - t0) / 3 * 1e3, 1)
 print(json.dumps(out))
 PY

@@ -1,0 +1,145 @@
+"""Distributed-plane throughput: localhost 2-worker decode tok/s with a
+per-hop RTT breakdown (VERDICT r3 item 6 — the reference's analog is the
+`--ignored` protocol throughput benches in cake-core/tests/protocol.rs).
+
+The number tracks PROTOCOL + scheduling overhead, not model compute: the
+tiny model makes per-stage forward time negligible, so tok/s here is
+dominated by the per-token master->worker->master round trips the
+architecture pays (one per contiguous remote range, ref:
+text_model.rs:298-331). Run on CPU; commit the JSON (BENCH_CLUSTER_r*.json)
+so regressions in framing/serialization show up between rounds.
+
+Usage: python benches/bench_cluster.py [--tokens N]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, ".")
+
+
+def start_worker(name, key, ready, cache_root):
+    from cake_tpu.cluster.worker import WorkerServer
+    holder = {}
+
+    def run():
+        async def main():
+            # per-worker cache root: two workers on ONE host would race on
+            # the shared content-keyed cache (different layer subsets,
+            # same key) — real deployments have one worker per host
+            server = WorkerServer(name, key, port=0, advertise=False,
+                                  cache_root=cache_root)
+            await server.start()
+            holder["port"] = server.port
+            holder["loop"] = asyncio.get_running_loop()
+            holder["server"] = server
+            ready.set()
+            await server.serve_forever()
+        asyncio.run(main())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return holder, t
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=128)
+    args = ap.parse_args()
+
+    import tempfile
+
+    from cake_tpu.cluster.master import DistributedTextModel, master_setup
+    from cake_tpu.models import SamplingConfig, TextModel, tiny_config
+    from cake_tpu.models.common.layers import init_params
+    from cake_tpu.utils.export import params_to_hf_tensors
+    from cake_tpu.utils.safetensors_io import save_safetensors
+
+    cfg = tiny_config("qwen3")
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    mdir = tempfile.mkdtemp(prefix="bench-cluster-")
+    save_safetensors(f"{mdir}/model.safetensors",
+                     params_to_hf_tensors(cfg, params))
+    with open(f"{mdir}/config.json", "w") as f:
+        json.dump({"architectures": ["Qwen3ForCausalLM"], "vocab_size": 256,
+                   "hidden_size": 64, "intermediate_size": 128,
+                   "num_hidden_layers": 4, "num_attention_heads": 4,
+                   "num_key_value_heads": 2, "rms_norm_eps": 1e-5,
+                   "rope_theta": 10000.0, "max_position_embeddings": 512,
+                   "eos_token_id": 255}, f)
+
+    r0, r1 = threading.Event(), threading.Event()
+    h0, t0 = start_worker("w0", "bench", r0, f"{mdir}/wc0")
+    h1, t1 = start_worker("w1", "bench", r1, f"{mdir}/wc1")
+    assert r0.wait(30) and r1.wait(30)
+    workers = [
+        {"name": "w0", "host": "127.0.0.1", "port": h0["port"],
+         "caps": {"backend": "cpu", "device": "cpu",
+                  "memory_bytes": 8 << 30, "tflops": 100.0}},
+        {"name": "w1", "host": "127.0.0.1", "port": h1["port"],
+         "caps": {"backend": "cpu", "device": "cpu",
+                  "memory_bytes": 8 << 30, "tflops": 100.0}},
+    ]
+    setup = master_setup(mdir, "bench", cfg, workers,
+                         assignments={"w0": (0, 2), "w1": (2, 4)},
+                         dtype_str="f32", max_cache_len=512)
+    dist = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                dtype=jnp.float32, max_cache_len=512)
+    prompt = [11, 23, 5, 190, 77, 3]
+    scfg = SamplingConfig(temperature=0.0)
+    # warm at FULL length: every growth bucket the timed run will touch
+    # compiles here (master + both workers), not inside the timing
+    dist.generate(prompt, max_new_tokens=args.tokens, sampling=scfg)
+    for c in setup.clients:
+        c.rtts.clear()          # stats cover the timed run only
+
+    t_start = time.monotonic()
+    toks, stats = dist.generate(prompt, max_new_tokens=args.tokens,
+                                sampling=scfg)
+    wall = time.monotonic() - t_start
+
+    # all-local reference on the same host: isolates protocol overhead
+    local = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=512)
+    local.generate(prompt, max_new_tokens=8, sampling=scfg)
+    _, lstats = local.generate(prompt, max_new_tokens=args.tokens,
+                               sampling=scfg)
+
+    n = stats["decode_tokens"]
+    result = {
+        "metric": "cluster_2worker_decode",
+        "value": round(stats["tok_per_s"], 1), "unit": "tok/s",
+        "vs_baseline": None,      # reference publishes no protocol numbers
+        "decode_tokens": n,
+        "wall_s": round(wall, 2),
+        "per_token_ms": round(stats["decode_s"] / max(n, 1) * 1e3, 2),
+        "stage_rtts": stats["stage_rtts"],
+        "local_same_model_tok_s": round(lstats["tok_per_s"], 1),
+        "note": "tiny model on localhost CPU: the number is protocol + "
+                "per-hop scheduling overhead (2 TCP round trips per "
+                "token), tracked round-over-round",
+    }
+    print(json.dumps(result))
+    for c in setup.clients:
+        c.close()
+    for holder, t in ((h0, t0), (h1, t1)):
+        loop, srv = holder.get("loop"), holder.get("server")
+        if loop and srv:
+            asyncio.run_coroutine_threadsafe(srv.stop(), loop)
+        t.join(timeout=5)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,17 +34,28 @@ async def images_generations(request: web.Request) -> web.Response:
     w, h = _parse_size(body.get("size", "1024x1024"))
     fmt = body.get("response_format", "b64_json")
 
+    kwargs = dict(
+        width=w, height=h,
+        steps=int(body.get("steps", 20)),
+        guidance=float(body.get("guidance", body.get("cfg_scale", 3.5))),
+        seed=body.get("seed"),
+        negative_prompt=body.get("negative_prompt"),
+    )
+    # SD-only debug surface (ref: sd.rs intermediary_images / --sd-tracing):
+    # OPERATOR-set via CLI flags on ApiState — request bodies cannot point
+    # the server at filesystem paths or make it dump per-step files
+    import inspect
+    sig = inspect.signature(state.image_model.generate_image).parameters
+    if "intermediate_every" in sig and state.sd_intermediate_every:
+        kwargs["intermediate_every"] = state.sd_intermediate_every
+    if "trace_dir" in sig and state.sd_trace_dir:
+        kwargs["trace_dir"] = state.sd_trace_dir
+
     async with state.lock:
         import asyncio
         loop = asyncio.get_running_loop()
-        image = await loop.run_in_executor(None, lambda: state.image_model.generate_image(
-            prompt,
-            width=w, height=h,
-            steps=int(body.get("steps", 20)),
-            guidance=float(body.get("guidance", body.get("cfg_scale", 3.5))),
-            seed=body.get("seed"),
-            negative_prompt=body.get("negative_prompt"),
-        ))
+        image = await loop.run_in_executor(
+            None, lambda: state.image_model.generate_image(prompt, **kwargs))
 
     buf = io.BytesIO()
     image.save(buf, format="PNG")

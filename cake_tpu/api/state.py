@@ -23,6 +23,12 @@ class ApiState:
     audio_model: Any = None
     topology: Any = None            # cluster Topology or None
     voices_dir: str | None = None   # server-side voice-prompt directory
+    # SD debug surface — OPERATOR-set (CLI --sd-intermediate-every /
+    # --sd-trace-dir), never taken from request bodies: trace_dir writes
+    # files server-side, a path clients must not choose (ref: the
+    # reference's --sd-tracing CLI flag, not an API field)
+    sd_intermediate_every: int = 0
+    sd_trace_dir: str | None = None
     layer_tensors: dict | None = None   # per-layer tensor detail for the UI
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     created: int = 0

@@ -93,7 +93,9 @@ def cmd_serve(args) -> int:
     image_model = audio_model = None
     if args.image_model:
         from .runtime import build_image_model
-        image_model = build_image_model(args.image_model, dtype=args.dtype)
+        image_model = build_image_model(
+            args.image_model, dtype=args.dtype,
+            fp8_native=getattr(args, "fp8_native", False))
     if args.audio_model:
         from .runtime import build_audio_model
         audio_model = build_audio_model(args.audio_model, dtype=args.dtype)
@@ -109,7 +111,9 @@ def cmd_serve(args) -> int:
     state = ApiState(model=gen, tokenizer=tokenizer, model_id=model_id,
                      topology=topo, image_model=image_model,
                      audio_model=audio_model, voices_dir=args.voices_dir,
-                     layer_tensors=layer_tensors)
+                     layer_tensors=layer_tensors,
+                     sd_intermediate_every=args.sd_intermediate_every,
+                     sd_trace_dir=args.sd_trace_dir)
     serve(state, host=args.host, port=args.port, basic_auth=args.basic_auth)
     return 0
 
@@ -222,6 +226,12 @@ def main(argv=None) -> int:
                         "served by name via the API")
     p.add_argument("--audio-model", default=None,
                    help="TTS model dir ('demo:vibevoice' | 'demo:luxtts')")
+    p.add_argument("--sd-intermediate-every", type=int, default=0,
+                   help="save the in-progress SD image every N denoise "
+                        "steps (ref: intermediary_images)")
+    p.add_argument("--sd-trace-dir", default=None,
+                   help="write a JAX profiler trace of SD generation here "
+                        "(ref: --sd-tracing)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("worker", help="run as a cluster worker")

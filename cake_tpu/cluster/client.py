@@ -38,6 +38,8 @@ class RemoteStage:
         self.sock: socket.socket | None = None
         self.info: dict = {}
         self._rid = 0
+        from collections import deque
+        self.rtts: deque = deque(maxlen=512)
 
     # -- connection --------------------------------------------------------
 
@@ -116,15 +118,30 @@ class RemoteStage:
         """cache is managed worker-side per connection; the local `cache`
         slot is passed through untouched (None)."""
         self._rid += 1
+        t0 = time.monotonic()
         proto.write_frame_sync(self.sock, proto.forward(
             np.asarray(x), int(pos0),
             None if valid_len is None else int(valid_len), self._rid))
         msg = proto.read_frame_sync(self.sock)
+        self.rtts.append(time.monotonic() - t0)
         if msg.get("t") == "worker_error":
             raise RuntimeError(f"worker {self.name}: {msg['error']}")
         if msg.get("rid", self._rid) != self._rid:
             raise proto.ProtocolError("response id mismatch")
         return proto.unpack_tensor(msg["x"]), cache
+
+    def rtt_stats(self) -> dict:
+        """Per-hop round-trip accounting (wire + worker compute; ref:
+        client.rs:96-104 per-client send/recv timing). mean vs p50 spread
+        flags bimodal stalls (Nagle/delayed-ACK class of bugs)."""
+        if not self.rtts:
+            return {"count": 0}
+        arr = sorted(self.rtts)
+        return {"count": len(arr),
+                "p50_ms": round(arr[len(arr) // 2] * 1e3, 2),
+                "p95_ms": round(arr[int(len(arr) * 0.95)] * 1e3, 2),
+                "mean_ms": round(sum(arr) / len(arr) * 1e3, 2),
+                "min_ms": round(arr[0] * 1e3, 2)}
 
     def goodbye(self):
         try:

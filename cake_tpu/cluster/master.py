@@ -62,6 +62,7 @@ class DistributedTextModel:
         self.dtype = dtype
         self.max_cache_len = max_cache_len
         self.mesh = mesh
+        self._kv_len = max_cache_len     # reset()/generate() re-bucket
         # embed + head replicate over the in-host tp mesh so the hidden
         # state entering/leaving the sharded local stages is replicated
         from ..parallel.sharding import shard_params
@@ -84,15 +85,33 @@ class DistributedTextModel:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def reset(self):
+    def reset(self, kv_len: int | None = None):
+        """Fresh caches everywhere; local stage caches start at the given
+        cache-length bucket and grow bucket-by-bucket during decode (same
+        lever as TextModel's growth bucketing — short generations never
+        attend over max_cache_len of mostly-empty buffer)."""
         from ..parallel.sharding import shard_cache
+        self._kv_len = min(kv_len or self.max_cache_len, self.max_cache_len)
         for s in self.stages:
             if s.kind == "local":
                 s.cache = shard_cache(
-                    init_cache(self.cfg, 1, self.max_cache_len,
+                    init_cache(self.cfg, 1, self._kv_len,
                                self.dtype, (s.start, s.end)), self.mesh)
             else:
                 s.runner.goodbye()
+
+    def _grow_local(self, new_len: int):
+        from ..models.common.cache import grow_cache
+        from ..parallel.sharding import shard_cache
+        new_len = min(new_len, self.max_cache_len)
+        if new_len <= self._kv_len:
+            return
+        for s in self.stages:
+            if s.kind == "local":
+                s.cache = shard_cache(
+                    grow_cache(self.cfg, s.cache, new_len,
+                               (s.start, s.end)), self.mesh)
+        self._kv_len = new_len
 
     # -- forward ------------------------------------------------------------
 
@@ -103,8 +122,7 @@ class DistributedTextModel:
         # unwrapped caches)
         flash_mode = "off"
         if valid_len is not None:
-            flash_mode = select_flash_mode(pos0, x.shape[1],
-                                           self.max_cache_len)
+            flash_mode = select_flash_mode(pos0, x.shape[1], self._kv_len)
         for s in self.stages:
             if s.kind == "local":
                 x, s.cache = s.runner.forward_hidden(
@@ -117,9 +135,7 @@ class DistributedTextModel:
 
     def prefill_logits(self, token_ids: list[int], pos0: int = 0):
         n = len(token_ids)
-        # stage caches are all allocated at max_cache_len (no growth
-        # bucketing on the distributed path), so capacity == max_cache_len
-        bkt = check_prefill_bounds(n, pos0, None, self.max_cache_len)
+        bkt = check_prefill_bounds(n, pos0, self._kv_len, self.max_cache_len)
         padded = np.zeros((1, bkt), np.int32)
         padded[0, :n] = token_ids
         x = self._embed(self.params, jnp.asarray(padded))
@@ -139,7 +155,9 @@ class DistributedTextModel:
                  rng=None, **_):
         scfg = sampling or SamplingConfig()
         rng = self._rng if rng is None else rng
-        self.reset()
+        from ..models.common.text_model import bucket_for
+        self.reset(kv_len=bucket_for(len(prompt_ids) + 17,
+                                     self.max_cache_len))
         out: list[int] = []
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
 
@@ -160,6 +178,8 @@ class DistributedTextModel:
         budget = self.max_cache_len - len(prompt_ids) - 1
         max_new_tokens = min(max_new_tokens, max(budget, 1))
         while not self.cfg.is_eos(tid) and len(out) < max_new_tokens:
+            if pos + 1 > self._kv_len:
+                self._grow_local(bucket_for(pos + 2, self.max_cache_len))
             logits = self.decode_logits(tid, pos)
             rng, sk = jax.random.split(rng)
             tok = self._sample(logits[0], sk, recent, scfg)
@@ -172,7 +192,11 @@ class DistributedTextModel:
         dt = time.monotonic() - t1
         stats = {"ttft_s": ttft, "decode_tokens": len(out) - 1,
                  "decode_s": dt,
-                 "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0}
+                 "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0,
+                 "stage_rtts": {
+                     f"{s.runner.name}[{s.start}:{s.end}]":
+                         s.runner.rtt_stats()
+                     for s in self.stages if s.kind == "remote"}}
         return out, stats
 
     def _mk_token(self, tid: int) -> Token:

@@ -87,9 +87,11 @@ class VibeVoiceConfig:
 
     @property
     def enc_depths_resolved(self) -> tuple[int, ...]:
-        """Per-stage encoder block counts; the decoder's depths reversed
-        when the config carries no explicit encoder_depths."""
-        return self.enc_depths or tuple(reversed(self.vae_depths))
+        """Per-stage encoder block counts; checkpoints without explicit
+        encoder_depths get 3 blocks per stage, matching the reference's
+        fallback (ref: vae_encoder.rs parse_depths [3]*num_stages) so both
+        implementations build the same stage layout for such checkpoints."""
+        return self.enc_depths or (3,) * (len(self.vae_ratios) + 1)
 
     @property
     def hop(self) -> int:
@@ -492,14 +494,16 @@ class VibeVoiceTTS:
         base_cfg, tts_cfg = cfg.lm_base, cfg.lm_tts
 
         @jax.jit
-        def _base_fwd(p, x, cache, pos):
-            x, cache = forward_layers(base_cfg, p, x, cache, pos)
+        def _base_fwd(p, x, cache, pos, valid_len=None):
+            x, cache = forward_layers(base_cfg, p, x, cache, pos,
+                                      valid_len=valid_len)
             return rms_norm(x, p["norm"]["weight"],
                             base_cfg.rms_norm_eps), cache
 
         @jax.jit
-        def _tts_fwd(p, x, cache, pos):
-            x, cache = forward_layers(tts_cfg, p, x, cache, pos)
+        def _tts_fwd(p, x, cache, pos, valid_len=None):
+            x, cache = forward_layers(tts_cfg, p, x, cache, pos,
+                                      valid_len=valid_len)
             return rms_norm(x, p["norm"]["weight"],
                             tts_cfg.rms_norm_eps), cache
 
@@ -597,10 +601,22 @@ class VibeVoiceTTS:
             # speech-start token), so guidance amplifies the voice
             # direction instead of subtracting it out
             emb = clone_emb + self._type_embed(0).astype(self.dtype)
+            # pad the reference to an 8-frame bucket so the jitted LM
+            # prefill compiles per bucket, not per distinct clip length
+            # (mirrors the acoustic encoder's 8-hop grid one step up);
+            # valid_len masks the padded frames out of the KV scatter and
+            # the position advance, so numerics match the exact-length
+            # prefill
+            n_true = emb.shape[1]
+            n_pad = -(-n_true // 8) * 8
+            if n_pad != n_true:
+                emb = jnp.pad(emb, ((0, 0), (0, n_pad - n_true), (0, 0)))
+            vl = jnp.asarray(n_true, jnp.int32)
             _, base_cache = self._base_fwd(self.params["base"], emb,
-                                           base_cache, base_cache["pos"])
+                                           base_cache, base_cache["pos"],
+                                           valid_len=vl)
             _, tts_cache = self._tts_fwd(self.params["tts"], emb, tts_cache,
-                                         tts_cache["pos"])
+                                         tts_cache["pos"], valid_len=vl)
 
         text_type = self._type_embed(1)
         speech_type = self._type_embed(0)
@@ -699,20 +715,29 @@ class VibeVoiceTTS:
             samples = np.pad(samples, (0, need - len(samples)))
         lat = self._encode_audio(self.params["vae_enc"],
                                  jnp.asarray(samples[None], self.dtype))
-        lat = lat[:, :n_true]
         sf = self.params["speech_scaling_factor"].astype(self.dtype)
         bf = self.params["speech_bias_factor"].astype(self.dtype)
+        # scale + connector run on the bucket-padded frames (both are
+        # per-frame pointwise) so they compile per bucket too; the exact
+        # clip's frames are sliced off last
         features = (lat + bf) * sf
         connected = self._connector(self.params["connector"], features)
-        return features, connected
+        return features[:, :n_true], connected[:, :n_true]
 
     def _voice_embeds(self, voice_wav: bytes):
         from ...utils.wav import decode_wav
         cfg = self.cfg
         samples, sr = decode_wav(voice_wav)
         if sr != cfg.sample_rate and len(samples) > 1:
-            # linear resample to the model rate — the encoder's hop/ratios
-            # are trained at cfg.sample_rate (24kHz)
+            # resample to the model rate (the encoder's hop/ratios are
+            # trained at 24kHz). Downsampling low-passes at the target
+            # Nyquist first (FFT brick-wall) so 44.1/48kHz references don't
+            # alias energy above 12kHz into the band.
+            if sr > cfg.sample_rate:
+                spec = np.fft.rfft(samples)
+                keep = int(len(spec) * cfg.sample_rate / sr)
+                spec[keep:] = 0.0
+                samples = np.fft.irfft(spec, n=len(samples))
             n_out = int(len(samples) * cfg.sample_rate / sr)
             samples = np.interp(
                 np.linspace(0, len(samples) - 1, max(n_out, 2)),

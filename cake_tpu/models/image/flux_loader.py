@@ -6,7 +6,7 @@ Supported layouts (ref: flux/config.rs Flux1ModelFile + flux1_prefixes):
     `model.diffusion_model.`, CLIP-L under `text_encoders.clip_l.
     transformer.`, T5-XXL under `text_encoders.t5xxl.transformer.`,
     autoencoder under `vae.`; FP8 tensors dequantized at load
-    (utils/mapping._dequant_read).
+    (utils/mapping.load_mapped_params fp8 read path).
   * BFL split layout: a transformer file with bare `double_blocks.*`
     names plus `ae.safetensors` (bare `decoder.*`), with CLIP/T5 in
     HF-layout subdirectories `clip/` and `t5/`.
@@ -205,16 +205,25 @@ def _shapes(init_fn):
     return jax.eval_shape(init_fn)
 
 
-def load_flux_params(ckpt: FluxCheckpoint, cfgs: dict, dtype=jnp.bfloat16):
+def load_flux_params(ckpt: FluxCheckpoint, cfgs: dict, dtype=jnp.bfloat16,
+                     fp8_native: bool = False):
     """Load transformer + VAE decoder (+ CLIP/T5 when present) pytrees with
-    full shape validation and coverage reporting."""
+    full shape validation and coverage reporting.
+
+    fp8_native keeps the transformer's float8-stored matmul weights
+    resident at 1 byte/param ({"fp8","scale_inv"} marker dicts dequantized
+    inside the jitted MMDiT matmuls) — flux1-dev-fp8 then occupies ~12 GB
+    HBM instead of ~24 (ref: native_dtype_backend.rs:1-26; the reference's
+    13.3 GB VRAM headline, docs/benchmarks/README.md:41-52). VAE and text
+    encoders are unaffected (stored bf16/f32 in the release bundles)."""
     mm_cfg, vae_cfg = cfgs["mmdit"], cfgs["vae"]
     mm_map = mmdit_mapping(mm_cfg, ckpt.transformer_prefix)
     params = {
         "transformer": load_mapped_params(
             ckpt.transformer, mm_map,
             _shapes(lambda: init_mmdit_params(mm_cfg, jax.random.PRNGKey(0),
-                                              dtype)), dtype),
+                                              dtype)), dtype,
+            fp8_native=fp8_native),
     }
     coverage_report(ckpt.transformer, mm_map, ckpt.transformer_prefix)
     # VAE decode runs in f32 (small, quality-sensitive — the reference also
@@ -421,7 +430,8 @@ class Flux1TextEncoder:
         return txt.astype(self.dtype), pooled.astype(self.dtype)
 
 
-def load_flux_image_model(path: str, dtype=jnp.bfloat16, t5_seq_len: int = 512):
+def load_flux_image_model(path: str, dtype=jnp.bfloat16, t5_seq_len: int = 512,
+                          fp8_native: bool = False):
     """Release-checkpoint FLUX.1 pipeline: detect layout, infer configs,
     load + validate every component, return a ready FluxImageModel
     (replaces the round-1 `demo:` escape hatch — ref: flux1.rs load path)."""
@@ -442,7 +452,7 @@ def load_flux_image_model(path: str, dtype=jnp.bfloat16, t5_seq_len: int = 512):
             f"{missing}. Bundle them (text_encoders.* prefixes) or provide "
             f"clip/ and t5/ subdirectories in HF layout.")
     cfgs = infer_flux_configs(ckpt)
-    params = load_flux_params(ckpt, cfgs, dtype)
+    params = load_flux_params(ckpt, cfgs, dtype, fp8_native=fp8_native)
     encoder = Flux1TextEncoder(cfgs, params, ckpt.model_dir,
                                t5_seq_len=t5_seq_len, dtype=dtype)
     pipe_cfg = FluxPipelineConfig(mmdit=cfgs["mmdit"], vae=cfgs["vae"])

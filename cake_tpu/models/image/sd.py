@@ -376,7 +376,24 @@ class SDImageModel:
                        steps: int | None = None, guidance: float | None = None,
                        seed: int | None = None, negative_prompt: str | None = None,
                        init_image=None, strength: float = 0.75,
-                       on_step=None):
+                       on_step=None, intermediate_every: int = 0,
+                       on_image=None, trace_dir: str | None = None):
+        """intermediate_every=N decodes and emits the in-progress image
+        every N denoise steps through on_image(step, pil_image) — without a
+        callback it is saved as sd_intermediate_<step>.png in the working
+        directory (ref: sd.rs:526-529 intermediary_images). trace_dir wraps
+        the whole generation in a JAX profiler trace (the TPU form of the
+        reference's --sd-tracing chrome-trace, sd.rs:358-384)."""
+        from ...utils.tracing import jax_trace
+        with jax_trace(trace_dir):
+            return self._generate(prompt, width, height, steps, guidance,
+                                  seed, negative_prompt, init_image,
+                                  strength, on_step, intermediate_every,
+                                  on_image)
+
+    def _generate(self, prompt, width, height, steps, guidance, seed,
+                  negative_prompt, init_image, strength, on_step,
+                  intermediate_every, on_image):
         cfg = self.cfg
         steps = steps or cfg.steps_default
         g = cfg.guidance_default if guidance is None else guidance
@@ -416,6 +433,14 @@ class SDImageModel:
             x = sch.step(eps, int(t), t_next, x)
             if on_step:
                 on_step(j + 1, len(ts))
+            if intermediate_every and (j + 1) % intermediate_every == 0 \
+                    and j + 1 < len(ts):
+                mid = self._decode(self.params["vae"], x)
+                pil = to_pil(np.asarray(mid[0, :, :height, :width]))
+                if on_image:
+                    on_image(j + 1, pil)
+                else:
+                    pil.save(f"sd_intermediate_{j + 1}.png")
 
         img = self._decode(self.params["vae"], x)
         return to_pil(np.asarray(img[0, :, :height, :width]))
@@ -430,10 +455,16 @@ class SDXLImageModel(SDImageModel):
 
     def __init__(self, cfg: SDPipelineConfig, params: dict,
                  text_encoder, text_encoder2, dtype=jnp.float32,
-                 seed: int = 0):
+                 seed: int = 0, force_zeros_for_empty_prompt: bool = True):
         super().__init__(cfg, params=params, text_encoder=text_encoder,
                          dtype=dtype, seed=seed)
         self.text_encoder2 = text_encoder2
+        # diffusers SDXL-base: an EMPTY negative prompt conditions on zero
+        # context + zero pooled instead of the encoded empty string
+        # (model_index.json force_zeros_for_empty_prompt, default true).
+        # The candle reference always encodes the uncond prompt — we follow
+        # diffusers, since that is what the released weights were tuned for.
+        self.force_zeros_for_empty_prompt = force_zeros_for_empty_prompt
 
     def _encode_prompt(self, prompt: str, negative_prompt: str,
                        width: int, height: int):
@@ -445,7 +476,10 @@ class SDXLImageModel(SDImageModel):
             return ctx, jnp.asarray(pooled2, self.dtype)
 
         ctx_p, pooled_p = enc(prompt)
-        ctx_n, pooled_n = enc(negative_prompt)
+        if not negative_prompt and self.force_zeros_for_empty_prompt:
+            ctx_n, pooled_n = jnp.zeros_like(ctx_p), jnp.zeros_like(pooled_p)
+        else:
+            ctx_n, pooled_n = enc(negative_prompt)
         # original size, crop top-left, target size (no cropping)
         time_ids = jnp.asarray([float(height), float(width), 0.0, 0.0,
                                 float(height), float(width)], jnp.float32)

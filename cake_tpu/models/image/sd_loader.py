@@ -337,8 +337,15 @@ def load_sd_image_model(path: str, dtype=jnp.float32):
         log.info("loaded SDXL checkpoint: base %d, mults %s, ctx %d, "
                  "depth %s", cfg.unet.base_channels, cfg.unet.channel_mults,
                  cfg.unet.context_dim, cfg.unet.transformer_depth)
+        force_zeros = True
+        mi_path = os.path.join(path, "model_index.json")
+        if os.path.exists(mi_path):
+            with open(mi_path) as f:
+                force_zeros = bool(json.load(f).get(
+                    "force_zeros_for_empty_prompt", True))
         return SDXLImageModel(cfg, params=params, text_encoder=encoder,
-                              text_encoder2=encoder2, dtype=dtype)
+                              text_encoder2=encoder2, dtype=dtype,
+                              force_zeros_for_empty_prompt=force_zeros)
     log.info("loaded SD checkpoint: base %d, mults %s, ctx %d",
              cfg.unet.base_channels, cfg.unet.channel_mults,
              cfg.unet.context_dim)

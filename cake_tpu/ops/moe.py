@@ -34,6 +34,13 @@ import jax.numpy as jnp
 RAGGED_MIN_TOKENS = 32
 
 
+def _ragged_enabled() -> bool:
+    """CAKE_MOE_RAGGED=0 pins every shape to the dense combine (escape
+    hatch if a backend mishandles ragged_dot_general)."""
+    import os
+    return os.environ.get("CAKE_MOE_RAGGED", "1") != "0"
+
+
 def router_topk(logits, k: int, norm_topk_prob: bool, gate_act: str = "softmax"):
     """logits: [T, E] -> (weights [T, k] f32, idx [T, k] int32).
 
@@ -80,7 +87,7 @@ def moe_ffn(x, router_weight, gate_proj, up_proj, down_proj, k: int,
                         preferred_element_type=jnp.float32)
     weights, idx = router_topk(logits, k, norm_topk_prob, gate_act)
 
-    if x.shape[0] >= RAGGED_MIN_TOKENS:
+    if x.shape[0] >= RAGGED_MIN_TOKENS and _ragged_enabled():
         return _moe_ragged(x, weights, idx, gate_proj, up_proj, down_proj,
                            act)
     w_te = combine_weights(weights, idx, e).astype(x.dtype)

@@ -101,7 +101,8 @@ def load_config_and_quant(model_dir: str, arch: str | None = None):
     raise FileNotFoundError(f"no config.json or .gguf in {model_dir}")
 
 
-def build_image_model(model: str, dtype: str = "bf16"):
+def build_image_model(model: str, dtype: str = "bf16",
+                      fp8_native: bool = False):
     """Image generator for the serve path: 'demo:flux' / 'demo:sd' run the
     full pipelines on random weights (zero-egress environments); any other
     value is a release-checkpoint path (FLUX.1 ComfyUI bundle / BFL split
@@ -127,7 +128,8 @@ def build_image_model(model: str, dtype: str = "bf16"):
         return load_flux2_image_model(flux2_ckpt, dtype=parse_dtype(dtype))
     if detect_sd_checkpoint(path):
         return load_sd_image_model(path, dtype=parse_dtype(dtype))
-    return load_flux_image_model(path, dtype=parse_dtype(dtype))
+    return load_flux_image_model(path, dtype=parse_dtype(dtype),
+                                 fp8_native=fp8_native)
 
 
 def build_audio_model(model: str, dtype: str = "bf16"):

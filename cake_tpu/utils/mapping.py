@@ -64,23 +64,11 @@ def unflatten_tree(flat: dict[str, object]):
     return listify(root)
 
 
-def _dequant_read(storage, name: str, scale_name: str | None = None):
-    """Read one tensor; FP8 (e4m3) tensors are dequantized on read — plain
-    cast, times the per-tensor `.scale_weight` when the checkpoint has one
-    (Comfy scaled-fp8 convention; the FLUX.1-dev-fp8 bundle is plain-cast,
-    ref: flux1_model.rs Fp8Linear F8->F16 dequant)."""
-    arr = storage.read(name)
-    if "float8" in str(arr.dtype):
-        arr = arr.astype(np.float32)
-        if scale_name and scale_name in storage:
-            arr = arr * storage.read(scale_name).astype(np.float32)
-    return arr
-
-
 def load_mapped_params(storage, mapping: dict[str, str], expected,
                        dtype=jnp.bfloat16,
                        transforms: dict[str, object] | None = None,
-                       extra: dict[str, object] | None = None) -> dict:
+                       extra: dict[str, object] | None = None,
+                       fp8_native: bool = False) -> dict:
     """Load a pytree through a name mapping with full validation.
 
     storage:   TensorStorage (or anything with read()/__contains__/names()).
@@ -91,6 +79,14 @@ def load_mapped_params(storage, mapping: dict[str, str], expected,
     transforms: {pytree path: fn(np.ndarray) -> np.ndarray} applied before
                shape validation (e.g. transpose, split of fused tensors).
     extra:     {pytree path: ready leaf} for computed leaves (rope tables).
+    fp8_native: keep 2D float8-stored tensors resident as
+               {"fp8", "scale_inv"} marker dicts (1 byte/param in HBM;
+               ops/linear.resolve_weight fuses the dequant into the
+               consuming matmul — ref: native_dtype_backend.rs keeping
+               FLUX.1-dev at ~13 GB instead of ~24). The ComfyUI
+               per-tensor `.scale_weight` (or 1.0 for the plain-cast
+               flux1-dev-fp8 bundle) broadcasts into the blockwise
+               scale_inv grid the text path's resolver already consumes.
 
     Raises ValueError listing ALL missing tensors / unmapped paths /
     shape mismatches at once — a failed 12 GB load should say everything
@@ -126,7 +122,29 @@ def load_mapped_params(storage, mapping: dict[str, str], expected,
         name = mapping[path]
         scale = name[:-len(".weight")] + ".scale_weight" \
             if name.endswith(".weight") else None
-        arr = _dequant_read(storage, name, scale)
+        arr = storage.read(name)               # single disk read per tensor
+        is_f8 = "float8" in str(arr.dtype)
+        if fp8_native and is_f8 and len(exp.shape) == 2:
+            if path in transforms:
+                arr = transforms[path](arr)    # transpose/split: 1B moves
+            if tuple(arr.shape) != tuple(exp.shape):
+                problems.append(f"{name} -> {path}: shape {tuple(arr.shape)}"
+                                f" != expected {tuple(exp.shape)}")
+                continue
+            s = (float(storage.read(scale)) if scale and scale in storage
+                 else 1.0)
+            o, i = arr.shape
+            si = jnp.full((-(-o // 128), -(-i // 128)), s, jnp.float32)
+            flat_out[path] = {"fp8": jnp.asarray(arr), "scale_inv": si}
+            continue
+        if is_f8:
+            # FP8 (e4m3) dequant on read: plain cast, times the per-tensor
+            # `.scale_weight` when the checkpoint has one (Comfy scaled-fp8
+            # convention; the flux1-dev-fp8 bundle is plain-cast — ref:
+            # flux1_model.rs Fp8Linear F8->F16 dequant)
+            arr = arr.astype(np.float32)
+            if scale and scale in storage:
+                arr = arr * storage.read(scale).astype(np.float32)
         if path in transforms:
             arr = transforms[path](arr)
         if tuple(arr.shape) != tuple(exp.shape):

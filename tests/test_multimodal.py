@@ -211,6 +211,83 @@ def test_sd_generate_and_img2img():
     assert not np.array_equal(np.asarray(img), np.asarray(img_i))
 
 
+def test_sd_intermediate_images_and_trace(tmp_path):
+    """intermediate_every decodes in-progress images through on_image
+    (ref: sd.rs:526-529 intermediary_images) and trace_dir writes a JAX
+    profiler trace (the --sd-tracing analog)."""
+    from cake_tpu.models.image.sd import SDImageModel, tiny_sd_config
+    model = SDImageModel(tiny_sd_config())
+    seen = []
+    img = model.generate_image("a fox", width=32, height=32, steps=4, seed=1,
+                               intermediate_every=2,
+                               on_image=lambda step, pil: seen.append(
+                                   (step, pil.size)),
+                               trace_dir=str(tmp_path / "trace"))
+    assert seen == [(2, (32, 32))]       # step 4 is the final image
+    assert img.size == (32, 32)
+    trace_files = list((tmp_path / "trace").rglob("*"))
+    assert trace_files, "profiler trace directory is empty"
+    # final image identical to a run without intermediates
+    img_plain = model.generate_image("a fox", width=32, height=32, steps=4,
+                                     seed=1)
+    np.testing.assert_array_equal(np.asarray(img), np.asarray(img_plain))
+
+
+def test_vibevoice_clone_prefill_bucketed():
+    """Voice-clone conditioning pads the reference to 8-frame buckets so
+    the jitted LM prefill compiles per bucket, not per clip length — and
+    two different clip lengths inside one bucket produce caches advanced
+    by their true frame counts."""
+    import jax.numpy as jnp
+
+    from cake_tpu.models.audio.vibevoice import (VibeVoiceTTS,
+                                                 tiny_tts_config)
+    from cake_tpu.utils.wav import encode_wav
+
+    cfg = tiny_tts_config()
+    m = VibeVoiceTTS(cfg, dtype=jnp.float32, max_frames=4)
+    sr = cfg.sample_rate
+    rng = np.random.default_rng(0)
+    for n_hops in (3, 5):    # both inside the same 8-hop encoder bucket
+        wav = encode_wav(rng.standard_normal(cfg.hop * n_hops)
+                         .astype(np.float32) * 0.1, sr)
+        audio = m.generate_speech("hi there", voice_wav=wav, seed=0,
+                                  max_frames=2)
+        assert np.isfinite(audio.samples).all()
+
+
+def test_resample_antialias_removes_above_band():
+    """48kHz reference with a 20kHz tone: after the low-pass + decimate to
+    24kHz, the aliased image (4kHz) must be strongly attenuated vs naive
+    linear decimation."""
+    import jax.numpy as jnp
+
+    from cake_tpu.models.audio.vibevoice import VibeVoiceTTS, tiny_tts_config
+    from cake_tpu.utils.wav import encode_wav
+
+    cfg = tiny_tts_config()
+    m = VibeVoiceTTS(cfg, dtype=jnp.float32, max_frames=2)
+    sr_in = 48000
+    t = np.arange(sr_in) / sr_in
+    tone = np.sin(2 * np.pi * 20000 * t).astype(np.float32)
+
+    captured = {}
+    orig = m.encode_voice_reference
+
+    def spy(samples):
+        captured["samples"] = np.asarray(samples)
+        return orig(samples)
+
+    m.encode_voice_reference = spy
+    m._voice_embeds(encode_wav(tone, sr_in))
+    res = captured["samples"]
+    # alias image of 20kHz at 24kHz output = 4kHz; measure its energy
+    spec = np.abs(np.fft.rfft(res))
+    freqs = np.fft.rfftfreq(len(res), 1 / cfg.sample_rate)
+    band = spec[(freqs > 3500) & (freqs < 4500)].max()
+    assert band < 0.05 * len(res) / 2, band
+
+
 def test_pipelines_run_in_bf16():
     """serve default dtype: the whole image path must not promote to f32
     (regression: np-scalar coefficients promoted bf16 latents)."""

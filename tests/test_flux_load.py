@@ -42,8 +42,9 @@ def _word_level_tokenizer_json(path, vocab_size):
         json.dump(tok, f)
 
 
-def synth_bundle(tmp_path, fp8_transformer=False):
-    """Write a tiny ComfyUI-style FLUX bundle + tokenizers + sidecar."""
+def synth_bundle(tmp_path, fp8_transformer=False, fp8_scaled=False):
+    """Write a tiny ComfyUI-style FLUX bundle + tokenizers + sidecar.
+    fp8_scaled adds per-tensor `.scale_weight` (Comfy scaled-fp8)."""
     pipe = tiny_flux_config()
     clip_cfg, t5_cfg = tiny_clip_config(), tiny_t5_config()
     rng = jax.random.PRNGKey(0)
@@ -69,7 +70,15 @@ def synth_bundle(tmp_path, fp8_transformer=False):
             arr = np.asarray(flat[path], np.float32)
             if fp8_transformer and prefix == TRANSFORMER_PREFIX \
                     and name.endswith(".weight") and arr.ndim == 2:
-                arr = arr.astype(jnp.float8_e4m3fn)
+                if fp8_scaled:
+                    # store w/2 in fp8 with scale_weight 2.0 so a dropped
+                    # scale is a visible numeric error, not a no-op
+                    arr2 = (arr / 2.0).astype(jnp.float8_e4m3fn)
+                    tensors[prefix + name[:-len(".weight")]
+                            + ".scale_weight"] = np.float32(2.0)
+                    arr = arr2
+                else:
+                    arr = arr.astype(jnp.float8_e4m3fn)
             tensors[prefix + name] = arr
     save_safetensors(str(tmp_path / "model.safetensors"), tensors)
     # non-shape-derivable dims for the tiny fixtures
@@ -149,6 +158,21 @@ def test_load_fp8_transformer(tmp_path):
     model = load_flux_image_model(str(tmp_path), dtype=jnp.float32)
     img = model.generate_image("w3 w4", width=16, height=16, steps=1, seed=1)
     assert np.isfinite(np.asarray(img)).all()
+
+
+def test_fp8_native_scaled_variant(tmp_path):
+    """Comfy scaled-fp8 bundles (per-tensor scale_weight): the native path
+    must broadcast the scalar into its blockwise scale_inv — identical
+    output to the dequant-at-load read, which multiplies it directly."""
+    synth_bundle(tmp_path, fp8_transformer=True, fp8_scaled=True)
+    dense = load_flux_image_model(str(tmp_path), dtype=jnp.float32)
+    native = load_flux_image_model(str(tmp_path), dtype=jnp.float32,
+                                   fp8_native=True)
+    img_d = dense.generate_image("w3 w4", width=16, height=16, steps=2,
+                                 seed=1)
+    img_n = native.generate_image("w3 w4", width=16, height=16, steps=2,
+                                  seed=1)
+    np.testing.assert_array_equal(np.asarray(img_d), np.asarray(img_n))
 
 
 def test_fp8_native_residency_matches_dequant_at_load(tmp_path):

@@ -37,6 +37,58 @@ def _fetch(x):
     return np.asarray(x)
 
 
+def device_mem_mb() -> dict:
+    """HBM residency snapshot (verdict r4 item 3: the fp8-native story needs
+    a device measurement, not host-side byte arithmetic)."""
+    try:
+        ms = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+    out = {}
+    if "bytes_in_use" in ms:
+        out["hbm_in_use_mb"] = round(ms["bytes_in_use"] / 2**20)
+    if "peak_bytes_in_use" in ms:
+        out["hbm_peak_mb"] = round(ms["peak_bytes_in_use"] / 2**20)
+    return out
+
+
+def _build_fp8_tree(shape_tree, skip_substrings=("embed_tokens", "lm_head")):
+    """Materialize a param tree directly from ShapeDtypeStructs, placing every
+    128x128-divisible 2D matmul weight on device as an fp8-native marker dict
+    ({"fp8", "scale_inv"}) and everything else in its declared dtype — the
+    same in-HBM layout `load_mapped_params(fp8_native=True)` produces, but
+    without ever materializing the bf16 model first (an 8B bf16 init would
+    blow 16 GB-class HBM before the fp8 conversion could start)."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    leaves, treedef = tree_flatten_with_path(shape_tree)
+    rng = np.random.default_rng(0)
+    # weight VALUES are throughput-irrelevant (TPU matmul speed is
+    # data-independent) — tile one modest random block instead of drawing
+    # ~8e9 host-side gaussians for an 8B model
+    block = rng.standard_normal(1 << 20, dtype=np.float32) * 0.02
+
+    def _rand(shape, np_dtype):
+        n = int(np.prod(shape)) if shape else 1
+        reps = -(-n // block.size)
+        flat = np.tile(block, reps)[:n] if reps > 1 else block[:n]
+        return jnp.asarray(flat.reshape(shape), np_dtype)
+
+    out = []
+    for path, leaf in leaves:
+        pstr = jax.tree_util.keystr(path)
+        shape, dtype = leaf.shape, leaf.dtype
+        if (len(shape) == 2 and shape[0] % 128 == 0 and shape[1] % 128 == 0
+                and dtype == jnp.bfloat16
+                and not any(s in pstr for s in skip_substrings)):
+            f8 = _rand(shape, jnp.float8_e4m3fn)
+            si = jnp.ones((shape[0] // 128, shape[1] // 128), jnp.float32)
+            out.append({"fp8": f8, "scale_inv": si})
+        else:
+            out.append(_rand(shape, dtype))
+    return tree_unflatten(treedef, out)
+
+
 def measure_link_rtt(n: int = 5) -> float:
     f = jax.jit(lambda a, b: (a * b).sum())
     x = jnp.ones((8, 8), jnp.bfloat16)
@@ -212,27 +264,13 @@ def bench_llama8b_fp8(smoke: bool):
             num_attention_heads=32, num_key_value_heads=8, head_dim=128,
             rope_theta=500000.0, max_position_embeddings=4096)
 
-    # build the fp8-native pytree directly: every matmul weight becomes a
-    # {"fp8", "scale_inv"} marker dict resolved inside the jitted forward
-    # (same in-HBM layout the --fp8-native loader produces; values are
-    # irrelevant to throughput)
-    def to_fp8(path_key, w):
-        if w.ndim == 2 and w.shape[0] % 128 == 0 and w.shape[1] % 128 == 0 \
-                and path_key not in ("embed_tokens", "lm_head"):
-            f8 = w.astype(jnp.float8_e4m3fn)
-            si = jnp.ones((w.shape[0] // 128, w.shape[1] // 128), jnp.float32)
-            return {"fp8": f8, "scale_inv": si}
-        return w
-
-    params = init_params(cfg, jax.random.PRNGKey(0), jnp.bfloat16)
-    for layer in params["layers"]:
-        for grp in ("self_attn", "mlp"):
-            for name, p in layer.get(grp, {}).items():
-                if isinstance(p, dict) and "weight" in p \
-                        and getattr(p["weight"], "ndim", 0) == 2:
-                    w = p["weight"]
-                    if w.shape[0] % 128 == 0 and w.shape[1] % 128 == 0:
-                        p["weight"] = to_fp8(name, w)
+    # build the fp8-native pytree directly from shapes: every matmul weight
+    # becomes a {"fp8", "scale_inv"} marker dict resolved inside the jitted
+    # forward — never materializing the ~16 GB bf16 model first
+    shapes = jax.eval_shape(
+        lambda k: init_params(cfg, k, jnp.bfloat16), jax.random.PRNGKey(0))
+    params = _build_fp8_tree(shapes)
+    mem_resident = device_mem_mb()
 
     model = TextModel(cfg, params=params, dtype=jnp.bfloat16,
                       max_cache_len=128 if smoke else 1024)
@@ -250,16 +288,81 @@ def bench_llama8b_fp8(smoke: bool):
         "value": round(float(np.mean(rates)), 1), "unit": "tok/s",
         "vs_baseline": None,    # reference cannot fit 8B on its 16 GB GPU
         "note": "fp8-native resident weights (~8 GB HBM), bf16 compute",
+        "hbm_weights_mb": mem_resident.get("hbm_in_use_mb"),
+        **device_mem_mb(),
+    }]
+
+
+# ---------------------------------------------------------------------------
+# FLUX.1-dev fp8-native denoise step (the reference's actual headline row:
+# 3.5 s/step at 768x1024, 13,317 MB resident — docs/benchmarks/README.md)
+# ---------------------------------------------------------------------------
+
+
+def bench_flux1_fp8(smoke: bool):
+    from cake_tpu.models.image.flux import (FluxImageModel, FluxPipelineConfig,
+                                            tiny_flux_config)
+    from cake_tpu.models.image.mmdit import init_mmdit_params
+    from cake_tpu.models.image.vae import init_vae_decoder_params
+
+    cfg = tiny_flux_config() if smoke else FluxPipelineConfig()
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    shapes = jax.eval_shape(
+        lambda a, b: {
+            "transformer": init_mmdit_params(cfg.mmdit, a, jnp.bfloat16),
+            "vae": init_vae_decoder_params(cfg.vae, b, jnp.bfloat16),
+        }, k1, k2)
+    # fp8 the transformer matmuls only; VAE convs + norms stay bf16
+    params = _build_fp8_tree(shapes, skip_substrings=("vae",))
+    mem_resident = device_mem_mb()
+    m = FluxImageModel(cfg, params=params, dtype=jnp.bfloat16)
+    w, h = (64, 64) if smoke else (768, 1024)
+    steps = 2 if smoke else 4
+    m.generate_image("warmup", width=w, height=h, steps=1, seed=0)   # compile
+    t0 = time.monotonic()
+    img = m.generate_image("bench", width=w, height=h, steps=steps, seed=0)
+    _fetch(img)
+    per_step = (time.monotonic() - t0) / steps
+    return [{
+        "metric": "flux1_fp8_step_s",
+        "value": round(per_step, 3), "unit": "s/step",
+        "vs_baseline": round(3.5 / per_step, 2),   # ref: 3.5 s/step fp8
+        "note": "FLUX.1-dev geometry (19+38 blocks, h3072), fp8-native "
+                "resident transformer weights, bf16 compute; includes VAE "
+                "decode amortized over steps",
+        "hbm_weights_mb": mem_resident.get("hbm_in_use_mb"),
+        **device_mem_mb(),
     }]
 
 
 BENCHES = {
     "prefill": bench_prefill,
     "flux2": bench_flux2,
+    "flux1_fp8": bench_flux1_fp8,
     "tts": bench_tts,
     "moe": bench_moe,
     "llama8b_fp8": bench_llama8b_fp8,
 }
+
+# generous per-bench wall budgets (first compile of a 57-block MMDiT or a
+# 32-layer 8B model is minutes on its own)
+BENCH_TIMEOUT_S = {"flux2": 2400, "flux1_fp8": 2400, "llama8b_fp8": 1800}
+DEFAULT_TIMEOUT_S = 1200
+
+
+def _fail_row(metric: str, error: str) -> str:
+    return json.dumps({"metric": metric, "value": 0.0, "unit": "",
+                       "vs_baseline": None, "error": error[:200]})
+
+
+def _run_inproc(names, smoke):
+    for name in names:
+        try:
+            for row in BENCHES[name](smoke):
+                print(json.dumps(row), flush=True)
+        except Exception as e:       # noqa: BLE001 — emit per-metric failure
+            traceback.print_exc(file=sys.stderr)
+            print(_fail_row(name, str(e)), flush=True)
 
 
 def main():
@@ -268,18 +371,65 @@ def main():
                                    f"{sorted(BENCHES)}")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--inproc", action="store_true",
+                    help="run benches in this process (child mode; the "
+                         "default parent spawns one subprocess per bench so "
+                         "memory_stats peaks are per-metric and a single "
+                         "OOM/wedge can't zero the rest of the matrix)")
+    ap.add_argument("--probe-budget", type=int, default=1200)
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     names = args.only.split(",") if args.only else list(BENCHES)
+    if args.inproc:
+        _run_inproc(names, args.smoke)
+        return
+
+    # parent mode: never touches the TPU itself (one process at a time owns
+    # the chip); probe with retry, then one subprocess per bench
+    import subprocess
+    if not args.cpu:
+        from bench import _health_probe
+        _health_probe(60, "bench_full", budget=args.probe_budget)
     for name in names:
+        cmd = [sys.executable, __file__, "--only", name, "--inproc"]
+        if args.smoke:
+            cmd.append("--smoke")
+        if args.cpu:
+            cmd.append("--cpu")
+        def _emit_rows(stdout) -> bool:
+            emitted = False
+            for line in (stdout or "").splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    emitted = True
+            return emitted
+
         try:
-            for row in BENCHES[name](args.smoke):
-                print(json.dumps(row), flush=True)
-        except Exception as e:       # noqa: BLE001 — emit per-metric failure
-            traceback.print_exc(file=sys.stderr)
-            print(json.dumps({"metric": name, "value": 0.0, "unit": "",
-                              "vs_baseline": None, "error": str(e)[:200]}),
+            r = subprocess.run(
+                cmd, timeout=BENCH_TIMEOUT_S.get(name, DEFAULT_TIMEOUT_S),
+                capture_output=True, text=True)
+            sys.stderr.write(r.stderr[-4000:] if r.stderr else "")
+            emitted = _emit_rows(r.stdout)
+            if not emitted:
+                print(_fail_row(name, f"no output (exit {r.returncode})"),
+                      flush=True)
+            elif r.returncode != 0:
+                # partial output then a hard crash (XLA abort / OOM kills
+                # the interpreter past _run_inproc's except) — the missing
+                # metrics must not vanish silently
+                print(_fail_row(name, f"child exit {r.returncode} after "
+                                      f"partial output"), flush=True)
+        except subprocess.TimeoutExpired as e:
+            # salvage rows the child completed before hanging + the stderr
+            # tail that says where it hung
+            out = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+            errtxt = e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr
+            sys.stderr.write(errtxt[-4000:] if errtxt else "")
+            _emit_rows(out)
+            print(_fail_row(name, f"timeout after "
+                                  f"{BENCH_TIMEOUT_S.get(name, DEFAULT_TIMEOUT_S)}s"),
                   flush=True)
 
 

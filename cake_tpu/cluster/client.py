@@ -39,7 +39,7 @@ class RemoteStage:
         self.info: dict = {}
         self._rid = 0
         from collections import deque
-        self.rtts: deque = deque(maxlen=512)
+        self.rtts: deque = deque(maxlen=512)       # (rtt_s, worker_fwd_s)
 
     # -- connection --------------------------------------------------------
 
@@ -114,34 +114,53 @@ class RemoteStage:
 
     # -- inference (stage interface) ----------------------------------------
 
-    def forward_hidden(self, x, cache, pos0, valid_len):
+    def forward_hidden(self, x, cache, pos0, valid_len, kv_hint=None):
         """cache is managed worker-side per connection; the local `cache`
-        slot is passed through untouched (None)."""
+        slot is passed through untouched (None). kv_hint: master's current
+        cache bucket, so the worker sizes its cache to match."""
         self._rid += 1
         t0 = time.monotonic()
         proto.write_frame_sync(self.sock, proto.forward(
             np.asarray(x), int(pos0),
-            None if valid_len is None else int(valid_len), self._rid))
+            None if valid_len is None else int(valid_len), self._rid,
+            kv_hint=kv_hint))
         msg = proto.read_frame_sync(self.sock)
-        self.rtts.append(time.monotonic() - t0)
+        rtt = time.monotonic() - t0
         if msg.get("t") == "worker_error":
             raise RuntimeError(f"worker {self.name}: {msg['error']}")
         if msg.get("rid", self._rid) != self._rid:
             raise proto.ProtocolError("response id mismatch")
+        # successful replies only: error RTTs would pollute the wire stats
+        self.rtts.append((rtt, float(msg.get("fwd_ms", 0.0)) / 1e3))
         return proto.unpack_tensor(msg["x"]), cache
 
     def rtt_stats(self) -> dict:
-        """Per-hop round-trip accounting (wire + worker compute; ref:
-        client.rs:96-104 per-client send/recv timing). mean vs p50 spread
-        flags bimodal stalls (Nagle/delayed-ACK class of bugs)."""
+        """Per-hop round-trip accounting (ref: client.rs:96-104 per-client
+        send/recv timing). mean vs p50 spread flags bimodal stalls
+        (Nagle/delayed-ACK class of bugs). Each RTT splits into the
+        worker-reported compute time (fwd_*, includes any in-band compile)
+        and the remainder (wire_*: serialization + TCP + scheduling), so a
+        tail stall is attributable to one side."""
         if not self.rtts:
             return {"count": 0}
-        arr = sorted(self.rtts)
-        return {"count": len(arr),
-                "p50_ms": round(arr[len(arr) // 2] * 1e3, 2),
-                "p95_ms": round(arr[int(len(arr) * 0.95)] * 1e3, 2),
-                "mean_ms": round(sum(arr) / len(arr) * 1e3, 2),
-                "min_ms": round(arr[0] * 1e3, 2)}
+
+        def _stats(vals, prefix):
+            arr = sorted(vals)
+            return {f"{prefix}p50_ms": round(arr[len(arr) // 2] * 1e3, 2),
+                    f"{prefix}p95_ms": round(arr[int(len(arr) * 0.95)] * 1e3, 2),
+                    f"{prefix}mean_ms": round(sum(arr) / len(arr) * 1e3, 2),
+                    f"{prefix}min_ms": round(arr[0] * 1e3, 2)}
+
+        rtts = [r for r, _ in self.rtts]
+        out = {"count": len(rtts), **_stats(rtts, "")}
+        # split only over samples that carry a worker timing (f > 0): a
+        # worker predating fwd_ms would otherwise have its whole RTT
+        # misattributed to the wire
+        timed = [(r, f) for r, f in self.rtts if f > 0]
+        if timed:
+            out.update(_stats([f for _, f in timed], "fwd_"))
+            out.update(_stats([max(r - f, 0.0) for r, f in timed], "wire_"))
+        return out
 
     def goodbye(self):
         try:

@@ -53,6 +53,7 @@ class DistributedTextModel:
     """TextModel over a stage chain. Single local stage == plain TextModel
     semantics; remote stages hop hidden states over the wire."""
 
+
     def __init__(self, cfg: ModelConfig, master_params: dict,
                  stages: list[Stage], tokenizer=None, dtype=jnp.bfloat16,
                  max_cache_len: int = 2048, seed: int = 42, mesh=None):
@@ -129,8 +130,12 @@ class DistributedTextModel:
                     jnp.asarray(x).astype(self.dtype), s.cache, pos, vl,
                     flash_mode=flash_mode)
             else:
+                # kv hint keeps the worker's per-connection cache bucket
+                # aligned with the master's, so growth reallocs land on the
+                # same (pre-warmed) bucket boundaries on every node
                 x, _ = s.runner.forward_hidden(
-                    np.asarray(x), None, pos0, valid_len)
+                    np.asarray(x), None, pos0, valid_len,
+                    kv_hint=self._kv_len)
         return x
 
     def prefill_logits(self, token_ids: list[int], pos0: int = 0):
@@ -155,8 +160,13 @@ class DistributedTextModel:
                  rng=None, **_):
         scfg = sampling or SamplingConfig()
         rng = self._rng if rng is None else rng
-        from ..models.common.text_model import bucket_for
-        self.reset(kv_len=bucket_for(len(prompt_ids) + 17,
+        # initial bucket covers prompt + first sampled token + a short run
+        # of decode (same sizing idea as TextModel's first_span): the first
+        # growth — a realloc on master AND every worker — should not land
+        # within the opening tokens of decode
+        from ..models.common.text_model import DECODE_HEADROOM
+        span = 1 + min(max_new_tokens, DECODE_HEADROOM)
+        self.reset(kv_len=bucket_for(len(prompt_ids) + span,
                                      self.max_cache_len))
         out: list[int] = []
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
@@ -252,7 +262,8 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
                  dtype_str: str = "bf16", max_cache_len: int = 2048,
                  push_weights: bool = True,
                  master_device_fraction_reserved: float = 0.1,
-                 fp8_native: bool = False, mesh=None) -> MasterSetup:
+                 fp8_native: bool = False, mesh=None,
+                 warm: str = "full") -> MasterSetup:
     """Connect/auth/assign/push to each worker; build the stage chain.
 
     workers: discovery replies ({"name", "host", "port", "caps"}).
@@ -299,6 +310,10 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
             push_weights=push_weights, fp8_native=fp8_native)
         assignment["max_cache_len"] = max_cache_len
         assignment["expected_files"] = expected
+        # "full": workers compile every growth bucket's decode + prefill
+        # shape during setup so serving never pays an in-band compile;
+        # "decode": smallest-bucket decode only (fast setup); "none"
+        assignment["warm"] = warm
         resp = client.assign(assignment)
         if resp.get("t") == "worker_error":
             raise RuntimeError(f"worker {name}: {resp['error']}")

@@ -186,14 +186,28 @@ def worker_error(message: str) -> dict:
     return {"t": "worker_error", "error": message}
 
 
-def forward(x, pos0: int, valid_len: int | None, request_id: int = 0) -> dict:
-    return {"t": "forward", "x": pack_tensor(x), "pos0": int(pos0),
-            "valid_len": None if valid_len is None else int(valid_len),
-            "rid": request_id}
+def forward(x, pos0: int, valid_len: int | None, request_id: int = 0,
+            kv_hint: int | None = None) -> dict:
+    """kv_hint: the master's current KV bucket — workers size their
+    per-connection cache to max(pos0 + width, kv_hint) so growth reallocs
+    stay bucket-aligned across all nodes."""
+    out = {"t": "forward", "x": pack_tensor(x), "pos0": int(pos0),
+           "valid_len": None if valid_len is None else int(valid_len),
+           "rid": request_id}
+    if kv_hint is not None:
+        out["kv"] = int(kv_hint)
+    return out
 
 
-def tensor_result(arr, request_id: int = 0) -> dict:
-    return {"t": "tensor", "x": pack_tensor(arr), "rid": request_id}
+def tensor_result(arr, request_id: int = 0,
+                  fwd_ms: float | None = None) -> dict:
+    """fwd_ms: worker-side compute time for this request (includes any
+    in-band XLA compile) — lets the master separate wire time from worker
+    time in its per-hop RTT stats."""
+    out = {"t": "tensor", "x": pack_tensor(arr), "rid": request_id}
+    if fwd_ms is not None:
+        out["fwd_ms"] = round(fwd_ms, 3)
+    return out
 
 
 def goodbye() -> dict:

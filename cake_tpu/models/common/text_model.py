@@ -43,6 +43,11 @@ from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
 
 PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
+# decode tokens an initial KV bucket reserves beyond the prompt so the
+# first growth realloc doesn't land within the opening tokens of decode
+# (shared by the distributed master's sizing and the worker's warmup)
+DECODE_HEADROOM = 16
+
 
 def bucket_for(n: int, max_len: int) -> int:
     for b in PREFILL_BUCKETS:

@@ -1,2 +1,8 @@
 #!/bin/sh
-python bench.py
+# TPU: the real flagship decode bench. CAKE_BENCH_CPU=1: the tiny smoke
+# model on CPU — validates the gate end-to-end without hardware.
+if [ "${CAKE_BENCH_CPU:-}" = "1" ]; then
+  python bench.py --smoke --cpu
+else
+  python bench.py
+fi

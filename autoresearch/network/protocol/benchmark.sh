@@ -1,2 +1,4 @@
 #!/bin/sh
-python benches/bench_micro.py --filter frame
+# CAKE_BENCH_CPU=1 -> CPU validation mode (TPU busy/absent)
+[ "${CAKE_BENCH_CPU:-}" = "1" ] && CPU=--cpu || CPU=
+python benches/bench_micro.py --filter frame $CPU

@@ -1,0 +1,119 @@
+"""Run every autoresearch brief's benchmark.sh gate and collect the
+one-line JSON results into a single artifact (GATES_r{N}.json).
+
+The reference treats its bench harness as a regression contract
+(/root/reference/cake-core/benches/, 23 divan modules + autoresearch/
+briefs); this is the equivalent sweep. Failures are recorded honestly —
+a brief whose gate errors or times out appears with "error" set.
+
+Usage:
+  python scripts/run_gates.py --mode cpu --out GATES_r05.json
+  python scripts/run_gates.py --mode tpu --out GATES_r05_tpu.json
+
+cpu mode sets CAKE_BENCH_CPU=1 (every gate honors it) — validates the
+gate logic without hardware; tpu mode runs on the default backend and is
+the number that counts.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def run_gate(path: str, mode: str, timeout: int) -> dict:
+    env = dict(os.environ)
+    if mode == "cpu":
+        env["CAKE_BENCH_CPU"] = "1"
+    else:
+        # an inherited CAKE_BENCH_CPU=1 would silently turn the TPU
+        # artifact ("the number that counts") into CPU smoke numbers
+        env.pop("CAKE_BENCH_CPU", None)
+    t0 = time.monotonic()
+    # own process group: on timeout we must kill the python grandchild
+    # too, or it keeps the captured pipes open (communicate() then blocks
+    # forever) and keeps the TPU busy for every later gate
+    proc = subprocess.Popen(["sh", path], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, start_new_session=True,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        r = subprocess.CompletedProcess(proc.args, proc.returncode, out, err)
+    except subprocess.TimeoutExpired:
+        import signal
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.communicate()
+        return {"error": f"timeout after {timeout}s",
+                "wall_s": round(time.monotonic() - t0, 1)}
+    wall = round(time.monotonic() - t0, 1)
+    # gates print one JSON object per line; keep every parseable line
+    # (bench_micro sweeps print several)
+    rows = []
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    if not rows:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        return {"error": tail[-1][:200] if tail else f"exit {r.returncode}",
+                "exit": r.returncode, "wall_s": wall}
+    out = {"wall_s": wall}
+    if r.returncode != 0:
+        out["exit"] = r.returncode
+    out["result"] = rows[0] if len(rows) == 1 else rows
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["cpu", "tpu"], default="cpu")
+    ap.add_argument("--out", default="")
+    ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--filter", default="")
+    args = ap.parse_args()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gates = sorted(glob.glob(os.path.join(root, "autoresearch", "*", "*",
+                                          "benchmark.sh")))
+    results = {}
+    for g in gates:
+        brief = "/".join(g.split(os.sep)[-3:-1])
+        if args.filter and args.filter not in brief:
+            continue
+        print(f"[gates] {brief} ...", file=sys.stderr, flush=True)
+        results[brief] = run_gate(g, args.mode, args.timeout)
+        print(f"[gates] {brief}: "
+              f"{json.dumps(results[brief])[:160]}", file=sys.stderr,
+              flush=True)
+    def gate_ok(r: dict) -> bool:
+        if "error" in r or r.get("exit"):
+            return False
+        rows = r.get("result", {})
+        rows = rows if isinstance(rows, list) else [rows]
+        # bench_micro-style sweeps exit 0 but report per-bench errors
+        return not any("error" in row for row in rows)
+
+    payload = {"mode": args.mode, "gates": results,
+               "n_ok": sum(1 for r in results.values() if gate_ok(r)),
+               "n_total": len(results)}
+    line = json.dumps(payload)
+    if args.out:
+        with open(os.path.join(root, args.out), "w") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""External numerics ground truth for the image path: SD UNet and VAE
+decoder cross-checked against the installed `diffusers` implementation
+(CPU, f32, tiny random configs), mirroring tests/test_hf_parity.py for
+text (which found three real semantic bugs the self-consistent goldens
+could not).
+
+`diffusers` is NOT installed in the build environment — these tests are
+insurance that activates automatically the day the environment gains it
+(VERDICT r4 item 7). They exercise the REAL loader path: weights flow a
+diffusers `save_pretrained` checkpoint -> sd_loader's mapping ->
+our forward, so the name mapping, conv-vs-linear squeeze transforms and
+group-norm/timestep conventions are all under test.
+
+FLUX is not covered here: our FLUX.1 loader consumes the BFL/ComfyUI
+tensor layout (bare double_blocks.*), not diffusers', so a cross-check
+would test a name-translation layer written only for the test.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+diffusers = pytest.importorskip("diffusers")
+
+from cake_tpu.models.image.sd import init_unet_params, unet_forward
+from cake_tpu.models.image.sd_loader import (sd_configs_from_dir,
+                                             sd_unet_mapping,
+                                             sd_vae_decoder_mapping)
+from cake_tpu.models.image.vae import init_vae_decoder_params, vae_decode
+from cake_tpu.utils.mapping import load_mapped_params
+from cake_tpu.utils.safetensors_io import TensorStorage
+
+ATOL = 1e-3
+
+
+def randomize_torch(model, seed: int):
+    """Non-trivial random weights everywhere (default inits zero some
+    projections, which would hide mapping bugs)."""
+    rng = np.random.default_rng(seed)
+    with torch.no_grad():
+        for p in model.parameters():
+            p.copy_(torch.from_numpy(
+                rng.normal(0.0, 0.05, tuple(p.shape)).astype(np.float32)))
+    model.eval()
+    return model
+
+
+def tiny_unet():
+    return diffusers.UNet2DConditionModel(
+        sample_size=8, in_channels=4, out_channels=4,
+        down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"),
+        up_block_types=("UpBlock2D", "CrossAttnUpBlock2D"),
+        block_out_channels=(32, 64), layers_per_block=1,
+        cross_attention_dim=32, attention_head_dim=4, norm_num_groups=32)
+
+
+def tiny_vae():
+    return diffusers.AutoencoderKL(
+        in_channels=3, out_channels=3,
+        down_block_types=("DownEncoderBlock2D", "DownEncoderBlock2D"),
+        up_block_types=("UpDecoderBlock2D", "UpDecoderBlock2D"),
+        block_out_channels=(32, 64), layers_per_block=1,
+        latent_channels=4, norm_num_groups=32)
+
+
+@pytest.fixture(scope="module")
+def sd_dir(tmp_path_factory):
+    """A tiny diffusers-layout SD checkpoint directory (unet + vae +
+    scheduler), randomized, as sd_loader expects it on disk."""
+    d = tmp_path_factory.mktemp("sd-diffusers")
+    randomize_torch(tiny_unet(), 7).save_pretrained(d / "unet")
+    randomize_torch(tiny_vae(), 8).save_pretrained(d / "vae")
+    os.makedirs(d / "scheduler", exist_ok=True)
+    with open(d / "scheduler" / "scheduler_config.json", "w") as f:
+        json.dump({"prediction_type": "epsilon", "beta_start": 0.00085,
+                   "beta_end": 0.012, "beta_schedule": "scaled_linear"}, f)
+    return str(d)
+
+
+def test_sd_unet_forward_parity(sd_dir):
+    cfg = sd_configs_from_dir(sd_dir)
+    st = TensorStorage.from_model_dir(os.path.join(sd_dir, "unet"))
+    um, ut = sd_unet_mapping(cfg.unet)
+    params = load_mapped_params(
+        st, um,
+        jax.eval_shape(lambda: init_unet_params(
+            cfg.unet, jax.random.PRNGKey(0), jnp.float32)),
+        jnp.float32, transforms=ut)
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(0.0, 1.0, (1, 4, 8, 8)).astype(np.float32)
+    ctx = rng.normal(0.0, 1.0, (1, 7, 32)).astype(np.float32)
+    timestep = 450
+
+    hf = diffusers.UNet2DConditionModel.from_pretrained(
+        os.path.join(sd_dir, "unet"), torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        want = hf(torch.from_numpy(x),
+                  torch.tensor([timestep]),
+                  encoder_hidden_states=torch.from_numpy(ctx)).sample.numpy()
+
+    # our t is the timestep fraction in [0,1]; the embedding scales by 1000
+    got = np.asarray(unet_forward(
+        cfg.unet, params, jnp.asarray(x),
+        jnp.asarray([timestep / 1000.0], jnp.float32), jnp.asarray(ctx)))
+
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)
+
+
+def test_sd_vae_decode_parity(sd_dir):
+    cfg = sd_configs_from_dir(sd_dir)
+    st = TensorStorage.from_model_dir(os.path.join(sd_dir, "vae"))
+    vm, vt = sd_vae_decoder_mapping(st, cfg.vae)
+    shapes = jax.eval_shape(lambda: init_vae_decoder_params(
+        cfg.vae, jax.random.PRNGKey(0), jnp.float32))
+    lc = cfg.vae.latent_channels
+    shapes["post_quant_conv"] = {
+        "weight": jax.ShapeDtypeStruct((lc, lc, 1, 1), jnp.float32),
+        "bias": jax.ShapeDtypeStruct((lc,), jnp.float32)}
+    params = load_mapped_params(st, vm, shapes, jnp.float32, transforms=vt)
+    assert "post_quant_conv" in params
+
+    rng = np.random.default_rng(12)
+    z = rng.normal(0.0, 1.0, (1, lc, 8, 8)).astype(np.float32)
+
+    hf = diffusers.AutoencoderKL.from_pretrained(
+        os.path.join(sd_dir, "vae"), torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        want = hf.decode(torch.from_numpy(z)).sample.numpy()
+
+    # vae_decode applies the pipeline's z/scaling + shift internally;
+    # diffusers' decode() takes the already-unscaled latent — feed ours
+    # the pre-scaled value so both decoders see the same tensor
+    z_ours = (z - cfg.vae.shift_factor) * cfg.vae.scaling_factor
+    got = np.asarray(vae_decode(cfg.vae, params, jnp.asarray(z_ours)))
+
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)

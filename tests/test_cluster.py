@@ -537,3 +537,28 @@ def test_fp8_native_through_cluster_streaming(fp8_cluster_model_dir):
         if loop and srv:
             asyncio.run_coroutine_threadsafe(srv.stop(), loop)
         t.join(timeout=5)
+
+
+def test_warm_covers_every_serving_bucket_combo():
+    """The worker's assignment-time warm compiles prefill width w against
+    cache buckets {w, next(w)} (worker._warm). This pins the invariant it
+    relies on: for ANY prompt length and max_new_tokens, the master's
+    initial KV bucket (bucket_for(prompt + 1 + min(max_new,
+    DECODE_HEADROOM))) is at most ONE bucket above the prefill width
+    bucket (bucket_for(prompt)) — i.e. serving can never request a
+    (width, cache) combo the warm sweep did not compile."""
+    from cake_tpu.models.common.text_model import (DECODE_HEADROOM,
+                                                   PREFILL_BUCKETS,
+                                                   bucket_for)
+
+    max_len = PREFILL_BUCKETS[-1]
+    for prompt_len in range(1, 2049):
+        pb = bucket_for(prompt_len, max_len)
+        for max_new in (1, DECODE_HEADROOM, 10 * DECODE_HEADROOM):
+            span = 1 + min(max_new, DECODE_HEADROOM)
+            kv = bucket_for(prompt_len + span, max_len)
+            i_pb = PREFILL_BUCKETS.index(pb)
+            i_kv = PREFILL_BUCKETS.index(kv)
+            assert 0 <= i_kv - i_pb <= 1, (
+                f"prompt {prompt_len} max_new {max_new}: width bucket {pb} "
+                f"but kv bucket {kv} — warm sweep would miss this combo")

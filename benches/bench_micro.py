@@ -187,6 +187,63 @@ def bench_moe_dispatch():
     return out
 
 
+def bench_moe_crossover():
+    """Ragged-vs-dense crossover sweep: the token count where the sorted
+    segment-GEMM dispatch starts beating the dense all-experts combine is
+    what ops/moe.RAGGED_MIN_TOKENS should be set to (VERDICT r4 item 4:
+    32 was a guess, measure it). TPU-only (the ragged op densifies in CPU
+    lowering, so a CPU sweep measures nothing)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cake_tpu.ops import moe as moe_mod
+    from cake_tpu.ops.moe import combine_weights, moe_ffn, router_topk
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "crossover is only meaningful on TPU"}
+
+    e, k, i, h = 128, 8, 768, 2048
+    rng = np.random.default_rng(0)
+    router = jnp.asarray(rng.normal(0, .3, (e, h)), jnp.bfloat16)
+    gp = jnp.asarray(rng.normal(0, .02, (e, i, h)), jnp.bfloat16)
+    up = jnp.asarray(rng.normal(0, .02, (e, i, h)), jnp.bfloat16)
+    dp = jnp.asarray(rng.normal(0, .02, (e, h, i)), jnp.bfloat16)
+
+    def dense(x):
+        logits = jnp.einsum("th,eh->te", x, router,
+                            preferred_element_type=jnp.float32)
+        w, idx = router_topk(logits, k, True, "softmax")
+        w_te = combine_weights(w, idx, e).astype(x.dtype)
+        a = jax.nn.silu(jnp.einsum("th,eih->tei", x, gp)) \
+            * jnp.einsum("th,eih->tei", x, up)
+        return jnp.einsum("te,teh->th", w_te,
+                          jnp.einsum("tei,ehi->teh", a, dp))
+
+    # force both paths regardless of the RAGGED_MIN_TOKENS gate
+    def ragged_full(x):
+        logits = jnp.einsum("th,eh->te", x, router,
+                            preferred_element_type=jnp.float32)
+        w, idx = router_topk(logits, k, True, "softmax")
+        return moe_mod._moe_ragged(x, w, idx, gp, up, dp, "silu")
+
+    ragged = jax.jit(ragged_full)
+    jdense = jax.jit(dense)
+    rows = []
+    crossover = None
+    for t in (8, 16, 32, 64, 128, 256, 512):
+        x = jnp.asarray(rng.normal(0, 1, (t, h)), jnp.bfloat16)
+        r_ms = timeit(lambda: np.asarray(ragged(x)), warmup=2, iters=5) * 1e3
+        d_ms = timeit(lambda: np.asarray(jdense(x)), warmup=2, iters=5) * 1e3
+        rows.append({"tokens": t, "ragged_ms": round(r_ms, 3),
+                     "dense_ms": round(d_ms, 3)})
+        if crossover is None and r_ms < d_ms:
+            crossover = t
+    return {"experts": e, "topk": k, "sweep": rows,
+            "crossover_tokens": crossover,
+            "current_gate": moe_mod.RAGGED_MIN_TOKENS}
+
+
 def bench_sampling():
     import jax
     import jax.numpy as jnp
@@ -220,6 +277,7 @@ BENCHES = {
     "decode_tiny": bench_decode_step,
     "flash_attention": bench_flash_attention,
     "moe_dispatch": bench_moe_dispatch,
+    "moe_crossover": bench_moe_crossover,
     "sampling_151k_vocab": bench_sampling,
     "gguf_q4k_dequant": bench_gguf_dequant,
 }

@@ -27,8 +27,9 @@ from ..models.common.cache import init_cache
 from ..models.common.config import ModelConfig
 from ..models.common.layers import (embed_tokens, forward_layers,
                                     lm_head_logits)
-from ..models.common.text_model import (PREFILL_BUCKETS, LocalStage, Token,
-                                        bucket_for, check_prefill_bounds,
+from ..models.common.text_model import (PREFILL_BUCKETS, PREFILL_CHUNK,
+                                        LocalStage, Token, bucket_for,
+                                        check_prefill_bounds,
                                         select_flash_mode)
 from ..ops.sampling import SamplingConfig, push_recent_token, sample
 from .auth import cluster_hash
@@ -56,14 +57,22 @@ class DistributedTextModel:
 
     def __init__(self, cfg: ModelConfig, master_params: dict,
                  stages: list[Stage], tokenizer=None, dtype=jnp.bfloat16,
-                 max_cache_len: int = 2048, seed: int = 42, mesh=None):
+                 max_cache_len: int = 2048, seed: int = 42, mesh=None,
+                 prefill_chunk: int | None = None):
         self.cfg = cfg
         self.stages = stages
         self.tokenizer = tokenizer
         self.dtype = dtype
-        self.max_cache_len = max_cache_len
+        # clamp like TextModel: positions past max_seq_len would silently
+        # mis-index the rope tables (out-of-range gathers clamp, not raise)
+        self.max_cache_len = min(max_cache_len, cfg.max_seq_len)
         self.mesh = mesh
-        self._kv_len = max_cache_len     # reset()/generate() re-bucket
+        # pipelined-prefill chunk width; PREFILL_CHUNK is what workers
+        # compile-warm, so overriding trades a first-request in-band
+        # compile for the chosen width
+        self.prefill_chunk = prefill_chunk or PREFILL_CHUNK
+        self._last_prefill: dict = {}
+        self._kv_len = self.max_cache_len   # reset()/generate() re-bucket
         # embed + head replicate over the in-host tp mesh so the hidden
         # state entering/leaving the sharded local stages is replicated
         from ..parallel.sharding import shard_params
@@ -116,36 +125,121 @@ class DistributedTextModel:
 
     # -- forward ------------------------------------------------------------
 
+    def _stage_forward(self, s: Stage, x, pos0: int, valid_len: int | None):
+        """One stage hop — the single definition of local/remote dispatch
+        (dtype cast, flash-mode selection, kv hint) shared by the
+        sequential chain and the pipelined prefill threads."""
+        if s.kind == "local":
+            # local prefill stages flash like TextModel.prefill
+            # (full-length unwrapped caches)
+            flash_mode = "off"
+            if valid_len is not None:
+                flash_mode = select_flash_mode(pos0, x.shape[1],
+                                               self._kv_len)
+            x, s.cache = s.runner.forward_hidden(
+                jnp.asarray(x).astype(self.dtype), s.cache,
+                jnp.asarray(pos0, jnp.int32),
+                None if valid_len is None
+                else jnp.asarray(valid_len, jnp.int32),
+                flash_mode=flash_mode)
+            return x
+        # kv hint keeps the worker's per-connection cache bucket aligned
+        # with the master's, so growth reallocs land on the same
+        # (pre-warmed) bucket boundaries on every node
+        x, _ = s.runner.forward_hidden(np.asarray(x), None, pos0, valid_len,
+                                       kv_hint=self._kv_len)
+        return x
+
     def _run_stages(self, x, pos0: int, valid_len: int | None):
-        pos = jnp.asarray(pos0, jnp.int32)
-        vl = None if valid_len is None else jnp.asarray(valid_len, jnp.int32)
-        # local prefill stages flash like TextModel.prefill (full-length
-        # unwrapped caches)
-        flash_mode = "off"
-        if valid_len is not None:
-            flash_mode = select_flash_mode(pos0, x.shape[1], self._kv_len)
         for s in self.stages:
-            if s.kind == "local":
-                x, s.cache = s.runner.forward_hidden(
-                    jnp.asarray(x).astype(self.dtype), s.cache, pos, vl,
-                    flash_mode=flash_mode)
-            else:
-                # kv hint keeps the worker's per-connection cache bucket
-                # aligned with the master's, so growth reallocs land on the
-                # same (pre-warmed) bucket boundaries on every node
-                x, _ = s.runner.forward_hidden(
-                    np.asarray(x), None, pos0, valid_len,
-                    kv_hint=self._kv_len)
+            x = self._stage_forward(s, x, pos0, valid_len)
         return x
 
     def prefill_logits(self, token_ids: list[int], pos0: int = 0):
         n = len(token_ids)
         bkt = check_prefill_bounds(n, pos0, self._kv_len, self.max_cache_len)
+        # pipelined chunked prefill when the chain has remote hops and the
+        # prompt spans >= 2 chunks: decode is irreducibly sequential (token
+        # t+1 needs token t's sample) but prefill is not — chunk c+1 runs
+        # on stage s while chunk c is on stage s+1, hiding wire+compute of
+        # every stage but the slowest
+        cw = self.prefill_chunk
+        if (pos0 == 0 and n > cw
+                and (-(-n // cw)) * cw <= self._kv_len  # padded chunks fit
+                and any(s.kind == "remote" for s in self.stages)):
+            return self._prefill_pipelined(token_ids)
+        self._last_prefill = {"pipelined": False, "chunks": 1, "width": bkt}
         padded = np.zeros((1, bkt), np.int32)
         padded[0, :n] = token_ids
         x = self._embed(self.params, jnp.asarray(padded))
         x = self._run_stages(x, pos0, n)
         x = jnp.asarray(x)[:, n - 1:n]
+        return self._head(self.params, x.astype(self.dtype))
+
+    def _prefill_pipelined(self, token_ids: list[int]):
+        """Stream the prompt through the stage chain in PREFILL_CHUNK-token
+        slices, one thread per stage (plus a feeder): the blocking remote
+        round trips of different stages overlap, so long-prompt TTFT
+        approaches max-stage time instead of sum-of-stages. Queues are
+        unbounded — a failed stage can then never deadlock its upstream;
+        in-flight memory is bounded by n_chunks hidden-state slices."""
+        import queue as _queue
+        import threading
+
+        cw = self.prefill_chunk
+        n = len(token_ids)
+        n_chunks = -(-n // cw)
+        self._last_prefill = {"pipelined": True, "chunks": n_chunks,
+                              "width": cw}
+        qs = [_queue.Queue() for _ in range(len(self.stages) + 1)]
+        errs: list[Exception] = []
+
+        def feed():
+            try:
+                for ci in range(n_chunks):
+                    lo = ci * cw
+                    ids = token_ids[lo:lo + cw]
+                    padded = np.zeros((1, cw), np.int32)
+                    padded[0, :len(ids)] = ids
+                    x = self._embed(self.params, jnp.asarray(padded))
+                    qs[0].put((x, lo, len(ids)))
+            except Exception as e:     # noqa: BLE001 — surfaced below
+                errs.append(e)
+            finally:
+                qs[0].put(None)
+
+        def run_stage(i: int, s: Stage):
+            try:
+                while True:
+                    item = qs[i].get()
+                    if item is None:
+                        break
+                    x, p0, vl = item
+                    qs[i + 1].put((self._stage_forward(s, x, p0, vl), p0, vl))
+            except Exception as e:     # noqa: BLE001 — surfaced below
+                errs.append(e)
+            finally:
+                qs[i + 1].put(None)
+
+        threads = [threading.Thread(target=feed, daemon=True)] + [
+            threading.Thread(target=run_stage, args=(i, s), daemon=True)
+            for i, s in enumerate(self.stages)]
+        for t in threads:
+            t.start()
+        last = None
+        while True:
+            item = qs[-1].get()
+            if item is None:
+                break
+            last = item
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        if last is None:
+            raise RuntimeError("pipelined prefill produced no output")
+        x, _, vl = last
+        x = jnp.asarray(x)[:, vl - 1:vl]
         return self._head(self.params, x.astype(self.dtype))
 
     def decode_logits(self, token_id: int, pos: int):
@@ -201,7 +295,7 @@ class DistributedTextModel:
                 on_token(self._mk_token(tid))
         dt = time.monotonic() - t1
         stats = {"ttft_s": ttft, "decode_tokens": len(out) - 1,
-                 "decode_s": dt,
+                 "decode_s": dt, "prefill": dict(self._last_prefill),
                  "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0,
                  "stage_rtts": {
                      f"{s.runner.name}[{s.start}:{s.end}]":

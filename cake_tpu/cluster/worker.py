@@ -243,7 +243,8 @@ class WorkerServer:
         once per assignment regardless of how many masters connect."""
         if mode == "none":
             return
-        from ..models.common.text_model import PREFILL_BUCKETS
+        from ..models.common.text_model import (PREFILL_BUCKETS,
+                                                PREFILL_CHUNK)
         st = self.state
         t0 = time.monotonic()
         buckets = [b for b in PREFILL_BUCKETS if b <= st.max_cache_len]
@@ -273,6 +274,24 @@ class WorkerServer:
                         xb, cache, zero, jnp.asarray(w, jnp.int32),
                         flash_mode=select_flash_mode(0, w, b))
                     n += 1
+                # pipelined-prefill chunk shapes: prompts longer than
+                # PREFILL_CHUNK arrive as chunk-width slices — fresh for
+                # chunk 0, append (pos0 traced, one compile covers all
+                # later chunks) for the rest
+                # (>= 2*chunk: the master only chunks prompts longer than
+                # one chunk, and ceil-to-chunk must fit the bucket — a
+                # bucket strictly between chunk and 2*chunk can never
+                # receive chunked prefill)
+                if b >= 2 * PREFILL_CHUNK:
+                    xc = jnp.zeros((1, PREFILL_CHUNK, st.cfg.hidden_size),
+                                   st.dtype)
+                    vlc = jnp.asarray(PREFILL_CHUNK, jnp.int32)
+                    for p0 in (0, PREFILL_CHUNK):
+                        _, cache = st.stage.forward_hidden(
+                            xc, cache, jnp.asarray(p0, jnp.int32), vlc,
+                            flash_mode=select_flash_mode(
+                                p0, PREFILL_CHUNK, b))
+                        n += 1
         log.info("worker %s warmed %d shapes (%s) in %.1fs", self.name, n,
                  mode, time.monotonic() - t0)
 

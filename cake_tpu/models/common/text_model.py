@@ -48,6 +48,13 @@ PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 # (shared by the distributed master's sizing and the worker's warmup)
 DECODE_HEADROOM = 16
 
+# distributed pipelined prefill streams the prompt through the stage chain
+# in chunks of this many tokens (stage s computes chunk c while stage s-1
+# computes chunk c+1 — prefill has no sampling dependency, so unlike
+# decode the chain CAN overlap); shared so the worker warm sweep compiles
+# the exact chunk shapes the master will send
+PREFILL_CHUNK = 512
+
 
 def bucket_for(n: int, max_len: int) -> int:
     for b in PREFILL_BUCKETS:

@@ -562,3 +562,57 @@ def test_warm_covers_every_serving_bucket_combo():
             assert 0 <= i_kv - i_pb <= 1, (
                 f"prompt {prompt_len} max_new {max_new}: width bucket {pb} "
                 f"but kv bucket {kv} — warm sweep would miss this combo")
+
+
+def test_pipelined_prefill_matches_local(cluster_model_dir):
+    """Long-prompt greedy parity through the pipelined chunked prefill:
+    a 70-token prompt with prefill_chunk=32 flows through the stage chain
+    as 3 chunks (fresh + 2 append) and must produce exactly the tokens of
+    the fully-local single-shot model."""
+    from cake_tpu.cluster.master import DistributedTextModel, master_setup
+    from cake_tpu.models import SamplingConfig, TextModel
+
+    cfg, params, mdir, wcache = cluster_model_dir
+    ready = threading.Event()
+    holder, t = _start_worker_thread("wp", "testkey", wcache + "-pp", ready)
+    assert ready.wait(10)
+    port = holder["port"]
+
+    prompt = [(i * 7 + 3) % 250 for i in range(70)]
+    try:
+        setup = master_setup(
+            mdir, "testkey", cfg,
+            workers=[{"name": "wp", "host": "127.0.0.1", "port": port,
+                      "caps": {"backend": "cpu", "device": "cpu",
+                               "memory_bytes": 8 << 30, "tflops": 1.0}}],
+            assignments={"wp": (1, 3)},
+            dtype_str="f32", max_cache_len=128)
+        dist = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                    dtype=jnp.float32, max_cache_len=128,
+                                    prefill_chunk=32)
+        got, stats = dist.generate(prompt, max_new_tokens=8,
+                                   sampling=SamplingConfig(temperature=0.0))
+        assert stats["prefill"] == {"pipelined": True, "chunks": 3,
+                                    "width": 32}
+
+        local = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=128)
+        want, _ = local.generate(prompt, max_new_tokens=8,
+                                 sampling=SamplingConfig(temperature=0.0))
+        assert got == want
+
+        # short prompt falls back to the single-shot path on the same chain
+        got2, stats2 = dist.generate(prompt[:20], max_new_tokens=6,
+                                     sampling=SamplingConfig(temperature=0.0))
+        assert stats2["prefill"]["pipelined"] is False
+        want2, _ = local.generate(prompt[:20], max_new_tokens=6,
+                                  sampling=SamplingConfig(temperature=0.0))
+        assert got2 == want2
+
+        for c in setup.clients:
+            c.close()
+    finally:
+        loop = holder.get("loop")
+        srv = holder.get("server")
+        if loop and srv:
+            asyncio.run_coroutine_threadsafe(srv.stop(), loop)
+        t.join(timeout=5)

@@ -8,6 +8,8 @@ Routes:
   POST /api/v1/image            image gen (raw png, legacy)
   POST /v1/audio/speech         TTS (wav/pcm)
   GET  /api/v1/topology         cluster topology JSON
+  GET  /api/v1/layers           per-layer tensor detail (static, fetch once)
+  GET  /api/v1/stats            last generation's timing snapshot
   GET  /                        embedded web UI
 """
 from __future__ import annotations
@@ -64,6 +66,7 @@ def create_app(state: ApiState, basic_auth: str | None = None) -> web.Applicatio
     app.router.add_post("/v1/audio/speech", audio_routes.audio_speech)
     app.router.add_get("/api/v1/topology", ui_routes.topology)
     app.router.add_get("/api/v1/layers", ui_routes.layers)
+    app.router.add_get("/api/v1/stats", ui_routes.stats)
     app.router.add_get("/", ui_routes.index)
     return app
 

@@ -30,6 +30,10 @@ class ApiState:
     sd_intermediate_every: int = 0
     sd_trace_dir: str | None = None
     layer_tensors: dict | None = None   # per-layer tensor detail for the UI
+    # last generation's timing/stats snapshot for /api/v1/stats (ttft,
+    # tok/s, per-hop RTT wire/fwd split, prefill pipelining) — written
+    # under `lock`, so readers see a consistent dict
+    last_stats: dict | None = None
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     created: int = 0
 

@@ -120,11 +120,25 @@ def _decode_text(tokenizer, ids: list[int]) -> str:
         return "".join(parts)
 
 
+def _stats_snapshot(stats: dict) -> dict:
+    """JSON-safe snapshot of a generation's stats for /api/v1/stats:
+    timings, per-hop RTT wire/fwd split and prefill pipelining info (the
+    reference surfaces topology only; the wire/compute attribution is
+    what actually localizes a slow cluster)."""
+    out = {"ts": int(time.time())}
+    for k in ("ttft_s", "decode_tokens", "decode_s", "tok_per_s",
+              "stage_rtts", "prefill"):
+        if k in stats:
+            out[k] = stats[k]
+    return out
+
+
 async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
     async with state.lock:                  # one inference at a time
         try:
             toks, stats = await run_generation_blocking(state.model, messages,
                                                         gen_kwargs)
+            state.last_stats = _stats_snapshot(stats)
         except Exception as e:
             return web.json_response({"error": f"generation failed: {e}"},
                                      status=500)
@@ -205,6 +219,8 @@ async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
             # with a final chunk + [DONE] so clients don't hang
             await write_safe(chunk({"content": f"\n[error: {e}]"}))
             finish = "error"
+        if "stats" in result:
+            state.last_stats = _stats_snapshot(result["stats"])
     await write_safe(chunk({}, finish=finish))
     await write_safe(b"data: [DONE]\n\n")
     if not client_gone:

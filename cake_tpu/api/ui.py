@@ -44,6 +44,15 @@ async def topology(request: web.Request) -> web.Response:
     return web.json_response({"master": master, "nodes": nodes})
 
 
+async def stats(request: web.Request) -> web.Response:
+    """Last generation's timing snapshot: ttft/tok_s, per-hop RTT with the
+    wire-vs-worker-compute split, and prefill pipelining info. Empty dict
+    until the first generation completes."""
+    state: ApiState = request.app["state"]
+    return web.json_response({"model": state.model_id,
+                              "stats": state.last_stats or {}})
+
+
 async def layers(request: web.Request) -> web.Response:
     """Per-layer tensor detail (name/shape/dtype/bytes) from the
     safetensors headers (ref: api/ui.rs parallel header scan). Separate

@@ -262,6 +262,12 @@ class DistributedTextModel:
         span = 1 + min(max_new_tokens, DECODE_HEADROOM)
         self.reset(kv_len=bucket_for(len(prompt_ids) + span,
                                      self.max_cache_len))
+        # per-generation RTT windows: the stats this generate returns (and
+        # /api/v1/stats re-serves as "last generation") must not blend in
+        # samples from earlier generations
+        for s in self.stages:
+            if s.kind == "remote":
+                s.runner.rtts.clear()
         out: list[int] = []
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
 

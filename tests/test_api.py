@@ -319,3 +319,38 @@ def test_topology_layer_detail(tmp_path):
     assert "model.layers.0.self_attn.q_proj.weight" in l0
     t = detail["0"][0]
     assert t["bytes"] > 0 and t["shape"] and t["dtype"]
+
+
+def test_stats_endpoint():
+    """Empty before any generation; after a chat call it reports the last
+    generation's timing snapshot (ttft/tok_s + whatever the model's stats
+    carry — on a cluster master that includes the per-hop RTT wire/fwd
+    split and prefill pipelining info)."""
+    async def scenario(client):
+        r = await client.get("/api/v1/stats")
+        assert r.status == 200
+        data = await r.json()
+        assert data == {"model": "mock-model", "stats": {}}
+
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}]})
+        assert r.status == 200
+
+        r = await client.get("/api/v1/stats")
+        data = await r.json()
+        assert data["model"] == "mock-model"
+        assert "ts" in data["stats"]
+        assert data["stats"]["tok_per_s"] > 0
+
+        # the streaming path writes last_stats through a separate branch
+        first_ts = data["stats"]["ts"]
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "stream": True})
+        assert r.status == 200
+        await r.read()
+        r = await client.get("/api/v1/stats")
+        data = await r.json()
+        assert "ts" in data["stats"] and data["stats"]["ts"] >= first_ts
+        assert data["stats"]["tok_per_s"] > 0
+    with_client(make_state(), scenario)

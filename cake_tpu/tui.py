@@ -10,6 +10,7 @@ from __future__ import annotations
 import curses
 import queue
 import threading
+import time
 
 
 class ChatSession:
@@ -28,6 +29,8 @@ class ChatSession:
         self.tokens: queue.Queue = queue.Queue()
         self.busy = False
         self.last_stats: dict = {}
+        self._topo_cache: dict | None = None
+        self._topo_expiry = 0.0
 
     def send(self, text: str):
         self.history.append({"role": "user", "content": text})
@@ -62,13 +65,32 @@ class ChatSession:
 
     def topology(self) -> dict:
         if self.api_url:
+            # the cluster view redraws ~20x/s; a 1 s TTL keeps the view
+            # live while capping HTTP at 2 req/s (and bounds how long a
+            # hung server can stall the UI loop to once per TTL window)
+            now = time.monotonic()
+            if self._topo_cache and now < self._topo_expiry:
+                return self._topo_cache
             try:
                 import requests
-                return requests.get(self.api_url.rstrip("/")
-                                    + "/api/v1/topology", timeout=5).json()
+                base = self.api_url.rstrip("/")
+                topo = requests.get(base + "/api/v1/topology",
+                                    timeout=5).json()
+                try:
+                    st = requests.get(base + "/api/v1/stats",
+                                      timeout=5).json().get("stats")
+                    if st:
+                        topo["stats"] = st
+                except Exception:
+                    pass               # stats are optional
             except Exception as e:
-                return {"error": str(e)}
+                topo = {"error": str(e)}
+            self._topo_cache = topo
+            self._topo_expiry = now + 1.0
+            return topo
         info = {"master": {"model": self.model_id}}
+        if self.last_stats:
+            info["stats"] = self.last_stats
         if self.gen is not None and hasattr(self.gen, "cfg"):
             cfg = self.gen.cfg
             info["master"].update({"arch": cfg.arch,
@@ -197,6 +219,29 @@ def _draw_cluster(stdscr, s: ChatSession, h, w):
         row += 1
         if row >= h - 2:
             break
+    st = topo.get("stats") or {}
+    if st and row < h - 4:
+        row += 1
+        line = []
+        if st.get("ttft_s") is not None:
+            line.append(f"ttft {st['ttft_s'] * 1000:.0f} ms")
+        if st.get("tok_per_s") is not None:
+            line.append(f"{st['tok_per_s']:.1f} tok/s")
+        p = st.get("prefill") or {}
+        if p.get("pipelined"):
+            line.append(f"prefill {p['chunks']}x{p['width']}-tok chunks")
+        stdscr.addnstr(row, 2, "last generation: " + "  ".join(line), w - 4,
+                       curses.A_BOLD)
+        row += 1
+        for hop, r in (st.get("stage_rtts") or {}).items():
+            if row >= h - 2:
+                break
+            desc = f"p50 {r.get('p50_ms')} ms  p95 {r.get('p95_ms')} ms"
+            if r.get("fwd_p50_ms") is not None:
+                desc += (f"  (compute {r['fwd_p50_ms']} ms"
+                         f" + wire {r['wire_p50_ms']} ms)")
+            stdscr.addnstr(row, 4, f"{hop}: {desc}", w - 6)
+            row += 1
     if "error" in topo:
         stdscr.addnstr(row + 1, 2, f"topology error: {topo['error']}", w - 4,
                        curses.A_DIM)

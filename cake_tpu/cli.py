@@ -35,6 +35,10 @@ def _add_common_model_args(p: argparse.ArgumentParser):
     p.add_argument("--tp", default=None,
                    help="in-host tensor parallelism: 'auto' shards over all "
                         "local devices, N over the first N (default: 1 chip)")
+    p.add_argument("--sp", type=int, default=None,
+                   help="in-host sequence parallelism: shard long-prompt "
+                        "prefill over N devices via ring attention "
+                        "(composes with --tp; tp*sp devices are used)")
 
 
 def _add_sampling_args(p: argparse.ArgumentParser):
@@ -60,7 +64,7 @@ def _build(args):
         cluster_key=args.cluster_key, topology_path=args.topology,
         download=not args.no_download,
         fp8_native=getattr(args, "fp8_native", False),
-        tp=getattr(args, "tp", None))
+        tp=getattr(args, "tp", None), sp=getattr(args, "sp", None))
 
 
 def cmd_run(args) -> int:

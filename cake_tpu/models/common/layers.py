@@ -19,6 +19,7 @@ from ...ops.moe import moe_ffn
 from .cache import update_kv_cache
 from .config import LayerSpec, ModelConfig
 
+
 # ---------------------------------------------------------------------------
 # Parameter initialization (random weights; checkpoint loading lives in
 # utils/loaders.py which produces the same pytree layout)
@@ -170,7 +171,7 @@ def make_rope(cfg: ModelConfig) -> dict:
 
 def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
                       layer_cache: dict, pos0, rope: dict, valid_len=None,
-                      flash_mode: str = "off"):
+                      flash_mode: str = "off", mesh=None):
     """x: [B, S, H], pos0: traced scalar (first absolute position).
     Returns (y [B, S, H], new_layer_cache)."""
     b, s, _ = x.shape
@@ -223,7 +224,25 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
         flash_mode == "fresh"
         or (flash_mode == "append" and spec.window is None
             and layer_cache is not None))
-    if use_flash and flash_mode == "fresh":
+    if flash_mode == "ring" and mesh is not None and spec.window is None:
+        # sp-sharded fresh prefill: sequence split over the mesh's sp axis,
+        # K/V blocks rotate via collective permute (parallel/ring_attention)
+        # so no device materializes the full sequence's scores. Exact for
+        # padded prompts: pad KEYS sit at positions > every real query, so
+        # the global causal mask hides them (pad query rows are garbage the
+        # last-valid-position slice never reads — same as single-shot
+        # padding). The KV cache stays in its usual (replicated/tp) layout:
+        # GSPMD inserts the sp all-gather at the scatter below, which IS
+        # the gather-KV-for-decode step. Only reached on all-full-attention
+        # models (mode selection requires every layer full + windowless:
+        # SWA layers have no windowed flash under ring, and their masked
+        # fallback is quadratic at exactly the lengths sp targets).
+        from ...parallel.ring_attention import ring_attention
+        y = ring_attention(q, k, v, mesh, scale=cfg.attn_scale)
+        new_cache = (update_kv_cache(layer_cache, k, v, pos0, valid_len)
+                     if layer_cache is not None else None)
+        use_flash = True          # skip the masked fallback below
+    elif use_flash and flash_mode == "fresh":
         # fresh-cache prefill: nothing in the cache is visible yet, so
         # causal flash over the in-pass K/V is exact, incl. SWA layers via
         # the kernel's window mask (Pallas; ref: flash-attn dispatch
@@ -304,35 +323,35 @@ def _ffn(cfg, spec, p, x):
 
 
 def _attn(cfg, spec, p, x, lc, pos0, rope, valid_len=None,
-          flash_mode="off"):
+          flash_mode="off", mesh=None):
     if spec.kind == "linear":
         from ..qwen3_5 import gdn_forward
         return gdn_forward(cfg, p["linear_attn"], x, lc, pos0, valid_len)
     return attention_forward(cfg, spec, p["self_attn"], x, lc, pos0, rope,
-                             valid_len, flash_mode)
+                             valid_len, flash_mode, mesh=mesh)
 
 
 def block_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
                   layer_cache: dict, pos0, rope: dict, valid_len=None,
-                  flash_mode: str = "off"):
+                  flash_mode: str = "off", mesh=None):
     """One decoder block; norm placement per family
     (ref: common/transformer.rs pre-norm; olmo2/block.rs post-norm;
     gemma3/block.rs sandwich)."""
     eps = cfg.rms_norm_eps
     if spec.norm_style == "pre":
         h = rms_norm(x, p["input_layernorm"]["weight"], eps)
-        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, flash_mode)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, flash_mode, mesh)
         x = x + attn_out
         h = rms_norm(x, p["post_attention_layernorm"]["weight"], eps)
         x = x + _ffn(cfg, spec, p, h)
     elif spec.norm_style == "post":
-        attn_out, layer_cache = _attn(cfg, spec, p, x, layer_cache, pos0, rope, valid_len, flash_mode)
+        attn_out, layer_cache = _attn(cfg, spec, p, x, layer_cache, pos0, rope, valid_len, flash_mode, mesh)
         x = x + rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
         x = x + rms_norm(_ffn(cfg, spec, p, x),
                          p["post_feedforward_layernorm"]["weight"], eps)
     elif spec.norm_style == "sandwich":
         h = rms_norm(x, p["input_layernorm"]["weight"], eps)
-        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, flash_mode)
+        attn_out, layer_cache = _attn(cfg, spec, p, h, layer_cache, pos0, rope, valid_len, flash_mode, mesh)
         attn_out = rms_norm(attn_out, p["post_attention_layernorm"]["weight"], eps)
         x = x + attn_out
         h = rms_norm(x, p["pre_feedforward_layernorm"]["weight"], eps)
@@ -346,7 +365,7 @@ def block_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
 
 def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
                    layer_range: tuple[int, int] | None = None, valid_len=None,
-                   flash_mode: str = "off"):
+                   flash_mode: str = "off", mesh=None):
     """Run a contiguous range of blocks over hidden states — the jit unit for
     both local stages and remote workers (ref: Forwarder.forward_batch /
     worker.rs op-batch execution, but compiled as ONE device program)."""
@@ -362,7 +381,7 @@ def forward_layers(cfg: ModelConfig, params: dict, x, cache: dict, pos0,
     for j, spec in enumerate(specs):
         x, new_layers[j] = block_forward(cfg, spec, params["layers"][j], x,
                                          cache["layers"][j], pos0, rope,
-                                         valid_len, flash_mode)
+                                         valid_len, flash_mode, mesh=mesh)
     advance = x.shape[1] if valid_len is None else valid_len
     new_cache = {"layers": new_layers, "pos": pos0 + advance}
     return x, new_cache

@@ -159,12 +159,15 @@ class TextModel:
             check_tp_divisibility(cfg, mesh)
         self.params = shard_params(params, mesh)
         self._rng = jax.random.PRNGKey(seed)
+        self.last_prefill_mode: str | None = None
         self._build()
 
     # -- compiled programs --------------------------------------------------
 
     def _build(self):
         cfg = self.cfg
+        mesh = self.mesh     # static per instance: the ring branch's mesh
+                             # is baked into this model's compiled prefill
 
         @functools.partial(jax.jit, donate_argnums=(2,),
                            static_argnames=("flash_mode",))
@@ -172,7 +175,7 @@ class TextModel:
             x = embed_tokens(cfg, params, tokens)
             x, cache = forward_layers(cfg, params, x, cache, pos0,
                                       valid_len=valid_len,
-                                      flash_mode=flash_mode)
+                                      flash_mode=flash_mode, mesh=mesh)
             # logits at the last valid position
             idx = jnp.clip(valid_len - 1, 0, x.shape[1] - 1)
             x_last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
@@ -286,6 +289,19 @@ class TextModel:
 
     # -- inference ----------------------------------------------------------
 
+    def _sp_size(self) -> int:
+        m = self.mesh
+        return (m.shape["sp"] if m is not None and "sp" in m.axis_names
+                else 1)
+
+    def _ring_ok(self) -> bool:
+        """Ring prefill requires every layer full + windowless: SWA layers
+        have no windowed flash under ring (their fallback is quadratic at
+        exactly the lengths sp targets) and GDN scans would serialize over
+        a sharded sequence."""
+        return all(s.kind == "full" and s.window is None
+                   for s in self.cfg.layer_specs())
+
     def prefill(self, cache, token_ids: Iterable[int], pos0: int = 0):
         ids = list(token_ids)
         n = len(ids)
@@ -294,6 +310,15 @@ class TextModel:
         padded = np.zeros((1, bkt), np.int32)
         padded[0, :n] = ids
         flash_mode = select_flash_mode(pos0, bkt, cap)
+        # sequence-parallel prefill: with an sp mesh axis, fresh full-prompt
+        # prefill runs ring attention (sequence sharded over sp, K/V blocks
+        # rotating via collective permute) — the long-context path the
+        # reference lacks. Decode is untouched: the cache scatter gathers
+        # K/V back to the cache's own layout.
+        if (flash_mode == "fresh" and self._sp_size() > 1
+                and bkt % self._sp_size() == 0 and self._ring_ok()):
+            flash_mode = "ring"
+        self.last_prefill_mode = flash_mode
         logits, cache = self._prefill(self.params, jnp.asarray(padded), cache,
                                       jnp.asarray(pos0, jnp.int32),
                                       jnp.asarray(n, jnp.int32),

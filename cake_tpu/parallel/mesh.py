@@ -35,7 +35,8 @@ def single_device_mesh() -> Mesh:
     return Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("tp",))
 
 
-def serving_mesh(tp: int | str | None) -> Mesh | None:
+def serving_mesh(tp: int | str | None,
+                 sp: int | None = None) -> Mesh | None:
     """Mesh for the serve/run/worker product path (the in-host tensor
     parallelism the reference approximates with its multi-GPU layer split,
     ref: worker.rs:126-229).
@@ -43,22 +44,34 @@ def serving_mesh(tp: int | str | None) -> Mesh | None:
     tp: None/0/1 -> None (single device, no mesh);
         "auto"   -> all local devices;
         int N    -> first N local devices (error if fewer exist).
+    sp: sequence-parallel axis size (ring-attention prefill); composes
+        with tp — tp*sp devices are used.
     """
     devices = jax.devices()
-    if tp in (None, 0, 1, "1"):
+    sp = int(sp or 1)
+    if tp in (None, 0, 1, "1") and sp <= 1:
         return None
     if tp == "auto":
-        n = len(devices)
-        if n == 1:
+        if sp > len(devices):
+            raise ValueError(
+                f"--sp {sp}: only {len(devices)} local device(s) available")
+        n = max(len(devices) // sp, 1)
+        if n * sp == 1:
             return None
     else:
-        n = int(tp)
-        if n > len(devices):
+        n = int(tp) if tp not in (None, 0) else 1
+        if n * sp > len(devices):
             raise ValueError(
-                f"--tp {n}: only {len(devices)} local device(s) available")
-        if n <= 1:
+                f"--tp {n} --sp {sp}: only {len(devices)} local device(s) "
+                "available")
+        if n * sp <= 1:
             return None
-    return make_mesh({"tp": n}, devices=devices[:n])
+    axes = {}
+    if sp > 1:
+        axes["sp"] = sp
+    if n > 1 or not axes:
+        axes["tp"] = n
+    return make_mesh(axes, devices=devices[:n * sp])
 
 
 def axis_size(mesh: Mesh, name: str) -> int:

@@ -57,9 +57,13 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
     return acc, m, l
 
 
-def ring_attention_sharded(q, k, v, axis_name: str, scale: float | None = None):
+def ring_attention_sharded(q, k, v, axis_name: str, scale: float | None = None,
+                           vary_axes: tuple[str, ...] = ()):
     """Body run per-device under shard_map: q/k/v are the local sequence
-    shards [B, S_local, H(.kv), D]; global sequence = concat over the axis."""
+    shards [B, S_local, H(.kv), D]; global sequence = concat over the axis.
+    vary_axes: additional manual mesh axes the inputs vary over (e.g. the
+    tp head axis) — the accumulators must be cast varying over them too or
+    the fori_loop carry type mismatches."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     n = jax.lax.axis_size(axis_name)
@@ -69,11 +73,13 @@ def ring_attention_sharded(q, k, v, axis_name: str, scale: float | None = None):
     q_pos = (me * s_local + jnp.arange(s_local, dtype=jnp.int32))[None, :]
     q_pos = jnp.broadcast_to(q_pos, (b, s_local))
 
-    # pvary: accumulators start device-varying over the ring axis so the
-    # fori_loop carry type matches (shard_map manual-axes typing rule)
-    acc = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), (axis_name,), to='varying')
-    m = jax.lax.pcast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), (axis_name,), to='varying')
-    l = jax.lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), (axis_name,), to='varying')
+    # pvary: accumulators start device-varying over the ring axis (and any
+    # extra manual axes) so the fori_loop carry type matches (shard_map
+    # manual-axes typing rule)
+    vary = (axis_name, *vary_axes)
+    acc = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), vary, to='varying')
+    m = jax.lax.pcast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), vary, to='varying')
+    l = jax.lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), vary, to='varying')
 
     def step(i, carry):
         acc, m, l, k_blk, v_blk = carry
@@ -95,10 +101,19 @@ def ring_attention_sharded(q, k, v, axis_name: str, scale: float | None = None):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   scale: float | None = None):
-    """q/k/v: [B, S, H(.kv), D] global tensors; S must divide by mesh[axis]."""
-    fn = functools.partial(ring_attention_sharded, axis_name=axis, scale=scale)
-    spec = P(None, axis, None, None)
+                   scale: float | None = None, head_axis: str = "tp"):
+    """q/k/v: [B, S, H(.kv), D] global tensors; S must divide by mesh[axis].
+
+    Composes with tensor parallelism: when the mesh also has a >1
+    `head_axis`, heads stay sharded over it inside the ring (head blocks
+    are aligned between q and kv, so local GQA grouping is preserved) —
+    otherwise the shard_map region would silently all-gather the heads
+    and compute the full attention redundantly on every tp member."""
+    h = (head_axis if head_axis in mesh.axis_names
+         and mesh.shape[head_axis] > 1 else None)
+    fn = functools.partial(ring_attention_sharded, axis_name=axis, scale=scale,
+                           vary_axes=(h,) if h else ())
+    spec = P(None, axis, h, None)
     mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec)
     return mapped(q, k, v)

@@ -164,7 +164,7 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                      topology_path: str | None = None,
                      discovery_timeout: float = 3.0,
                      download: bool = True, fp8_native: bool = False,
-                     tp: int | str | None = None):
+                     tp: int | str | None = None, sp: int | None = None):
     """Returns (generator, tokenizer, model_id, topology|None).
 
     With a cluster key: discover workers (or use the topology file), run
@@ -180,7 +180,14 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
     Applies to the local model and to the master's local stages alike.
     """
     from .parallel import serving_mesh
-    mesh = serving_mesh(tp)
+    if sp and int(sp) > 1 and cluster_key:
+        # ring prefill is selected only by the local TextModel; the
+        # distributed master's stages would just replicate over the sp
+        # axis — sp-times the devices doing redundant work, silently
+        log.warning("--sp applies to local serving only; ignoring it for "
+                    "the cluster path")
+        sp = None
+    mesh = serving_mesh(tp, sp=sp)
     model_dir = resolve_model(model, download=download)
     cfg, quant, raw = load_config_and_quant(model_dir, arch)
     if mesh is not None:

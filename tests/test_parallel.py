@@ -94,3 +94,51 @@ def test_ep_moe_sharded_forward():
     got = jax.jit(lambda p, t: forward_train(cfg, p, t))(sharded, toks)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-3,
                                rtol=1e-3)
+
+
+def test_sp_ring_prefill_serving_parity():
+    """Product-path sequence-parallel prefill: TextModel over an sp mesh
+    takes the ring-attention branch for fresh prefill (last_prefill_mode
+    == "ring") and must match the meshless model's logits AND the
+    subsequent greedy decode exactly — the cache scatter gathers K/V back
+    so decode is byte-for-byte the ordinary path."""
+    from cake_tpu.models import SamplingConfig
+
+    cfg = tiny_config("qwen3")
+    params = init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    prompt = [(i * 7 + 3) % 250 for i in range(40)]
+
+    ref_model = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+    want, _ = ref_model.generate(prompt, max_new_tokens=8,
+                                 sampling=SamplingConfig(temperature=0.0))
+    assert ref_model.last_prefill_mode == "fresh"
+
+    mesh = make_mesh({"sp": 8})
+    sp_model = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64,
+                         mesh=mesh)
+    got, _ = sp_model.generate(prompt, max_new_tokens=8,
+                               sampling=SamplingConfig(temperature=0.0))
+    assert sp_model.last_prefill_mode == "ring"
+    assert got == want
+
+
+def test_sp_tp_composed_ring_prefill_parity():
+    """tp x sp composed mesh: heads sharded over tp INSIDE the ring
+    (parallel/ring_attention head_axis) while the sequence shards over sp."""
+    from cake_tpu.models import SamplingConfig
+
+    cfg = tiny_config("qwen3", num_key_value_heads=2)
+    params = init_params(cfg, jax.random.PRNGKey(8), jnp.float32)
+    prompt = [(i * 11 + 5) % 250 for i in range(32)]
+
+    ref_model = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+    want, _ = ref_model.generate(prompt, max_new_tokens=6,
+                                 sampling=SamplingConfig(temperature=0.0))
+
+    mesh = make_mesh({"sp": 4, "tp": 2})
+    sp_model = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64,
+                         mesh=mesh)
+    got, _ = sp_model.generate(prompt, max_new_tokens=6,
+                               sampling=SamplingConfig(temperature=0.0))
+    assert sp_model.last_prefill_mode == "ring"
+    assert got == want

@@ -231,12 +231,15 @@ def attention_forward(cfg: ModelConfig, spec: LayerSpec, p: dict, x,
         # padded prompts: pad KEYS sit at positions > every real query, so
         # the global causal mask hides them (pad query rows are garbage the
         # last-valid-position slice never reads — same as single-shot
-        # padding). The KV cache stays in its usual (replicated/tp) layout:
-        # GSPMD inserts the sp all-gather at the scatter below, which IS
-        # the gather-KV-for-decode step. Only reached on all-full-attention
-        # models (mode selection requires every layer full + windowless:
-        # SWA layers have no windowed flash under ring, and their masked
-        # fallback is quadratic at exactly the lengths sp targets).
+        # padding). The KV cache itself is length-sharded over sp
+        # (parallel/sharding.cache_shardings), so the scatter below writes
+        # each device's sequence shard LOCALLY — context memory scales
+        # with sp, and decode attends over the sharded length with GSPMD
+        # inserting the softmax-reduction collectives. Only reached on
+        # all-full-attention models (mode selection requires every layer
+        # full + windowless: SWA layers have no windowed flash under ring,
+        # and their masked fallback is quadratic at exactly the lengths sp
+        # targets).
         from ...parallel.ring_attention import ring_attention
         y = ring_attention(q, k, v, mesh, scale=cfg.attn_scale)
         new_cache = (update_kv_cache(layer_cache, k, v, pos0, valid_len)

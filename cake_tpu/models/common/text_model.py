@@ -157,6 +157,15 @@ class TextModel:
         from ...parallel.sharding import check_tp_divisibility, shard_params
         if mesh is not None:
             check_tp_divisibility(cfg, mesh)
+            sp = mesh.shape.get("sp", 1)
+            if sp > 1 and (self.max_cache_len % sp or sp & (sp - 1)):
+                # otherwise cache_shardings silently replicates the top KV
+                # bucket — the context-memory scaling sp exists for
+                # vanishes at exactly the size where it matters
+                raise ValueError(
+                    f"sp={sp} must be a power of two dividing "
+                    f"max_cache_len {self.max_cache_len} so every KV "
+                    "growth bucket shards over it")
         self.params = shard_params(params, mesh)
         self._rng = jax.random.PRNGKey(seed)
         self.last_prefill_mode: str | None = None

@@ -12,7 +12,11 @@ chunks stay head-aligned — see layers.py init_attention_params):
   gate/up_proj [I, H] : rows over tp
   down_proj [H, I]    : cols over tp
   MoE expert banks    : leading E axis over ep (+ inner tp)
-  KV cache            : heads over tp, batch over dp
+  KV cache            : heads over tp, batch over dp, LENGTH over sp
+                        (context memory scales across the sp devices;
+                        ring prefill writes each sequence shard locally,
+                        decode attends over the sharded length with GSPMD
+                        inserting the softmax-reduction collectives)
 """
 from __future__ import annotations
 
@@ -84,6 +88,7 @@ def params_shardings(params, mesh: Mesh):
 
 def cache_shardings(cache, mesh: Mesh):
     dp, tp = _ax(mesh, "dp"), _ax(mesh, "tp")
+    sp = _ax(mesh, "sp")
 
     def _fit(leaf, spec: P) -> P:
         """Drop axes the leaf's dims can't be divided by (batch=1 under dp,
@@ -100,14 +105,20 @@ def cache_shardings(cache, mesh: Mesh):
         name = str(getattr(path[-1], "key", getattr(path[-1], "idx", "")))
         ndim = getattr(leaf, "ndim", 0)
         spec = P()
+        # sp: KV buffers shard over the LENGTH axis, so context memory
+        # scales across the sp devices (ring prefill writes each shard
+        # locally; decode attention over the sharded length is partial
+        # per device with GSPMD inserting the softmax-reduction
+        # collectives). _fit drops sp when the capacity (e.g. an SWA
+        # window) is not divisible.
         if ndim == 4 and name in ("k", "v"):
-            spec = P(dp, None, tp, None)
+            spec = P(dp, sp, tp, None)
         elif ndim == 4 and name == "state":     # GDN [B, Hv, Dk, Dv]
             spec = P(dp, tp, None, None)
         elif ndim == 3 and name == "conv":      # GDN conv state [B, C, K-1]
             spec = P(dp, tp, None)
         elif ndim == 2 and name == "pos":
-            spec = P(dp, None)
+            spec = P(dp, sp)
         return NamedSharding(mesh, _fit(leaf, spec))
     return jax.tree_util.tree_map_with_path(f, cache)
 

@@ -142,3 +142,17 @@ def test_sp_tp_composed_ring_prefill_parity():
                                sampling=SamplingConfig(temperature=0.0))
     assert sp_model.last_prefill_mode == "ring"
     assert got == want
+
+
+def test_sp_cache_length_sharded():
+    """On an sp mesh the KV buffers shard over the LENGTH axis — context
+    memory scales across devices, the actual reason to serve with sp."""
+    cfg = tiny_config("qwen3")
+    params = init_params(cfg, jax.random.PRNGKey(9), jnp.float32)
+    mesh = make_mesh({"sp": 8})
+    model = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64,
+                      mesh=mesh)
+    cache = model.new_cache(1, kv_len=64)
+    k = cache["layers"][0]["k"]
+    shard_shapes = {s.data.shape for s in k.addressable_shards}
+    assert shard_shapes == {(1, 64 // 8, *k.shape[2:])}, shard_shapes

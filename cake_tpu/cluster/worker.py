@@ -74,6 +74,7 @@ class WorkerServer:
         self.state = WorkerState()
         self._advertiser = None
         self._server: asyncio.AbstractServer | None = None
+        self._writers: set = set()       # live connections, closed on stop()
         self.stats = {"ops": 0, "tokens": 0, "fwd_s": 0.0}
 
     # -- lifecycle ---------------------------------------------------------
@@ -101,6 +102,15 @@ class WorkerServer:
             self._advertiser.stop()
         if self._server:
             self._server.close()
+            # close LIVE connections too: Server.close() only stops
+            # accepting, so without this a "stopped" worker keeps serving
+            # forwards indefinitely (masters see a healthy worker that the
+            # operator believes is down)
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
             # bounded: py3.12 wait_closed blocks until all live master
             # connections drop, which may be never during teardown
             try:
@@ -120,10 +130,15 @@ class WorkerServer:
             # (measured: p50 1 ms / mean 30 ms bimodal RTTs on localhost)
             import socket as _socket
             sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        # register BEFORE auth: a connection suspended mid-handshake when
+        # stop() runs must be closed too, or it survives shutdown and
+        # serves forwards on a worker the operator believes is down
+        self._writers.add(writer)
         try:
             await authenticate_as_worker(reader, writer, self.cluster_key)
         except Exception as e:
             log.warning("auth failed from %s: %s", peer, e)
+            self._writers.discard(writer)
             writer.close()
             return
         cache = None
@@ -160,6 +175,7 @@ class WorkerServer:
         except Exception as e:
             log.exception("connection error from %s: %s", peer, e)
         finally:
+            self._writers.discard(writer)
             writer.close()
 
     # -- setup ---------------------------------------------------------------

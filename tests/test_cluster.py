@@ -616,3 +616,68 @@ def test_pipelined_prefill_matches_local(cluster_model_dir):
         if loop and srv:
             asyncio.run_coroutine_threadsafe(srv.stop(), loop)
         t.join(timeout=5)
+
+
+def test_worker_error_keeps_connection_alive(cluster_model_dir):
+    """A failed forward must produce a worker_error reply (raised master-
+    side) WITHOUT killing the worker loop — the next valid request on the
+    same connection succeeds (ref behavior: per-op WorkerError keeps the
+    worker alive, worker.rs:425-431). A dead worker must then raise, not
+    hang."""
+    from cake_tpu.cluster.master import DistributedTextModel, master_setup
+    from cake_tpu.models import SamplingConfig
+
+    cfg, params, mdir, wcache = cluster_model_dir
+    ready = threading.Event()
+    holder, t = _start_worker_thread("we", "testkey", wcache + "-err", ready)
+    assert ready.wait(10)
+    port = holder["port"]
+
+    try:
+        setup = master_setup(
+            mdir, "testkey", cfg,
+            workers=[{"name": "we", "host": "127.0.0.1", "port": port,
+                      "caps": {"backend": "cpu", "device": "cpu",
+                               "memory_bytes": 8 << 30, "tflops": 1.0}}],
+            assignments={"we": (1, 3)},
+            dtype_str="f32", max_cache_len=64)
+        dist = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                    dtype=jnp.float32, max_cache_len=64)
+        stage = next(s for s in dist.stages if s.kind == "remote")
+
+        # malformed request: hidden width 7 != hidden_size -> worker-side
+        # failure -> worker_error reply raised here
+        bad = np.zeros((1, 2, 7), np.float32)
+        with pytest.raises(RuntimeError, match="worker we"):
+            stage.runner.forward_hidden(bad, None, 0, 2)
+
+        # same connection, next valid generation succeeds
+        toks, _ = dist.generate([1, 2, 3, 4, 5], max_new_tokens=6,
+                                sampling=SamplingConfig(temperature=0.0))
+        assert len(toks) >= 1
+
+        # dead worker: raises promptly instead of hanging. stop() closes
+        # the live connection synchronously before its first await, so the
+        # assertion below holds even if the worker loop winds down before
+        # the stop future resolves.
+        loop, srv = holder["loop"], holder["server"]
+        try:
+            asyncio.run_coroutine_threadsafe(srv.stop(), loop).result(
+                timeout=5)
+        except Exception:
+            pass
+        with pytest.raises(Exception):
+            dist.generate([1, 2, 3], max_new_tokens=4,
+                          sampling=SamplingConfig(temperature=0.0))
+        for c in setup.clients:
+            c.close()
+    finally:
+        loop = holder.get("loop")
+        srv = holder.get("server")
+        if loop and srv:
+            try:
+                asyncio.run_coroutine_threadsafe(srv.stop(), loop).result(
+                    timeout=5)
+            except Exception:
+                pass
+        t.join(timeout=5)

@@ -394,88 +394,110 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
     worker_by_name = {w["name"]: w for w in workers}
     n = cfg.num_hidden_layers
 
-    for name, (start, end) in ordered:
-        w = worker_by_name[name]
-        client = RemoteStage(w["host"], w["port"], cluster_key, name).connect()
-        names = transfer.subset_tensor_names(storage, start, end, n,
-                                             include_embed=False,
-                                             include_head=False)
-        # expected sizes always sent so the worker can validate its cache
-        # even when pushing is disabled (header-only synthesis: no data read)
-        total, _ = transfer.synthesize_safetensors(storage, names)
-        expected = {"model.safetensors": total}
-        assignment = proto.layer_assignment(
-            model_id=mhash, arch=cfg.arch, config=config_raw,
-            start=start, end=end, dtype=dtype_str, cache_key=ckey,
-            push_weights=push_weights, fp8_native=fp8_native)
-        assignment["max_cache_len"] = max_cache_len
-        assignment["expected_files"] = expected
-        # "full": workers compile every growth bucket's decode + prefill
-        # shape during setup so serving never pays an in-band compile;
-        # "decode": smallest-bucket decode only (fast setup); "none"
-        assignment["warm"] = warm
-        resp = client.assign(assignment)
-        if resp.get("t") == "worker_error":
-            raise RuntimeError(f"worker {name}: {resp['error']}")
-        if push_weights and not transfer_cached(resp):
-            start_off = (resp.get("resume") or {}).get("model.safetensors", 0)
-            total, chunks = transfer.synthesize_safetensors(storage, names)
-            client.push_weights(
-                transfer.encode_chunks("model.safetensors", total, chunks,
-                                       start_offset=start_off))
-        client.wait_ready()
-        clients.append(client)
-        log.info("worker %s ready with layers [%d,%d)", name, start, end)
+    try:
+        for name, (start, end) in ordered:
+            w = worker_by_name[name]
+            client = RemoteStage(w["host"], w["port"], cluster_key,
+                                 name).connect()
+            # registered immediately: a failure anywhere below (this worker
+            # or a later one) must not leak the already-open sockets and
+            # their per-connection server state
+            clients.append(client)
+            names = transfer.subset_tensor_names(storage, start, end, n,
+                                                 include_embed=False,
+                                                 include_head=False)
+            # expected sizes always sent so the worker can validate its
+            # cache even when pushing is disabled (header-only synthesis:
+            # no data read)
+            total, _ = transfer.synthesize_safetensors(storage, names)
+            expected = {"model.safetensors": total}
+            assignment = proto.layer_assignment(
+                model_id=mhash, arch=cfg.arch, config=config_raw,
+                start=start, end=end, dtype=dtype_str, cache_key=ckey,
+                push_weights=push_weights, fp8_native=fp8_native)
+            assignment["max_cache_len"] = max_cache_len
+            assignment["expected_files"] = expected
+            # "full": workers compile every growth bucket's decode + prefill
+            # shape during setup so serving never pays an in-band compile;
+            # "decode": smallest-bucket decode only (fast setup); "none"
+            assignment["warm"] = warm
+            resp = client.assign(assignment)
+            if resp.get("t") == "worker_error":
+                raise RuntimeError(f"worker {name}: {resp['error']}")
+            if push_weights and not transfer_cached(resp):
+                start_off = (resp.get("resume") or {}).get(
+                    "model.safetensors", 0)
+                total, chunks = transfer.synthesize_safetensors(storage,
+                                                                names)
+                client.push_weights(
+                    transfer.encode_chunks("model.safetensors", total,
+                                           chunks, start_offset=start_off))
+            client.wait_ready()
+            log.info("worker %s ready with layers [%d,%d)", name, start, end)
 
-    # master keeps the unassigned layers
-    assigned = set()
-    for start, end in assignments.values():
-        assigned |= set(range(start, end))
-    master_layers = [i for i in range(n) if i not in assigned]
+        # master keeps the unassigned layers
+        assigned = set()
+        for start, end in assignments.values():
+            assigned |= set(range(start, end))
+        master_layers = [i for i in range(n) if i not in assigned]
 
-    # build the ordered stage chain
-    stages: list[Stage] = []
-    ranges: list[tuple[str, int, int, object]] = []
-    for name, (start, end) in ordered:
-        ranges.append(("remote", start, end,
-                       clients[[nm for nm, _ in ordered].index(name)]))
-    for lo, hi in _contiguous(master_layers):
-        ranges.append(("local", lo, hi, None))
-    ranges.sort(key=lambda r: r[1])
+        # build the ordered stage chain
+        stages: list[Stage] = []
+        ranges: list[tuple[str, int, int, object]] = []
+        for name, (start, end) in ordered:
+            ranges.append(("remote", start, end,
+                           clients[[nm for nm, _ in ordered].index(name)]))
+        for lo, hi in _contiguous(master_layers):
+            ranges.append(("local", lo, hi, None))
+        ranges.sort(key=lambda r: r[1])
 
-    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32,
-             "f16": jnp.float16}.get(dtype_str, jnp.bfloat16)
-    quant = None
-    if fp8_native:
-        from ..utils.quant import fp8_native_quant
-        quant = fp8_native_quant()
-    master_params = load_model_params(cfg, model_dir, dtype, quant=quant,
-                                      layer_range=(0, 0),
-                                      include_embed=True, include_head=True)
-    for kind, lo, hi, runner in ranges:
-        if kind == "local":
-            p = load_model_params(cfg, model_dir, dtype, quant=quant,
-                                  layer_range=(lo, hi),
-                                  include_embed=False, include_head=False)
-            from ..parallel.sharding import shard_cache
-            runner = LocalStage(cfg, p, lo, hi, mesh=mesh)
-            cache = shard_cache(init_cache(cfg, 1, max_cache_len, dtype,
-                                           (lo, hi)), mesh)
-            stages.append(Stage("local", lo, hi, runner, cache))
-        else:
-            stages.append(Stage("remote", lo, hi, runner))
+        dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32,
+                 "f16": jnp.float16}.get(dtype_str, jnp.bfloat16)
+        quant = None
+        if fp8_native:
+            from ..utils.quant import fp8_native_quant
+            quant = fp8_native_quant()
+        master_params = load_model_params(cfg, model_dir, dtype, quant=quant,
+                                          layer_range=(0, 0),
+                                          include_embed=True, include_head=True)
+        for kind, lo, hi, runner in ranges:
+            if kind == "local":
+                p = load_model_params(cfg, model_dir, dtype, quant=quant,
+                                      layer_range=(lo, hi),
+                                      include_embed=False, include_head=False)
+                from ..parallel.sharding import shard_cache
+                runner = LocalStage(cfg, p, lo, hi, mesh=mesh)
+                cache = shard_cache(init_cache(cfg, 1, max_cache_len, dtype,
+                                               (lo, hi)), mesh)
+                stages.append(Stage("local", lo, hi, runner, cache))
+            else:
+                stages.append(Stage("remote", lo, hi, runner))
 
-    topo = Topology.from_dict({
-        name: {"host": f"{worker_by_name[name]['host']}:"
-                       f"{worker_by_name[name]['port']}",
-               "layers": [f"model.layers.{s}-{e - 1}"],
-               "memory_bytes": worker_by_name[name]["caps"]["memory_bytes"],
-               "tflops": worker_by_name[name]["caps"]["tflops"],
-               "backend": worker_by_name[name]["caps"].get("backend", "")}
-        for name, (s, e) in assignments.items()})
-    storage.close()
-    return MasterSetup(cfg=cfg, topology=topo, stages=stages,
-                       master_params=master_params, clients=clients)
+        topo = Topology.from_dict({
+            name: {"host": f"{worker_by_name[name]['host']}:"
+                           f"{worker_by_name[name]['port']}",
+                   "layers": [f"model.layers.{s}-{e - 1}"],
+                   "memory_bytes": worker_by_name[name]["caps"]["memory_bytes"],
+                   "tflops": worker_by_name[name]["caps"]["tflops"],
+                   "backend": worker_by_name[name]["caps"].get("backend", "")}
+            for name, (s, e) in assignments.items()})
+        storage.close()
+        return MasterSetup(cfg=cfg, topology=topo, stages=stages,
+                           master_params=master_params, clients=clients)
+    except BaseException:
+        # a failure ANYWHERE in setup (worker connect/assign/push, master
+        # local-stage load, cache init) must not leak the already-open
+        # worker sockets or the checkpoint storage handles
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        try:
+            storage.close()
+        except Exception:
+            pass
+        raise
 
 
 def transfer_cached(ack_msg: dict) -> bool:

@@ -681,3 +681,45 @@ def test_worker_error_keeps_connection_alive(cluster_model_dir):
             except Exception:
                 pass
         t.join(timeout=5)
+
+
+def test_master_setup_partial_failure_closes_connections(cluster_model_dir):
+    """If a later worker fails during master_setup, the already-connected
+    workers' sockets must be closed, not leaked (the worker would keep
+    per-connection state for a master that no longer exists)."""
+    from cake_tpu.cluster.master import master_setup
+
+    cfg, params, mdir, wcache = cluster_model_dir
+    ready = threading.Event()
+    holder, t = _start_worker_thread("wa", "testkey", wcache + "-pf", ready)
+    assert ready.wait(10)
+    port = holder["port"]
+
+    caps = {"backend": "cpu", "device": "cpu", "memory_bytes": 8 << 30,
+            "tflops": 1.0}
+    # second worker points at a dead port -> connect fails mid-setup
+    workers = [{"name": "wa", "host": "127.0.0.1", "port": port,
+                "caps": caps},
+               {"name": "wdead", "host": "127.0.0.1", "port": 1,
+                "caps": caps}]
+    try:
+        with pytest.raises(Exception):
+            master_setup(mdir, "testkey", cfg, workers,
+                         assignments={"wa": (1, 2), "wdead": (2, 3)},
+                         dtype_str="f32", max_cache_len=64)
+        # wa's connection must drain to zero (close propagated)
+        deadline = time.time() + 10
+        srv = holder["server"]
+        while time.time() < deadline and srv._writers:
+            time.sleep(0.2)
+        assert not srv._writers, "leaked master connection on the worker"
+    finally:
+        loop = holder.get("loop")
+        srv = holder.get("server")
+        if loop and srv:
+            try:
+                asyncio.run_coroutine_threadsafe(srv.stop(), loop).result(
+                    timeout=5)
+            except Exception:
+                pass
+        t.join(timeout=5)

@@ -156,3 +156,27 @@ def test_sp_cache_length_sharded():
     k = cache["layers"][0]["k"]
     shard_shapes = {s.data.shape for s in k.addressable_shards}
     assert shard_shapes == {(1, 64 // 8, *k.shape[2:])}, shard_shapes
+
+
+@pytest.mark.parametrize("arch", ["llama", "qwen2", "olmo2", "phi4"])
+def test_sp_ring_prefill_across_families(arch):
+    """Ring prefill parity across norm styles (pre/post), QKV bias,
+    partial RoPE — families whose layer stacks are all-full attention.
+    Greedy output must match the meshless model exactly."""
+    from cake_tpu.models import SamplingConfig
+
+    cfg = tiny_config(arch)
+    params = init_params(cfg, jax.random.PRNGKey(13), jnp.float32)
+    prompt = [(i * 5 + 2) % 250 for i in range(40)]
+
+    ref = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+    want, _ = ref.generate(prompt, max_new_tokens=6,
+                           sampling=SamplingConfig(temperature=0.0))
+
+    mesh = make_mesh({"sp": 8})
+    spm = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64,
+                    mesh=mesh)
+    got, _ = spm.generate(prompt, max_new_tokens=6,
+                          sampling=SamplingConfig(temperature=0.0))
+    assert spm.last_prefill_mode == "ring"
+    assert got == want

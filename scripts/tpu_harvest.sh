@@ -3,22 +3,28 @@
 # TPU-mode autoresearch gates, and the micro sweeps the moment the chip is
 # healthy; each stage tees to its artifact so partial progress survives.
 # Usage: scripts/tpu_harvest.sh [round-suffix, default r05]
+#        CPU=1 scripts/tpu_harvest.sh rehearsal   # CPU dress rehearsal
 set -uo pipefail
 cd "$(dirname "$0")/.."
 R="${1:-r05}"
+if [ "${CPU:-}" = "1" ]; then
+  BCPU="--cpu --smoke"; FCPU="--cpu --smoke"; GMODE=cpu; MCPU="--cpu"
+else
+  BCPU=""; FCPU=""; GMODE=tpu; MCPU=""
+fi
 
 echo "[harvest] headline bench.py" >&2
-python bench.py --probe-budget 600 | tail -1 | tee "BENCH_headline_${R}.json"
+python bench.py --probe-budget 600 $BCPU | tail -1 | tee "BENCH_headline_${R}.json"
 
 echo "[harvest] bench_full matrix" >&2
-python bench_full.py --probe-budget 300 | tee "BENCH_FULL_${R}.json"
+python bench_full.py --probe-budget 300 $FCPU | tee "BENCH_FULL_${R}.json"
 
 echo "[harvest] micro: moe crossover + flash + decode" >&2
-python benches/bench_micro.py --filter moe > "MOE_MICRO_${R}.json" 2>/dev/null
-python benches/bench_micro.py --filter flash >> "MOE_MICRO_${R}.json" 2>/dev/null
+python benches/bench_micro.py --filter moe $MCPU > "MOE_MICRO_${R}.json" 2>/dev/null
+python benches/bench_micro.py --filter flash $MCPU >> "MOE_MICRO_${R}.json" 2>/dev/null
 cat "MOE_MICRO_${R}.json"
 
-echo "[harvest] gates (tpu mode)" >&2
-python scripts/run_gates.py --mode tpu --out "GATES_${R}_tpu.json" --timeout 1500
+echo "[harvest] gates (${GMODE} mode)" >&2
+python scripts/run_gates.py --mode "$GMODE" --out "GATES_${R}_${GMODE}.json" --timeout 1500
 
 echo "[harvest] done" >&2

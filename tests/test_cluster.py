@@ -379,9 +379,11 @@ def test_worker_cache_hit_skips_push(cluster_model_dir):
                         fromlist=["TensorStorage"]).TensorStorage.from_model_dir(mdir)
         names = T.subset_tensor_names(st, 1, 3, cfg.num_hidden_layers)
         total, _ = T.synthesize_safetensors(st, names)
+        with open(os.path.join(mdir, "config.json")) as f:
+            cfg_raw = json.load(f)
         a = P.layer_assignment(
             model_id=T.model_hash(mdir), arch=cfg.arch,
-            config=json.load(open(os.path.join(mdir, "config.json"))),
+            config=cfg_raw,
             start=1, end=3, dtype="f32",
             cache_key=T.cache_key(cluster_hash("testkey"), T.model_hash(mdir)),
             push_weights=True)

@@ -7,9 +7,12 @@ import json
 import sys
 
 
-def chat_local(gen, model_id: str, sampling, max_tokens: int) -> int:
+def chat_local(gen, model_id: str, sampling, max_tokens: int,
+               system_prompt: str | None = None) -> int:
     print(f"chat with {model_id} — /quit to exit, /reset to clear history")
-    history: list[dict] = []
+    seed = ([{"role": "system", "content": system_prompt}]
+            if system_prompt else [])
+    history: list[dict] = list(seed)
     while True:
         try:
             line = input("\n> ").strip()
@@ -21,7 +24,7 @@ def chat_local(gen, model_id: str, sampling, max_tokens: int) -> int:
         if line in ("/quit", "/exit"):
             return 0
         if line == "/reset":
-            history.clear()
+            history[:] = list(seed)
             print("(history cleared)")
             continue
         history.append({"role": "user", "content": line})
@@ -62,11 +65,14 @@ def stream_chat_sse(api_url: str, messages: list[dict],
                 yield delta["content"]
 
 
-def chat_remote(api_url: str, api_key: str | None = None) -> int:
+def chat_remote(api_url: str, api_key: str | None = None,
+                system_prompt: str | None = None) -> int:
     """SSE REPL against any OpenAI-compatible endpoint."""
     import requests
     print(f"chat via {api_url} — /quit to exit, /reset to clear history")
-    history: list[dict] = []
+    seed = ([{"role": "system", "content": system_prompt}]
+            if system_prompt else [])
+    history: list[dict] = list(seed)
     while True:
         try:
             line = input("\n> ").strip()
@@ -78,7 +84,7 @@ def chat_remote(api_url: str, api_key: str | None = None) -> int:
         if line in ("/quit", "/exit"):
             return 0
         if line == "/reset":
-            history.clear()
+            history[:] = list(seed)
             continue
         history.append({"role": "user", "content": line})
         parts: list[str] = []

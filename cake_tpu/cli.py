@@ -16,6 +16,7 @@ import json
 import logging
 import os
 import sys
+import time
 
 
 def _add_common_model_args(p: argparse.ArgumentParser):
@@ -39,6 +40,11 @@ def _add_common_model_args(p: argparse.ArgumentParser):
                    help="in-host sequence parallelism: shard long-prompt "
                         "prefill over N devices via ring attention "
                         "(composes with --tp; tp*sp devices are used)")
+    p.add_argument("--discovery-timeout", type=float, default=3.0,
+                   help="seconds to wait for UDP worker discovery")
+    p.add_argument("--min-workers", type=int, default=0,
+                   help="stop discovery as soon as this many workers "
+                        "replied (0 = wait the full timeout)")
 
 
 def _add_sampling_args(p: argparse.ArgumentParser):
@@ -47,13 +53,28 @@ def _add_sampling_args(p: argparse.ArgumentParser):
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--repeat-penalty", type=float, default=1.0)
+    p.add_argument("--repeat-last-n", type=int, default=64,
+                   help="window the repeat penalty looks back over")
+    p.add_argument("--system-prompt", default=None,
+                   help="system message for the chat template (the "
+                        "reference defaults to 'You are a helpful AI "
+                        "assistant.'; here omitted unless given)")
 
 
 def _sampling(args):
     from .ops.sampling import SamplingConfig
     return SamplingConfig(temperature=args.temperature, top_k=args.top_k,
                           top_p=args.top_p,
-                          repeat_penalty=args.repeat_penalty)
+                          repeat_penalty=args.repeat_penalty,
+                          repeat_last_n=args.repeat_last_n)
+
+
+def _messages(args, prompt: str) -> list[dict]:
+    msgs = []
+    if getattr(args, "system_prompt", None):
+        msgs.append({"role": "system", "content": args.system_prompt})
+    msgs.append({"role": "user", "content": prompt})
+    return msgs
 
 
 def _build(args):
@@ -64,7 +85,9 @@ def _build(args):
         cluster_key=args.cluster_key, topology_path=args.topology,
         download=not args.no_download,
         fp8_native=getattr(args, "fp8_native", False),
-        tp=getattr(args, "tp", None), sp=getattr(args, "sp", None))
+        tp=getattr(args, "tp", None), sp=getattr(args, "sp", None),
+        discovery_timeout=getattr(args, "discovery_timeout", 3.0),
+        min_workers=getattr(args, "min_workers", 0))
 
 
 def cmd_run(args) -> int:
@@ -77,7 +100,7 @@ def cmd_run(args) -> int:
                                 on_token=_print_token)
     else:
         _, stats = gen.chat_generate(
-            [{"role": "user", "content": prompt}],
+            _messages(args, prompt),
             max_new_tokens=args.max_tokens, sampling=_sampling(args),
             on_token=_print_token)
     print()
@@ -89,6 +112,51 @@ def cmd_run(args) -> int:
 def _print_token(tok):
     if tok.text and not tok.is_end_of_stream:
         print(tok.text, end="", flush=True)
+
+
+def cmd_image(args) -> int:
+    """One-shot image generation to a PNG (ref: `cake run --model-type
+    image-model --image-output out.png`; here a dedicated subcommand)."""
+    from .runtime import build_image_model
+    model = build_image_model(args.model, dtype=args.dtype,
+                              fp8_native=getattr(args, "fp8_native", False))
+    kwargs = dict(width=args.width, height=args.height, seed=args.seed)
+    if args.steps is not None:
+        kwargs["steps"] = args.steps
+    if args.guidance is not None:
+        kwargs["guidance"] = args.guidance
+    if args.negative_prompt is not None:
+        kwargs["negative_prompt"] = args.negative_prompt
+    t0 = time.monotonic()
+    image = model.generate_image(args.prompt, **kwargs)
+    image.save(args.out, format="PNG")
+    print(f"[{args.out}: {args.width}x{args.height} in "
+          f"{time.monotonic() - t0:.1f}s]", file=sys.stderr)
+    return 0
+
+
+def cmd_tts(args) -> int:
+    """One-shot TTS to a WAV (ref: `cake run --model-type audio-model
+    --audio-output output.wav`; here a dedicated subcommand)."""
+    from .runtime import build_audio_model
+    model = build_audio_model(args.model, dtype=args.dtype)
+    voice_wav = None
+    if args.voice_wav:
+        with open(args.voice_wav, "rb") as f:
+            voice_wav = f.read()
+    kwargs = dict(voice=args.voice, voice_wav=voice_wav, seed=args.seed)
+    if args.frames is not None:
+        kwargs["max_frames"] = args.frames
+    if args.steps is not None:
+        kwargs["steps"] = args.steps
+    if args.cfg_scale is not None:
+        kwargs["cfg_scale"] = args.cfg_scale
+    t0 = time.monotonic()
+    audio = model.generate_speech(args.text, **kwargs)
+    with open(args.out, "wb") as f:
+        f.write(audio.wav_bytes())
+    print(f"[{args.out}: {time.monotonic() - t0:.1f}s]", file=sys.stderr)
+    return 0
 
 
 def cmd_serve(args) -> int:
@@ -182,21 +250,24 @@ def cmd_split(args) -> int:
 
 
 def cmd_chat(args) -> int:
+    sys_p = getattr(args, "system_prompt", None)
     if args.tui:
         from .tui import ChatSession, run_tui
         if args.api:
-            session = ChatSession(api_url=args.api, api_key=args.api_key)
+            session = ChatSession(api_url=args.api, api_key=args.api_key,
+                                  system_prompt=sys_p)
         else:
             gen, tokenizer, model_id, _ = _build(args)
             session = ChatSession(gen=gen, sampling=_sampling(args),
                                   max_tokens=args.max_tokens,
-                                  model_id=model_id)
+                                  model_id=model_id, system_prompt=sys_p)
         return run_tui(session)
     from .chat import chat_local, chat_remote
     if args.api:
-        return chat_remote(args.api, args.api_key)
+        return chat_remote(args.api, args.api_key, system_prompt=sys_p)
     gen, tokenizer, model_id, _ = _build(args)
-    return chat_local(gen, model_id, _sampling(args), args.max_tokens)
+    return chat_local(gen, model_id, _sampling(args), args.max_tokens,
+                      system_prompt=sys_p)
 
 
 def main(argv=None) -> int:
@@ -217,6 +288,40 @@ def main(argv=None) -> int:
     p.add_argument("--raw", action="store_true",
                    help="no chat template, raw completion")
     p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("image", help="generate an image to a PNG file")
+    p.add_argument("model", help="image model dir ('demo:flux'/'demo:sd' "
+                                 "for random weights)")
+    p.add_argument("prompt")
+    p.add_argument("--out", default="output.png")
+    p.add_argument("--width", type=int, default=1024)
+    p.add_argument("--height", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--guidance", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--negative-prompt", default=None)
+    p.add_argument("--dtype", default="bf16")
+    p.add_argument("--fp8-native", action="store_true",
+                   help="FLUX.1 fp8 checkpoints stay 1 byte/param in HBM")
+    p.set_defaults(fn=cmd_image)
+
+    p = sub.add_parser("tts", help="synthesize speech to a WAV file")
+    p.add_argument("model", help="TTS model dir ('demo:vibevoice' | "
+                                 "'demo:luxtts')")
+    p.add_argument("text")
+    p.add_argument("--out", default="output.wav")
+    p.add_argument("--frames", type=int, default=None,
+                   help="max speech frames (~133ms each for VibeVoice)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="diffusion steps per frame")
+    p.add_argument("--cfg-scale", type=float, default=None)
+    p.add_argument("--voice", default=None,
+                   help="voice-prompt .safetensors path (VibeVoice)")
+    p.add_argument("--voice-wav", default=None,
+                   help="clone the voice from this reference WAV")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", default="bf16")
+    p.set_defaults(fn=cmd_tts)
 
     p = sub.add_parser("serve", help="OpenAI-compatible API server")
     _add_common_model_args(p)

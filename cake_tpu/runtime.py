@@ -164,7 +164,8 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                      topology_path: str | None = None,
                      discovery_timeout: float = 3.0,
                      download: bool = True, fp8_native: bool = False,
-                     tp: int | str | None = None, sp: int | None = None):
+                     tp: int | str | None = None, sp: int | None = None,
+                     min_workers: int = 0):
     """Returns (generator, tokenizer, model_id, topology|None).
 
     With a cluster key: discover workers (or use the topology file), run
@@ -218,7 +219,8 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                                  "tflops": n.tflops}}
                        for n in topo.nodes.values()]
         else:
-            workers = discover_workers(cluster_key, timeout=discovery_timeout)
+            workers = discover_workers(cluster_key, timeout=discovery_timeout,
+                                       expected=min_workers or None)
         if not workers:
             log.warning("no workers found; running all-local")
 

@@ -18,14 +18,17 @@ class ChatSession:
 
     def __init__(self, gen=None, api_url: str | None = None,
                  api_key: str | None = None, sampling=None,
-                 max_tokens: int = 256, model_id: str = "model"):
+                 max_tokens: int = 256, model_id: str = "model",
+                 system_prompt: str | None = None):
         self.gen = gen
         self.api_url = api_url
         self.api_key = api_key
         self.sampling = sampling
         self.max_tokens = max_tokens
         self.model_id = model_id
-        self.history: list[dict] = []
+        self.history: list[dict] = (
+            [{"role": "system", "content": system_prompt}]
+            if system_prompt else [])
         self.tokens: queue.Queue = queue.Queue()
         self.busy = False
         self.last_stats: dict = {}

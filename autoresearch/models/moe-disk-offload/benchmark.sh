@@ -3,17 +3,29 @@ python - <<'PY'
 import os
 if os.environ.get("CAKE_BENCH_CPU") == "1":
     import jax; jax.config.update("jax_platforms", "cpu")
-import json, time, tempfile, os
-import jax, jax.numpy as jnp, numpy as np
-from cake_tpu.models import TextModel, tiny_config
-from cake_tpu.models.common.layers import init_params
+import json, time, tempfile
+import jax, jax.numpy as jnp
+from cake_tpu.models import init_params, tiny_config
+from cake_tpu.models.common.offload_model import OffloadedTextModel
 from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.utils import params_to_hf_tensors, save_safetensors
+from cake_tpu.utils.loaders import load_model_params
+
+# the REAL --expert-offload path: experts stream from disk per token
 cfg = tiny_config("qwen3_moe", num_experts=16, moe_intermediate_size=64)
-m = TextModel(cfg, dtype=jnp.float32, max_cache_len=128)
-m.generate([1, 2, 3], max_new_tokens=16, chunk=16,
-           sampling=SamplingConfig(temperature=0.0))
-t0 = time.perf_counter()
-out, st = m.generate([1, 2, 3], max_new_tokens=64, chunk=32,
+d = tempfile.mkdtemp()
+params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+save_safetensors(f"{d}/model.safetensors", params_to_hf_tensors(cfg, params))
+with open(f"{d}/config.json", "w") as f:
+    json.dump({"architectures": ["Qwen3MoeForCausalLM"]}, f)
+# lru_size=2 << 16 experts: the timed run must hit real disk reads,
+# not a warm dequant cache
+off = load_model_params(cfg, d, jnp.float32, expert_offload=True,
+                        expert_lru_size=2)
+m = OffloadedTextModel(cfg, off, dtype=jnp.float32, max_cache_len=128)
+m.generate([1, 2, 3], max_new_tokens=8,
+           sampling=SamplingConfig(temperature=0.0))      # warm page cache
+out, st = m.generate([1, 2, 3], max_new_tokens=48,
                      sampling=SamplingConfig(temperature=0.0))
 print(json.dumps({"moe_offload_tok_per_s": round(st["tok_per_s"], 1)}))
 PY

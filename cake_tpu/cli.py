@@ -40,6 +40,10 @@ def _add_common_model_args(p: argparse.ArgumentParser):
                    help="in-host sequence parallelism: shard long-prompt "
                         "prefill over N devices via ring attention "
                         "(composes with --tp; tp*sp devices are used)")
+    p.add_argument("--expert-offload", action="store_true",
+                   help="MoE: stream experts from disk instead of holding "
+                        "them in HBM (capacity over throughput; serves "
+                        "models whose expert banks exceed device memory)")
     p.add_argument("--discovery-timeout", type=float, default=3.0,
                    help="seconds to wait for UDP worker discovery")
     p.add_argument("--min-workers", type=int, default=0,
@@ -87,7 +91,8 @@ def _build(args):
         fp8_native=getattr(args, "fp8_native", False),
         tp=getattr(args, "tp", None), sp=getattr(args, "sp", None),
         discovery_timeout=getattr(args, "discovery_timeout", 3.0),
-        min_workers=getattr(args, "min_workers", 0))
+        min_workers=getattr(args, "min_workers", 0),
+        expert_offload=getattr(args, "expert_offload", False))
 
 
 def cmd_run(args) -> int:

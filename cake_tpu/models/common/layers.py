@@ -306,11 +306,20 @@ def mlp_forward(cfg: ModelConfig, p: dict, x):
 def moe_forward(cfg: ModelConfig, p: dict, x):
     b, s, h = x.shape
     flat = x.reshape(b * s, h)
-    y = moe_ffn(flat, p["gate"]["weight"], p["experts"]["gate_proj"],
-                p["experts"]["up_proj"], p["experts"]["down_proj"],
-                cfg.num_experts_per_tok, cfg.norm_topk_prob,
-                cfg.moe_gate_act,
-                "gelu" if cfg.hidden_act == "gelu_tanh" else "silu")
+    act = "gelu" if cfg.hidden_act == "gelu_tanh" else "silu"
+    if "_provider" in p:
+        # disk-offloaded experts (--expert-offload): router on device,
+        # selected experts streamed from storage — EAGER only (the host
+        # round-trip on the routing indices cannot trace under jit)
+        from .expert_provider import moe_ffn_offloaded
+        y = moe_ffn_offloaded(flat, p["gate"]["weight"], p["_provider"],
+                              cfg.num_experts_per_tok, cfg.norm_topk_prob,
+                              cfg.moe_gate_act, act)
+    else:
+        y = moe_ffn(flat, p["gate"]["weight"], p["experts"]["gate_proj"],
+                    p["experts"]["up_proj"], p["experts"]["down_proj"],
+                    cfg.num_experts_per_tok, cfg.norm_topk_prob,
+                    cfg.moe_gate_act, act)
     if "shared_expert" in p:
         # always-active shared expert, sigmoid-gated (ref: qwen3_5_moe/moe.rs)
         sh = mlp_forward(cfg, p["shared_expert"], flat)

@@ -1,0 +1,117 @@
+"""Eager serving path for disk-offloaded MoE experts (--expert-offload).
+
+Capacity over throughput (ref: cake-cli `--expert-offload` +
+disk_expert_provider.rs "Flash-MoE"): the dense trunk (attention, norms,
+router gates, shared experts, embeddings, head) is resident; expert banks
+stay on disk and stream per selected expert through a dequant-LRU
+provider — what lets a many-expert model serve with HBM holding only the
+trunk.
+
+Runs the SAME layer code as TextModel (forward_layers) but eagerly: the
+offloaded MoE forward round-trips the routing indices to the host, which
+cannot trace under jit. Per-op dispatch still executes on the device; the
+cost model is dominated by expert reads, not dispatch overhead.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.sampling import SamplingConfig, push_recent_token, sample
+from .cache import init_cache
+from .config import ModelConfig
+from .layers import embed_tokens, forward_layers, lm_head_logits
+from .text_model import (Token, bucket_for, chat_prompt_ids,
+                         check_prefill_bounds)
+
+
+class OffloadedTextModel:
+    """TextModel-compatible generate surface over offloaded-expert params
+    (pytrees whose MoE layers carry a `_provider` leaf instead of stacked
+    expert tensors — see utils/loaders.ParamLoader(expert_offload=True))."""
+
+    def __init__(self, cfg: ModelConfig, params: dict, tokenizer=None,
+                 dtype=jnp.bfloat16, max_cache_len: int | None = None,
+                 seed: int = 42, **_):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.dtype = dtype
+        self.max_cache_len = min(max_cache_len or cfg.max_seq_len,
+                                 cfg.max_seq_len)
+        self._rng = jax.random.PRNGKey(seed)
+
+    def _forward(self, x, cache, pos0: int, valid_len: int | None):
+        x, cache = forward_layers(
+            self.cfg, self.params, x, cache, jnp.asarray(pos0, jnp.int32),
+            valid_len=None if valid_len is None
+            else jnp.asarray(valid_len, jnp.int32))
+        return x, cache
+
+    def generate(self, prompt_ids: list[int], max_new_tokens: int = 256,
+                 sampling: SamplingConfig | None = None, on_token=None,
+                 rng=None, **_):
+        cfg = self.cfg
+        scfg = sampling or SamplingConfig()
+        rng = self._rng if rng is None else rng
+        n = len(prompt_ids)
+        kv_len = bucket_for(n + 1 + max_new_tokens, self.max_cache_len)
+        cache = init_cache(cfg, 1, kv_len, self.dtype)
+        recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
+
+        t0 = time.monotonic()
+        bkt = check_prefill_bounds(n, 0, kv_len, self.max_cache_len)
+        padded = np.zeros((1, bkt), np.int32)
+        padded[0, :n] = prompt_ids
+        x = embed_tokens(cfg, self.params, jnp.asarray(padded))
+        x, cache = self._forward(x, cache, 0, n)
+        logits = lm_head_logits(cfg, self.params,
+                                x[:, n - 1:n].astype(self.dtype))[:, 0]
+        rng, sk = jax.random.split(rng)
+        tok = sample(logits[0], sk, scfg, recent)
+        recent = push_recent_token(recent, tok)
+        tid = int(tok)
+        ttft = time.monotonic() - t0
+
+        out = [tid]
+        if on_token:
+            on_token(self._mk_token(tid))
+        pos = n
+        t1 = time.monotonic()
+        budget = min(max_new_tokens, self.max_cache_len - n)
+        while not cfg.is_eos(tid) and len(out) < budget:
+            x = embed_tokens(cfg, self.params,
+                             jnp.asarray([[tid]], jnp.int32))
+            x, cache = self._forward(x, cache, pos, None)
+            logits = lm_head_logits(cfg, self.params,
+                                    x[:, -1:].astype(self.dtype))[:, 0]
+            rng, sk = jax.random.split(rng)
+            tok = sample(logits[0], sk, scfg, recent)
+            recent = push_recent_token(recent, tok)
+            tid = int(tok)
+            pos += 1
+            out.append(tid)
+            if on_token:
+                on_token(self._mk_token(tid))
+        dt = time.monotonic() - t1
+        stats = {"ttft_s": ttft, "decode_tokens": len(out) - 1,
+                 "decode_s": dt,
+                 "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0,
+                 "expert_offload": True}
+        return out, stats
+
+    def chat_generate(self, messages: list[dict], **kw):
+        return self.generate(chat_prompt_ids(self.tokenizer, messages), **kw)
+
+    def _mk_token(self, tid: int) -> Token:
+        text = None
+        if self.tokenizer is not None:
+            try:
+                text = self.tokenizer.decode([tid])
+            except Exception:
+                pass
+        return Token(id=tid, text=text,
+                     is_end_of_stream=self.cfg.is_eos(tid))

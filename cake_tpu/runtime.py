@@ -165,7 +165,7 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
                      discovery_timeout: float = 3.0,
                      download: bool = True, fp8_native: bool = False,
                      tp: int | str | None = None, sp: int | None = None,
-                     min_workers: int = 0):
+                     min_workers: int = 0, expert_offload: bool = False):
     """Returns (generator, tokenizer, model_id, topology|None).
 
     With a cluster key: discover workers (or use the topology file), run
@@ -224,6 +224,10 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
         if not workers:
             log.warning("no workers found; running all-local")
 
+    if expert_offload and cluster_key and workers:
+        log.warning("--expert-offload applies to local serving only; "
+                    "ignoring it for the cluster path")
+        expert_offload = False
     if cluster_key and workers:
         from .cluster.master import DistributedTextModel, master_setup
         assignments = None
@@ -242,6 +246,19 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
         return gen, tokenizer, model_id, setup.topology
 
     # fully local
+    if expert_offload:
+        if not cfg.num_experts:
+            raise ValueError("--expert-offload needs an MoE model "
+                             f"(arch {cfg.arch} has no experts)")
+        if fp8_native:
+            # DiskExpertProvider dequants on read; the keep-native fp8
+            # marker dicts the resident path streams into fused matmuls
+            # have no offloaded consumer
+            raise ValueError("--expert-offload and --fp8-native cannot "
+                             "combine (offloaded experts dequant on read)")
+        if mesh is not None:
+            log.warning("--tp/--sp apply to the resident path only; "
+                        "ignoring them for --expert-offload serving")
     gguf_files = [f for f in os.listdir(model_dir) if f.endswith(".gguf")]
     if gguf_files and not any(f.endswith(".safetensors")
                               for f in os.listdir(model_dir)):
@@ -249,10 +266,19 @@ def build_text_model(model: str, dtype: str = "bf16", arch: str | None = None,
         from .utils.loaders import ParamLoader
         storage = GgufStorage(os.path.join(model_dir, gguf_files[0]),
                               cfg.model_prefix)
-        params = ParamLoader(cfg, storage, dt, quant).load()
+        params = ParamLoader(cfg, storage, dt, quant,
+                             expert_offload=expert_offload).load()
     else:
         from .utils.loaders import load_model_params
-        params = load_model_params(cfg, model_dir, dt, quant=quant)
+        params = load_model_params(cfg, model_dir, dt, quant=quant,
+                                   expert_offload=expert_offload)
+    if expert_offload:
+        from .models.common.offload_model import OffloadedTextModel
+        gen = OffloadedTextModel(cfg, params, tokenizer=tokenizer, dtype=dt,
+                                 seed=seed, max_cache_len=max_cache_len)
+        log.info("expert offload: %d experts/layer stream from disk, "
+                 "dense trunk resident", cfg.num_experts)
+        return gen, tokenizer, model_id, None
     gen = TextModel(cfg, params, tokenizer=tokenizer, dtype=dt, seed=seed,
                     max_cache_len=max_cache_len, mesh=mesh)
     return gen, tokenizer, model_id, None

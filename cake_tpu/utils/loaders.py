@@ -31,12 +31,18 @@ def _to_dev(arr, dtype):
 
 class ParamLoader:
     def __init__(self, cfg: ModelConfig, storage: TensorStorage,
-                 dtype=jnp.bfloat16, quant=None):
+                 dtype=jnp.bfloat16, quant=None,
+                 expert_offload: bool = False, expert_lru_size: int = 32):
         self.cfg = cfg
         self.st = storage
         self.dtype = dtype
         self.quant = quant or NoQuantization()
         self.prefix = cfg.model_prefix
+        # MoE expert banks stay ON DISK, streamed per selected expert at
+        # forward time (ref: --expert-offload / disk_expert_provider.rs) —
+        # the storage handle is kept alive by the providers
+        self.expert_offload = expert_offload
+        self.expert_lru_size = expert_lru_size
 
     # -- helpers ------------------------------------------------------------
 
@@ -123,13 +129,23 @@ class ParamLoader:
         # router gate feeds a raw einsum (ops/moe.py), not linear(): dense
         p: dict = {"gate": {"weight": _to_dev(
             self._get_dense(f"{mp}.gate.weight"), self.dtype)}}
-        stacked = {k: [] for k in ("gate_proj", "up_proj", "down_proj")}
-        for e in range(cfg.num_experts):
-            for proj in stacked:
-                stacked[proj].append(
-                    self._get_dense(f"{mp}.experts.{e}.{proj}.weight"))
-        p["experts"] = {proj: _to_dev(np.stack(ws), self.dtype)
-                        for proj, ws in stacked.items()}
+        if self.expert_offload:
+            # experts stream from disk through a dequant-LRU provider
+            # instead of residing stacked in HBM; the provider object is a
+            # pytree leaf consumed only by the eager offloaded forward
+            from ..models.common.expert_provider import DiskExpertProvider
+            p["_provider"] = DiskExpertProvider(
+                self.st, mp, cfg.num_experts, quant=self.quant,
+                dtype=self.dtype, lru_size=self.expert_lru_size,
+                name_fmt="{lp}.experts.{e}.{proj}.weight")
+        else:
+            stacked = {k: [] for k in ("gate_proj", "up_proj", "down_proj")}
+            for e in range(cfg.num_experts):
+                for proj in stacked:
+                    stacked[proj].append(
+                        self._get_dense(f"{mp}.experts.{e}.{proj}.weight"))
+            p["experts"] = {proj: _to_dev(np.stack(ws), self.dtype)
+                            for proj, ws in stacked.items()}
         if cfg.shared_expert_intermediate_size:
             p["shared_expert"] = self._mlp(f"{mp}.shared_expert")
             p["shared_expert_gate"] = {"weight": _to_dev(
@@ -190,7 +206,8 @@ class ParamLoader:
 
 def load_model_params(cfg: ModelConfig, model_dir: str, dtype=jnp.bfloat16,
                       quant=None, layer_range=None, include_embed=None,
-                      include_head=None) -> dict:
+                      include_head=None, expert_offload: bool = False,
+                      expert_lru_size: int = 32) -> dict:
     """One-call load: storage + quant detection + pytree assembly."""
     import json
     import os
@@ -201,5 +218,7 @@ def load_model_params(cfg: ModelConfig, model_dir: str, dtype=jnp.bfloat16,
         cfg_path = os.path.join(model_dir, "config.json")
         with open(cfg_path) as f:
             quant = detect_quantization(json.load(f))
-    loader = ParamLoader(cfg, storage, dtype, quant)
+    loader = ParamLoader(cfg, storage, dtype, quant,
+                         expert_offload=expert_offload,
+                         expert_lru_size=expert_lru_size)
     return loader.load(layer_range, include_embed, include_head)

@@ -129,3 +129,38 @@ def test_read_many_batched_preadv(tmp_path):
     batched = st.read_many(names)
     for n, arr in zip(names, batched):
         np.testing.assert_array_equal(arr, st.read(n))
+
+
+def test_offloaded_model_end_to_end(tmp_path):
+    """The PRODUCT --expert-offload path: load_model_params(expert_offload)
+    leaves expert banks on disk (provider leaves, no stacked tensors) and
+    OffloadedTextModel's greedy output matches the resident TextModel
+    exactly from the same checkpoint."""
+    from cake_tpu.models import TextModel
+    from cake_tpu.models.common.offload_model import OffloadedTextModel
+    from cake_tpu.ops.sampling import SamplingConfig
+    from cake_tpu.utils.loaders import load_model_params
+
+    cfg = tiny_config("qwen3_moe")
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    save_safetensors(str(tmp_path / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump({"architectures": ["Qwen3MoeForCausalLM"]}, f)
+
+    resident = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+    prompt = [5, 9, 2, 7, 1, 4]
+    want, _ = resident.generate(prompt, max_new_tokens=8,
+                                sampling=SamplingConfig(temperature=0.0))
+
+    off_params = load_model_params(cfg, str(tmp_path), jnp.float32,
+                                   expert_offload=True)
+    for layer in off_params["layers"]:
+        assert "_provider" in layer["mlp"]
+        assert "experts" not in layer["mlp"]
+    model = OffloadedTextModel(cfg, off_params, dtype=jnp.float32,
+                               max_cache_len=64)
+    got, stats = model.generate(prompt, max_new_tokens=8,
+                                sampling=SamplingConfig(temperature=0.0))
+    assert stats["expert_offload"] is True
+    assert got == want

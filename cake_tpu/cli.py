@@ -132,6 +132,18 @@ def cmd_image(args) -> int:
         kwargs["guidance"] = args.guidance
     if args.negative_prompt is not None:
         kwargs["negative_prompt"] = args.negative_prompt
+    if args.init_image:
+        # img2img (ref: --sd-img2img FILE + --sd-img2img-strength): load,
+        # resize to the target, VAE-encode to the init latent
+        if not hasattr(model, "encode_image"):
+            raise SystemExit("--init-image needs an SD model (FLUX is "
+                             "guidance-distilled text-to-image only)")
+        from PIL import Image
+        img = Image.open(args.init_image).convert("RGB").resize(
+            (args.width, args.height))
+        import numpy as np
+        kwargs["init_image"] = model.encode_image(np.asarray(img))
+        kwargs["strength"] = args.strength
     t0 = time.monotonic()
     image = model.generate_image(args.prompt, **kwargs)
     image.save(args.out, format="PNG")
@@ -305,6 +317,11 @@ def main(argv=None) -> int:
     p.add_argument("--guidance", type=float, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--negative-prompt", default=None)
+    p.add_argument("--init-image", default=None,
+                   help="img2img: start from this image (SD; ref "
+                        "--sd-img2img)")
+    p.add_argument("--strength", type=float, default=0.8,
+                   help="img2img denoise depth (ref --sd-img2img-strength)")
     p.add_argument("--dtype", default="bf16")
     p.add_argument("--fp8-native", action="store_true",
                    help="FLUX.1 fp8 checkpoints stay 1 byte/param in HBM")

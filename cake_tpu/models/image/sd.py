@@ -340,9 +340,11 @@ class SDImageModel:
         self.cfg = cfg
         self.dtype = dtype
         if params is None:
-            k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+            from .vae import init_vae_encoder_params
+            k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
             params = {"unet": init_unet_params(cfg.unet, k1, dtype),
-                      "vae": init_vae_decoder_params(cfg.vae, k2, dtype)}
+                      "vae": init_vae_decoder_params(cfg.vae, k2, dtype),
+                      "vae_enc": init_vae_encoder_params(cfg.vae, k3, dtype)}
         self.params = params
         self.text_encoder = text_encoder or DummyTextEncoder(
             cfg.unet.context_dim, 1, seq_len=8)
@@ -360,8 +362,50 @@ class SDImageModel:
         def _decode(vp, z):
             return vae_decode(vcfg, vp, z)
 
+        @jax.jit
+        def _encode(vp, px):
+            from .vae import vae_encode
+            return vae_encode(vcfg, vp, px)
+
         self._eps = _eps
         self._decode = _decode
+        self._encode = _encode
+
+    def encode_image(self, pixels, rng=None):
+        """Real-image img2img entry: pixels [H, W, 3], integer dtype in
+        0..255 or float already in [-1, 1] (the dtype decides — a value
+        heuristic would silently mis-scale dark images). Returns the
+        scheduler-space init latent for generate_image(init_image=...).
+        Needs the VAE encoder weights (any full AutoencoderKL dump;
+        decoder-only bundles raise here)."""
+        if "vae_enc" not in self.params:
+            raise ValueError(
+                "this checkpoint has no VAE encoder weights — img2img from "
+                "a real image needs a full AutoencoderKL dump")
+        arr = np.asarray(pixels)
+        px = jnp.asarray(arr, jnp.float32)
+        if np.issubdtype(arr.dtype, np.integer):
+            px = px / 127.5 - 1.0          # 0..255 -> [-1, 1]
+        if px.ndim == 3:
+            px = px[None]
+        px = px.transpose(0, 3, 1, 2)      # NHWC -> NCHW
+        factor = 2 ** (len(self.cfg.vae.channel_mults) - 1)
+        if px.shape[2] < 8 * factor or px.shape[3] < 8 * factor:
+            # _generate floors the noise latent at 8x8; a smaller encoded
+            # latent would shape-clash in the img2img mix
+            raise ValueError(
+                f"img2img needs at least {8 * factor}x{8 * factor} pixels")
+        # match the encoder's own precision (release checkpoints load the
+        # VAE in f32; demo/random init follows the model dtype)
+        w_dt = self.params["vae_enc"]["conv_in"]["weight"].dtype
+        z = self._encode(self.params["vae_enc"], px.astype(w_dt))
+        if rng is not None:
+            # jitted path returns the mode; posterior sampling re-runs
+            # eagerly (rare path, keeps the jit signature simple)
+            from .vae import vae_encode
+            z = vae_encode(self.cfg.vae, self.params["vae_enc"],
+                           px.astype(w_dt), rng=rng)
+        return z
 
     def _encode_prompt(self, prompt: str, negative_prompt: str,
                        width: int, height: int):

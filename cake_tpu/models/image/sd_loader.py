@@ -34,7 +34,8 @@ from ...utils.safetensors_io import TensorStorage
 from ..text_encoders import CLIPTextConfig, clip_mapping, clip_text_forward, \
     init_clip_params
 from .sd import SDPipelineConfig, UNetConfig, init_unet_params
-from .vae import VaeConfig, init_vae_decoder_params
+from .vae import (VaeConfig, init_vae_decoder_params,
+                  init_vae_encoder_params)
 
 log = logging.getLogger("cake_tpu.sd_loader")
 
@@ -127,13 +128,8 @@ def sd_unet_mapping(cfg: UNetConfig) -> tuple[dict, dict]:
     return m, tr
 
 
-def sd_vae_decoder_mapping(storage, cfg: VaeConfig,
-                           prefix: str = "") -> tuple[dict, dict]:
-    """Diffusers AutoencoderKL decoder names (+post_quant_conv); handles
-    both attention-name generations."""
-    m: dict[str, str] = {}
-    tr: dict[str, object] = {}
-
+def _vae_map_helpers(m: dict):
+    """(conv, resnet) emitters shared by the encoder and decoder maps."""
     def conv(dst, src):
         m[f"{dst}.weight"] = f"{src}.weight"
         m[f"{dst}.bias"] = f"{src}.bias"
@@ -145,12 +141,13 @@ def sd_vae_decoder_mapping(storage, cfg: VaeConfig,
         if has_shortcut:
             conv(f"{dst}.shortcut", f"{src}.conv_shortcut")
 
-    d = f"{prefix}decoder."
-    conv("post_quant_conv", f"{prefix}post_quant_conv")
-    conv("conv_in", f"{d}conv_in")
-    resnet("mid_res1", f"{d}mid_block.resnets.0", False)
-    resnet("mid_res2", f"{d}mid_block.resnets.1", False)
-    a = f"{d}mid_block.attentions.0"
+    return conv, resnet
+
+
+def _vae_map_mid_attention(m: dict, tr: dict, storage, a: str):
+    """mid_block.attentions.0 mapping, both diffusers name generations
+    (to_q/... vs query/...) — shared by encoder and decoder."""
+    conv, _ = _vae_map_helpers(m)
     new_style = f"{a}.to_q.weight" in storage
     names = (("norm", "group_norm"), ("q", "to_q"), ("k", "to_k"),
              ("v", "to_v"), ("proj", "to_out.0")) if new_style else \
@@ -160,6 +157,22 @@ def sd_vae_decoder_mapping(storage, cfg: VaeConfig,
         conv(f"mid_attn.{ours}", f"{a}.{theirs}")
         if ours != "norm":
             tr[f"mid_attn.{ours}.weight"] = _expand_conv
+
+
+def sd_vae_decoder_mapping(storage, cfg: VaeConfig,
+                           prefix: str = "") -> tuple[dict, dict]:
+    """Diffusers AutoencoderKL decoder names (+post_quant_conv); handles
+    both attention-name generations."""
+    m: dict[str, str] = {}
+    tr: dict[str, object] = {}
+    conv, resnet = _vae_map_helpers(m)
+
+    d = f"{prefix}decoder."
+    conv("post_quant_conv", f"{prefix}post_quant_conv")
+    conv("conv_in", f"{d}conv_in")
+    resnet("mid_res1", f"{d}mid_block.resnets.0", False)
+    resnet("mid_res2", f"{d}mid_block.resnets.1", False)
+    _vae_map_mid_attention(m, tr, storage, f"{d}mid_block.attentions.0")
     chs = [cfg.base_channels * mlt for mlt in cfg.channel_mults]
     n_lv = len(chs)
     cin = chs[-1]
@@ -173,6 +186,34 @@ def sd_vae_decoder_mapping(storage, cfg: VaeConfig,
             conv(f"ups.{k}.upsample", f"{src}.upsamplers.0.conv")
     conv("norm_out", f"{d}conv_norm_out")
     conv("conv_out", f"{d}conv_out")
+    return m, tr
+
+
+def sd_vae_encoder_mapping(storage, cfg: VaeConfig) -> tuple[dict, dict]:
+    """Diffusers AutoencoderKL ENCODER names (+quant_conv) — the img2img
+    entry point (pixels -> posterior latent); mirror of the decoder map."""
+    m: dict[str, str] = {}
+    tr: dict[str, object] = {}
+    conv, resnet = _vae_map_helpers(m)
+
+    e = "encoder."
+    conv("quant_conv", "quant_conv")
+    conv("conv_in", f"{e}conv_in")
+    chs = [cfg.base_channels * mlt for mlt in cfg.channel_mults]
+    n_res = max(cfg.num_res_blocks - 1, 1)
+    cin = chs[0]
+    for i, c in enumerate(chs):
+        src = f"{e}down_blocks.{i}"
+        for j in range(n_res):
+            resnet(f"downs.{i}.res.{j}", f"{src}.resnets.{j}", cin != c)
+            cin = c
+        if i < len(chs) - 1:
+            conv(f"downs.{i}.downsample", f"{src}.downsamplers.0.conv")
+    resnet("mid_res1", f"{e}mid_block.resnets.0", False)
+    resnet("mid_res2", f"{e}mid_block.resnets.1", False)
+    _vae_map_mid_attention(m, tr, storage, f"{e}mid_block.attentions.0")
+    conv("norm_out", f"{e}conv_norm_out")
+    conv("conv_out", f"{e}conv_out")
     return m, tr
 
 
@@ -327,7 +368,19 @@ def load_sd_image_model(path: str, dtype=jnp.float32):
     params["vae"] = load_mapped_params(vae_st, vm, vae_shapes, jnp.float32,
                                        transforms=vt)
     assert "post_quant_conv" in params["vae"]
-    coverage_report(vae_st, vm, ignore=("encoder.", "quant_conv."))
+    # encoder (img2img entry point) — present in every full AutoencoderKL
+    # dump; skip gracefully for decoder-only bundles
+    cov_map = dict(vm)
+    cov_ignore: tuple = ("encoder.", "quant_conv.")
+    if "encoder.conv_in.weight" in vae_st:
+        em, et = sd_vae_encoder_mapping(vae_st, cfg.vae)
+        enc_shapes = jax.eval_shape(lambda: init_vae_encoder_params(
+            cfg.vae, jax.random.PRNGKey(0), jnp.float32))
+        params["vae_enc"] = load_mapped_params(vae_st, em, enc_shapes,
+                                               jnp.float32, transforms=et)
+        cov_map.update(em)
+        cov_ignore = ()
+    coverage_report(vae_st, cov_map, ignore=cov_ignore)
 
     encoder = _load_clip_encoder(path, "text_encoder", "tokenizer", dtype)
     if os.path.isdir(os.path.join(path, "text_encoder_2")):

@@ -76,6 +76,45 @@ def init_vae_decoder_params(cfg: VaeConfig, key, dtype=jnp.float32) -> dict:
     return p
 
 
+def init_vae_encoder_params(cfg: VaeConfig, key, dtype=jnp.float32) -> dict:
+    """Encoder mirror of the decoder (diffusers AutoencoderKL Encoder):
+    conv_in -> per-level resnets + stride-2 downsample -> mid(res, attn,
+    res) -> norm+conv_out to 2*latent moments, then quant_conv 1x1.
+    Per-level resnet count is layers_per_block = decoder's
+    num_res_blocks - 1 (the decoder has one extra resnet per level)."""
+    chs = [cfg.base_channels * m for m in cfg.channel_mults]
+    top = chs[-1]
+    lc = cfg.latent_channels
+    keys = iter(jax.random.split(key, 64))
+    p: dict = {
+        "conv_in": _conv_p(next(keys), chs[0], cfg.out_channels, 3, dtype),
+        "downs": [],
+        "mid_res1": _resnet_p(next(keys), top, top, dtype),
+        "mid_attn": {
+            "norm": _norm_p(top, dtype),
+            "q": _conv_p(next(keys), top, top, 1, dtype),
+            "k": _conv_p(next(keys), top, top, 1, dtype),
+            "v": _conv_p(next(keys), top, top, 1, dtype),
+            "proj": _conv_p(next(keys), top, top, 1, dtype),
+        },
+        "mid_res2": _resnet_p(next(keys), top, top, dtype),
+        "norm_out": _norm_p(top, dtype),
+        "conv_out": _conv_p(next(keys), 2 * lc, top, 3, dtype),
+        "quant_conv": _conv_p(next(keys), 2 * lc, 2 * lc, 1, dtype),
+    }
+    cin = chs[0]
+    n_res = max(cfg.num_res_blocks - 1, 1)
+    for i, c in enumerate(chs):
+        blk = {"res": [], "downsample": None}
+        for _ in range(n_res):
+            blk["res"].append(_resnet_p(next(keys), cin, c, dtype))
+            cin = c
+        if i < len(chs) - 1:
+            blk["downsample"] = _conv_p(next(keys), c, c, 3, dtype)
+        p["downs"].append(blk)
+    return p
+
+
 def _resnet(p, x):
     h = jax.nn.silu(group_norm(x, p["norm1"]["weight"], p["norm1"]["bias"], 32))
     h = conv2d(h, p["conv1"]["weight"], p["conv1"]["bias"], padding=1)
@@ -103,6 +142,40 @@ def _upsample2x(p, x):
     b, c, h, w = x.shape
     x = jax.image.resize(x, (b, c, h * 2, w * 2), method="nearest")
     return conv2d(x, p["weight"], p["bias"], padding=1)
+
+
+def _downsample2x(p, x):
+    # diffusers Downsample2D: ASYMMETRIC (0,1) pad then stride-2 conv with
+    # no padding — not a symmetric p1 conv
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, 1), (0, 1)))
+    return conv2d(x, p["weight"], p["bias"], stride=2, padding=0)
+
+
+def vae_encode(cfg: VaeConfig, p: dict, img, rng=None):
+    """img: [B, 3, H, W] in [-1, 1] -> scheduler-space latent
+    [B, latent_ch, H/8, W/8] (the init_image contract of the img2img
+    pipelines: z = (raw_mean - shift) * scale, matching vae_decode's
+    inverse). rng samples the posterior; None takes the mode."""
+    x = conv2d(img, p["conv_in"]["weight"], p["conv_in"]["bias"], padding=1)
+    for blk in p["downs"]:
+        for r in blk["res"]:
+            x = _resnet(r, x)
+        if blk.get("downsample") is not None:
+            x = _downsample2x(blk["downsample"], x)
+    x = _resnet(p["mid_res1"], x)
+    x = _mid_attention(p["mid_attn"], x)
+    x = _resnet(p["mid_res2"], x)
+    x = jax.nn.silu(group_norm(x, p["norm_out"]["weight"],
+                               p["norm_out"]["bias"], 32))
+    moments = conv2d(x, p["conv_out"]["weight"], p["conv_out"]["bias"],
+                     padding=1)
+    moments = conv2d(moments, p["quant_conv"]["weight"],
+                     p["quant_conv"]["bias"])
+    mean, logvar = jnp.split(moments, 2, axis=1)
+    if rng is not None:
+        std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
+        mean = mean + std * jax.random.normal(rng, mean.shape, mean.dtype)
+    return (mean - cfg.shift_factor) * cfg.scaling_factor
 
 
 def vae_decode(cfg: VaeConfig, p: dict, z):

@@ -146,3 +146,35 @@ def test_sd_vae_decode_parity(sd_dir):
 
     assert got.shape == want.shape
     np.testing.assert_allclose(got, want, atol=ATOL, rtol=0)
+
+
+def test_sd_vae_encode_parity(sd_dir):
+    from cake_tpu.models.image.sd_loader import sd_vae_encoder_mapping
+    from cake_tpu.models.image.vae import (init_vae_encoder_params,
+                                           vae_encode)
+
+    cfg = sd_configs_from_dir(sd_dir)
+    st = TensorStorage.from_model_dir(os.path.join(sd_dir, "vae"))
+    em, et = sd_vae_encoder_mapping(st, cfg.vae)
+    params = load_mapped_params(
+        st, em,
+        jax.eval_shape(lambda: init_vae_encoder_params(
+            cfg.vae, jax.random.PRNGKey(0), jnp.float32)),
+        jnp.float32, transforms=et)
+
+    rng = np.random.default_rng(13)
+    px = rng.uniform(-1.0, 1.0, (1, 3, 16, 16)).astype(np.float32)
+
+    hf = diffusers.AutoencoderKL.from_pretrained(
+        os.path.join(sd_dir, "vae"), torch_dtype=torch.float32)
+    hf.eval()
+    with torch.no_grad():
+        want = hf.encode(torch.from_numpy(px)).latent_dist.mode().numpy()
+
+    # vae_encode returns the scheduler-space latent (raw - shift) * scale;
+    # diffusers' mode() is the raw posterior mean
+    got = np.asarray(vae_encode(cfg.vae, params, jnp.asarray(px)))
+    got_raw = got / cfg.vae.scaling_factor + cfg.vae.shift_factor
+
+    assert got_raw.shape == want.shape
+    np.testing.assert_allclose(got_raw, want, atol=ATOL, rtol=0)

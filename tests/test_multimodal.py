@@ -312,3 +312,30 @@ def test_pipelines_run_in_bf16():
     out2 = flow_matching_euler_step(x, jnp.zeros_like(x),
                                     float(fm[0]), float(fm[1]))
     assert out2.dtype == jnp.bfloat16
+
+
+def test_vae_encoder_img2img_from_pixels():
+    """vae_encode: [H,W,3] pixels -> scheduler-space latent at H/8 with
+    finite values, and the full img2img pipeline runs from it (the CLI
+    --init-image path); posterior sampling differs from the mode."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from cake_tpu.models.image.sd import SDImageModel, tiny_sd_config
+
+    m = SDImageModel(tiny_sd_config(), dtype=jnp.float32)
+    px = np.random.default_rng(0).integers(0, 256, (64, 64, 3),
+                                           dtype=np.uint8)
+    z0 = m.encode_image(px)
+    lc = m.cfg.vae.latent_channels
+    f = 2 ** (len(m.cfg.vae.channel_mults) - 1)   # /8 on real SD (4 levels)
+    assert z0.shape == (1, lc, 64 // f, 64 // f)
+    assert np.isfinite(np.asarray(z0)).all()
+
+    zs = m.encode_image(px, rng=jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(zs), np.asarray(z0))
+
+    img = m.generate_image("x", width=64, height=64, steps=2,
+                           init_image=z0, strength=0.5, seed=3)
+    assert img.size == (64, 64)

@@ -41,7 +41,7 @@ def _inv_transform(path, name, arr):
 def synth_sd_dir(tmp_path):
     clip_cfg = tiny_clip_config()
     rng = jax.random.PRNGKey(0)
-    ks = jax.random.split(rng, 3)
+    ks = jax.random.split(rng, 4)     # ks[3]: the VAE encoder synth
 
     os.makedirs(tmp_path / "unet")
     u_params = init_unet_params(TINY_UNET, ks[0], jnp.float32)
@@ -75,6 +75,18 @@ def synth_sd_dir(tmp_path):
     tensors = {}
     for path, name in vm.items():
         arr = np.asarray(flatv[path], np.float32)
+        if path.startswith("mid_attn") and not path.endswith("norm.weight") \
+                and not path.endswith("norm.bias") and arr.ndim == 4:
+            arr = arr.reshape(arr.shape[0], arr.shape[1])   # linear-style
+        tensors[name] = arr
+    # full AutoencoderKL dumps ship the ENCODER too (img2img entry point)
+    from cake_tpu.models.image.sd_loader import sd_vae_encoder_mapping
+    from cake_tpu.models.image.vae import init_vae_encoder_params
+    e_params = init_vae_encoder_params(TINY_VAE, ks[3], jnp.float32)
+    em, _ = sd_vae_encoder_mapping({}, TINY_VAE)
+    flate = flatten_tree(e_params)
+    for path, name in em.items():
+        arr = np.asarray(flate[path], np.float32)
         if path.startswith("mid_attn") and not path.endswith("norm.weight") \
                 and not path.endswith("norm.bias") and arr.ndim == 4:
             arr = arr.reshape(arr.shape[0], arr.shape[1])   # linear-style
@@ -167,6 +179,17 @@ def test_sd_img2img(tmp_path):
     img = model.generate_image("w1", width=32, height=32, steps=3,
                                init_image=init, strength=0.6, seed=1)
     assert np.isfinite(np.asarray(img)).all()
+
+    # real-image img2img: the loaded checkpoint ships the VAE encoder,
+    # so pixels -> encode_image -> generate (the CLI --init-image path)
+    assert "vae_enc" in model.params
+    px = np.random.default_rng(1).integers(0, 256, (32, 32, 3),
+                                           dtype=np.uint8)
+    z0 = model.encode_image(px)
+    assert z0.shape == (1, 4, 16, 16)
+    img2 = model.generate_image("w1", width=32, height=32, steps=2,
+                                init_image=z0, strength=0.5, seed=2)
+    assert np.isfinite(np.asarray(img2)).all()
 
 
 def test_sd_runtime_detection(tmp_path):

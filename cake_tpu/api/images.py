@@ -41,6 +41,25 @@ async def images_generations(request: web.Request) -> web.Response:
         seed=body.get("seed"),
         negative_prompt=body.get("negative_prompt"),
     )
+    # img2img: image BYTES in the body (like audio's voice_b64) — the
+    # reference's legacy endpoint takes a server-side file path from the
+    # request, which we deliberately do not (clients must not choose
+    # server filesystem paths). The encode itself runs under the lock in
+    # the executor below, next to the generation it feeds.
+    init_pil = None
+    if body.get("init_image_b64"):
+        if not hasattr(state.image_model, "init_latent_from"):
+            return web.json_response(
+                {"error": "img2img is SD-only (FLUX is guidance-distilled "
+                          "text-to-image)"}, status=400)
+        try:
+            from PIL import Image
+            init_pil = Image.open(
+                io.BytesIO(base64.b64decode(body["init_image_b64"])))
+        except Exception as e:
+            return web.json_response(
+                {"error": f"bad init_image_b64: {e}"}, status=400)
+        kwargs["strength"] = float(body.get("strength", 0.8))
     # SD-only debug surface (ref: sd.rs intermediary_images / --sd-tracing):
     # OPERATOR-set via CLI flags on ApiState — request bodies cannot point
     # the server at filesystem paths or make it dump per-step files
@@ -51,11 +70,21 @@ async def images_generations(request: web.Request) -> web.Response:
     if "trace_dir" in sig and state.sd_trace_dir:
         kwargs["trace_dir"] = state.sd_trace_dir
 
+    def _run():
+        if init_pil is not None:
+            kwargs["init_image"] = state.image_model.init_latent_from(
+                init_pil, w, h)
+        return state.image_model.generate_image(prompt, **kwargs)
+
     async with state.lock:
         import asyncio
         loop = asyncio.get_running_loop()
-        image = await loop.run_in_executor(
-            None, lambda: state.image_model.generate_image(prompt, **kwargs))
+        try:
+            image = await loop.run_in_executor(None, _run)
+        except ValueError as e:
+            # user-input class: too-small image, encoder-less checkpoint,
+            # bad parameter combinations
+            return web.json_response({"error": str(e)}, status=400)
 
     buf = io.BytesIO()
     image.save(buf, format="PNG")

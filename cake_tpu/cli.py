@@ -133,16 +133,16 @@ def cmd_image(args) -> int:
     if args.negative_prompt is not None:
         kwargs["negative_prompt"] = args.negative_prompt
     if args.init_image:
-        # img2img (ref: --sd-img2img FILE + --sd-img2img-strength): load,
-        # resize to the target, VAE-encode to the init latent
-        if not hasattr(model, "encode_image"):
+        # img2img (ref: --sd-img2img FILE + --sd-img2img-strength)
+        if not hasattr(model, "init_latent_from"):
             raise SystemExit("--init-image needs an SD model (FLUX is "
                              "guidance-distilled text-to-image only)")
         from PIL import Image
-        img = Image.open(args.init_image).convert("RGB").resize(
-            (args.width, args.height))
-        import numpy as np
-        kwargs["init_image"] = model.encode_image(np.asarray(img))
+        try:
+            kwargs["init_image"] = model.init_latent_from(
+                Image.open(args.init_image), args.width, args.height)
+        except ValueError as e:
+            raise SystemExit(str(e))
         kwargs["strength"] = args.strength
     t0 = time.monotonic()
     image = model.generate_image(args.prompt, **kwargs)

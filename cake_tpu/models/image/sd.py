@@ -371,6 +371,14 @@ class SDImageModel:
         self._decode = _decode
         self._encode = _encode
 
+    def init_latent_from(self, img, width: int, height: int):
+        """Shared img2img preprocessing (CLI --init-image and the API's
+        init_image_b64): PIL image -> resize to target -> encode.
+        Raises ValueError for user-input problems (no encoder weights /
+        image below the latent floor) for callers to surface."""
+        img = img.convert("RGB").resize((width, height))
+        return self.encode_image(np.asarray(img))
+
     def encode_image(self, pixels, rng=None):
         """Real-image img2img entry: pixels [H, W, 3], integer dtype in
         0..255 or float already in [-1, 1] (the dtype decides — a value

@@ -354,3 +354,50 @@ def test_stats_endpoint():
         assert "ts" in data["stats"] and data["stats"]["ts"] >= first_ts
         assert data["stats"]["tok_per_s"] > 0
     with_client(make_state(), scenario)
+
+
+def test_images_img2img_b64():
+    """init_image_b64 + strength: image BYTES in the body (never a
+    server-side path) route through encode_image when the model has it."""
+    import base64 as b64
+    import io
+
+    calls = {}
+
+    class I2IModel(MockImageModel):
+        def init_latent_from(self, img, w, h):
+            img = img.convert("RGB").resize((w, h))
+            import numpy as np
+            calls["px_shape"] = np.asarray(img).shape
+            return "latent"
+
+        def generate_image(self, prompt, **kw):
+            calls["kw"] = kw
+            return super().generate_image(prompt, **{
+                k: v for k, v in kw.items()
+                if k not in ("init_image", "strength")})
+
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.new("RGB", (16, 16), (200, 10, 10)).save(buf, format="PNG")
+    png_b64 = b64.b64encode(buf.getvalue()).decode()
+
+    async def scenario(client):
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "x", "size": "32x32", "steps": 2,
+            "init_image_b64": png_b64, "strength": 0.5})
+        assert r.status == 200, await r.text()
+        assert calls["px_shape"] == (32, 32, 3)
+        assert calls["kw"]["init_image"] == "latent"
+        assert calls["kw"]["strength"] == 0.5
+    st = make_state()
+    st.image_model = I2IModel()
+    with_client(st, scenario)
+
+    async def rejects(client):
+        # a model without encode_image rejects img2img with a clear 400
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "x", "init_image_b64": png_b64})
+        assert r.status == 400
+        assert "SD-only" in (await r.json())["error"]
+    with_client(make_state(), rejects)

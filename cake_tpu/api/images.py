@@ -70,28 +70,50 @@ async def images_generations(request: web.Request) -> web.Response:
     if "trace_dir" in sig and state.sd_trace_dir:
         kwargs["trace_dir"] = state.sd_trace_dir
 
+    # OpenAI `n` (ref: --sd-num-samples): sequential generations with
+    # derived seeds, bounded so a request can't monopolize the server
+    try:
+        n = int(body.get("n") or 1)
+    except (TypeError, ValueError):
+        return web.json_response({"error": "n must be 1..4"}, status=400)
+    if not 1 <= n <= 4:
+        return web.json_response({"error": "n must be 1..4"}, status=400)
+    if n > 1 and (fmt == "png" or request.path.endswith("/image")):
+        # the raw-png responses carry exactly one image — generating the
+        # extras under the lock would just burn device time
+        return web.json_response(
+            {"error": "n > 1 needs response_format=b64_json"}, status=400)
+
     def _run():
         if init_pil is not None:
             kwargs["init_image"] = state.image_model.init_latent_from(
                 init_pil, w, h)
-        return state.image_model.generate_image(prompt, **kwargs)
+        out = []
+        for i in range(n):
+            kw = dict(kwargs)
+            if n > 1:
+                kw["seed"] = (kwargs.get("seed") or 0) + i
+            out.append(state.image_model.generate_image(prompt, **kw))
+        return out
 
     async with state.lock:
         import asyncio
         loop = asyncio.get_running_loop()
         try:
-            image = await loop.run_in_executor(None, _run)
+            images = await loop.run_in_executor(None, _run)
         except ValueError as e:
             # user-input class: too-small image, encoder-less checkpoint,
             # bad parameter combinations
             return web.json_response({"error": str(e)}, status=400)
 
-    buf = io.BytesIO()
-    image.save(buf, format="PNG")
-    png = buf.getvalue()
+    pngs = []
+    for image in images:
+        buf = io.BytesIO()
+        image.save(buf, format="PNG")
+        pngs.append(buf.getvalue())
     if fmt == "png" or request.path.endswith("/image"):
-        return web.Response(body=png, content_type="image/png")
+        return web.Response(body=pngs[0], content_type="image/png")
     return web.json_response({
         "created": int(time.time()),
-        "data": [{"b64_json": base64.b64encode(png).decode()}],
+        "data": [{"b64_json": base64.b64encode(p).decode()} for p in pngs],
     })

@@ -401,3 +401,32 @@ def test_images_img2img_b64():
         assert r.status == 400
         assert "SD-only" in (await r.json())["error"]
     with_client(make_state(), rejects)
+
+
+def test_images_n_samples():
+    async def scenario(client):
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "x", "size": "16x16", "n": 3, "seed": 7})
+        assert r.status == 200
+        data = await r.json()
+        assert len(data["data"]) == 3
+        for d in data["data"]:
+            assert base64.b64decode(d["b64_json"])[:8] == b"\x89PNG\r\n\x1a\n"
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "x", "n": 9})
+        assert r.status == 400
+    with_client(make_state(), scenario)
+
+
+def test_images_n_validation():
+    async def scenario(client):
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "x", "n": None})      # null -> default 1
+        assert r.status == 200
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "x", "n": "abc"})
+        assert r.status == 400
+        r = await client.post("/v1/images/generations", json={
+            "prompt": "x", "n": 2, "response_format": "png"})
+        assert r.status == 400
+    with_client(make_state(), scenario)

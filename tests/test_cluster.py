@@ -725,3 +725,60 @@ def test_master_setup_partial_failure_closes_connections(cluster_model_dir):
             except Exception:
                 pass
         t.join(timeout=5)
+
+
+def test_distributed_moe_matches_local(tmp_path):
+    """MoE over the wire: workers load expert banks for their layer subset;
+    greedy distributed == local (pins the subset-synthesized safetensors
+    streaming of stacked expert tensors + routing over TCP)."""
+    from cake_tpu.cluster.master import DistributedTextModel, master_setup
+    from cake_tpu.models import SamplingConfig, TextModel
+
+    cfg = tiny_config("qwen3_moe")
+    params = init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    mdir = tmp_path / "moe-model"
+    mdir.mkdir()
+    save_safetensors(str(mdir / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    (mdir / "config.json").write_text(json.dumps(
+        {"architectures": ["Qwen3MoeForCausalLM"], "vocab_size": 256,
+         "hidden_size": 64, "intermediate_size": 128,
+         "num_hidden_layers": 4, "num_attention_heads": 4,
+         "num_key_value_heads": 2, "rms_norm_eps": 1e-5,
+         "rope_theta": 10000.0, "max_position_embeddings": 128,
+         "num_experts": 8, "num_experts_per_tok": 2,
+         "moe_intermediate_size": 32, "eos_token_id": 2}))
+
+    ready = threading.Event()
+    holder, t = _start_worker_thread("wm", "testkey",
+                                     str(tmp_path / "wc-moe"), ready)
+    assert ready.wait(10)
+    try:
+        setup = master_setup(
+            str(mdir), "testkey", cfg,
+            workers=[{"name": "wm", "host": "127.0.0.1",
+                      "port": holder["port"],
+                      "caps": {"backend": "cpu", "device": "cpu",
+                               "memory_bytes": 8 << 30, "tflops": 1.0}}],
+            assignments={"wm": (1, 3)},
+            dtype_str="f32", max_cache_len=64)
+        dist = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                    dtype=jnp.float32, max_cache_len=64)
+        got, _ = dist.generate([3, 1, 4, 1, 5], max_new_tokens=8,
+                               sampling=SamplingConfig(temperature=0.0))
+        local = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+        want, _ = local.generate([3, 1, 4, 1, 5], max_new_tokens=8,
+                                 sampling=SamplingConfig(temperature=0.0))
+        assert got == want
+        for c in setup.clients:
+            c.close()
+    finally:
+        loop = holder.get("loop")
+        srv = holder.get("server")
+        if loop and srv:
+            try:
+                asyncio.run_coroutine_threadsafe(srv.stop(), loop).result(
+                    timeout=5)
+            except Exception:
+                pass
+        t.join(timeout=5)

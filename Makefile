@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test bench bench-micro native clean docker
+.PHONY: install test bench bench-micro obs-smoke native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -17,6 +17,13 @@ bench:
 
 bench-micro:
 	python benches/bench_micro.py
+
+# observability gate: hot-path timing lint (no ad-hoc time.monotonic
+# deltas outside cake_tpu/obs) + a tiny traced CPU generation asserting
+# /metrics histograms and the Chrome-trace export are live
+obs-smoke:
+	python scripts/check_hot_timing.py
+	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -60,11 +60,85 @@ def _fail_payload(metric: str, error: str, **extra) -> dict:
 
 
 _PROBE_SRC = """
+import time
+t0 = time.perf_counter()
+def mark(name):
+    # cumulative seconds, one line per completed phase, flushed so the
+    # parent sees partial progress even when it kills a wedged attempt
+    print(f"probe-phase {name} {time.perf_counter() - t0:.3f}", flush=True)
 import jax, jax.numpy as jnp
+mark("import")
 x = jnp.ones((64, 64), jnp.bfloat16)
-jax.jit(lambda a: (a @ a).sum())(x).block_until_ready()
+mark("device_put")
+f = jax.jit(lambda a: (a @ a).sum())
+lowered = f.lower(x)
+mark("lower")
+compiled = lowered.compile()
+mark("compile")
+y = compiled(x)
+mark("dispatch")
+y.block_until_ready()
+mark("device_wait")
 print("probe-ok")
 """
+
+# phase order of _PROBE_SRC: the first missing mark names where a wedged
+# attempt is stuck (compile -> XLA/grant service; device_wait -> the TPU
+# accepted the program but never finished it)
+_PROBE_PHASES = ("import", "device_put", "lower", "compile", "dispatch",
+                 "device_wait")
+
+
+def _parse_probe_phases(stdout: str) -> dict[str, float]:
+    """'probe-phase <name> <cumulative_s>' lines -> per-phase seconds."""
+    cum: dict[str, float] = {}
+    for line in (stdout or "").splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "probe-phase":
+            try:
+                cum[parts[1]] = float(parts[2])
+            except ValueError:
+                pass
+    out, prev = {}, 0.0
+    for name in _PROBE_PHASES:
+        if name in cum:
+            out[name] = round(cum[name] - prev, 3)
+            prev = cum[name]
+    return out
+
+
+def _stuck_phase(phases: dict[str, float]) -> str:
+    """First phase that never completed — where the wedge sits."""
+    for name in _PROBE_PHASES:
+        if name not in phases:
+            return name
+    return "post-probe"
+
+
+def _record_probe_spans(phases: dict[str, float], attempt: int):
+    """Mirror the probe's phase breakdown into the span recorder;
+    _export_probe_trace writes the buffer out before bench exits."""
+    from cake_tpu.obs import RECORDER, now
+    if not RECORDER.enabled:
+        return
+    t_us = int(now() * 1e6)
+    off = 0
+    for name, dur in phases.items():
+        RECORDER.add(f"probe.{name}", t_us + off, int(dur * 1e6),
+                     cat="bench", attempt=attempt)
+        off += int(dur * 1e6)
+
+
+def _export_probe_trace():
+    """Write the recorded probe spans to $CAKE_TRACE_DIR before bench
+    exits (success or wedge) — the buffer dies with the process otherwise."""
+    from cake_tpu.obs import RECORDER
+    if RECORDER.enabled and len(RECORDER):
+        try:
+            path = RECORDER.export()
+            print(f"[bench] probe trace written to {path}", file=sys.stderr)
+        except OSError as e:
+            print(f"[bench] probe trace export failed: {e}", file=sys.stderr)
 
 
 def _health_probe(seconds: int, metric: str, budget: int = 1200):
@@ -81,21 +155,37 @@ def _health_probe(seconds: int, metric: str, budget: int = 1200):
     attempt = 0
     fast_fails = 0       # consecutive non-timeout failures: deterministic
     env = dict(os.environ)
+    phases: dict[str, float] = {}
     while True:
         attempt += 1
         try:
             r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
                                timeout=seconds, env=env,
                                capture_output=True, text=True)
+            phases = _parse_probe_phases(r.stdout)
+            _record_probe_spans(phases, attempt)
             if "probe-ok" in r.stdout:
                 print(f"[bench] health probe ok after {attempt} attempt(s) "
-                      f"({time.time() - t0:.1f}s)", file=sys.stderr)
+                      f"({time.time() - t0:.1f}s) phases={phases}",
+                      file=sys.stderr)
+                _export_probe_trace()
                 return
             err = (r.stderr or "").strip().splitlines()
             err = err[-1] if err else f"exit {r.returncode}"
             fast_fails += 1
-        except subprocess.TimeoutExpired:
-            err = f"64x64 jit did not finish in {seconds}s"
+        except subprocess.TimeoutExpired as te:
+            # the probe prints a flushed mark per completed phase, so even
+            # a killed attempt yields a breakdown — the first MISSING mark
+            # is where the wedge sits (jit-compile vs dispatch vs
+            # device-wait), which beats a bare "tpu-wedged"
+            so = te.stdout
+            if isinstance(so, bytes):
+                so = so.decode(errors="replace")
+            phases = _parse_probe_phases(so or "")
+            _record_probe_spans(phases, attempt)
+            err = (f"64x64 jit did not finish in {seconds}s "
+                   f"(stuck in {_stuck_phase(phases)}; "
+                   f"completed phases: {phases or 'none'})")
             fast_fails = 0
         elapsed = time.time() - t0
         print(f"[bench] probe attempt {attempt} failed ({err}); "
@@ -105,14 +195,19 @@ def _health_probe(seconds: int, metric: str, budget: int = 1200):
             # probe exits quickly with the same kind of error twice in a
             # row — that's a deterministic init failure, not a wedge;
             # burning the retry budget would only mislabel it
+            _export_probe_trace()
             print(json.dumps(_fail_payload(metric, "probe-failed",
-                                           detail=err)), flush=True)
+                                           detail=err, phases=phases)),
+                  flush=True)
             sys.exit(5)
         if elapsed + 150 + seconds > budget:
+            _export_probe_trace()
             print(json.dumps(_fail_payload(
                 metric, "tpu-wedged",
                 detail=f"{attempt} probe attempts over {elapsed:.0f}s; "
-                       f"last: {err}")), flush=True)
+                       f"last: {err}",
+                phases=phases, stuck_phase=_stuck_phase(phases))),
+                flush=True)
             sys.exit(4)
         time.sleep(150)
 

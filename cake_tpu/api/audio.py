@@ -8,7 +8,8 @@ import os
 
 from aiohttp import web
 
-from .state import ApiState
+from ..obs import GENERATIONS, request_scope
+from .state import ApiState, run_blocking
 
 log = logging.getLogger("cake_tpu.api.audio")
 
@@ -56,14 +57,21 @@ async def audio_speech(request: web.Request) -> web.Response:
             return web.json_response({"error": "invalid voice_b64"}, status=400)
 
     async with state.lock:
-        import asyncio
-        loop = asyncio.get_running_loop()
-        audio = await loop.run_in_executor(
-            None, lambda: state.audio_model.generate_speech(
-                text, voice=voice, voice_wav=voice_wav,
-                cfg_scale=float(body.get("cfg_scale", 1.3)),
-                steps=int(body.get("steps", 10)),
-            ))
+        with request_scope():
+
+            def _run():
+                return state.audio_model.generate_speech(
+                    text, voice=voice, voice_wav=voice_wav,
+                    cfg_scale=float(body.get("cfg_scale", 1.3)),
+                    steps=int(body.get("steps", 10)),
+                )
+
+            try:
+                audio = await run_blocking(_run)
+            except Exception:
+                GENERATIONS.inc(kind="audio", status="error")
+                raise
+    GENERATIONS.inc(kind="audio", status="ok")
 
     if fmt == "pcm":
         return web.Response(body=audio.pcm_bytes(),

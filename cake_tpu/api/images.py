@@ -9,7 +9,8 @@ import time
 
 from aiohttp import web
 
-from .state import ApiState
+from ..obs import GENERATIONS, request_scope
+from .state import ApiState, run_blocking
 
 
 def _parse_size(s: str) -> tuple[int, int]:
@@ -97,14 +98,18 @@ async def images_generations(request: web.Request) -> web.Response:
         return out
 
     async with state.lock:
-        import asyncio
-        loop = asyncio.get_running_loop()
-        try:
-            images = await loop.run_in_executor(None, _run)
-        except ValueError as e:
-            # user-input class: too-small image, encoder-less checkpoint,
-            # bad parameter combinations
-            return web.json_response({"error": str(e)}, status=400)
+        with request_scope():
+            try:
+                images = await run_blocking(_run)
+            except ValueError as e:
+                # user-input class: too-small image, encoder-less checkpoint,
+                # bad parameter combinations
+                GENERATIONS.inc(kind="image", status="error")
+                return web.json_response({"error": str(e)}, status=400)
+            except Exception:
+                GENERATIONS.inc(kind="image", status="error")
+                raise
+    GENERATIONS.inc(kind="image", status="ok")
 
     pngs = []
     for image in images:

@@ -1,0 +1,116 @@
+"""Observability endpoints: Prometheus /metrics + /health.
+
+/metrics renders the process-global registry (text exposition 0.0.4):
+serving histograms fed by the model layer, per-hop cluster timing fed by
+the master's RemoteStage clients, and HTTP request counters fed by the
+server middleware.
+
+/health reports what the reference's topology endpoint cannot: per-worker
+last-seen age (from each RemoteStage's monotonic last_ok, refreshed by
+every successful forward) and local accelerator memory from
+jax.Device.memory_stats() — so "is the cluster alive and how full is HBM"
+is one unauthenticated-scrape-shaped GET instead of a generation attempt.
+"""
+from __future__ import annotations
+
+import time
+
+from aiohttp import web
+
+from ..obs import RECORDER, REGISTRY, now
+from .state import ApiState
+
+# a worker is reported degraded when forwards keep being ATTEMPTED without
+# a success for longer than this — recency of traffic alone never degrades
+# health (an idle cluster is healthy; a liveness probe must not restart a
+# server just because no one is generating)
+STALE_WORKER_S = 120.0
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+async def metrics(request: web.Request) -> web.Response:
+    return web.Response(body=REGISTRY.render().encode(),
+                        headers={"Content-Type": PROM_CONTENT_TYPE})
+
+
+def _device_health() -> dict:
+    """Local accelerator snapshot; {} when no backend is initialized or the
+    platform exposes no memory stats (CPU)."""
+    try:
+        import jax
+        d = jax.local_devices()[0]
+        out = {"platform": d.platform, "device": str(d)}
+        mem = d.memory_stats() or {}
+        for k in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+            if k in mem:
+                out[k] = int(mem[k])
+        if mem.get("bytes_limit"):
+            out["hbm_used_frac"] = round(
+                mem.get("bytes_in_use", 0) / mem["bytes_limit"], 4)
+        return out
+    except Exception:
+        return {}
+
+
+def worker_health(model) -> list[dict]:
+    """Per-remote-stage liveness from the master's client channels. A
+    worker is `failing` when forwards are being attempted without success:
+    the newest attempt is > STALE_WORKER_S past the newest success, an old
+    attempt is still unanswered (wedged mid-forward: last_attempt frozen
+    ahead of last_ok), or attempts exist and none has ever succeeded. Mere
+    idleness (success as recent as the last attempt, or a never-used
+    channel) is healthy."""
+    out = []
+    t = now()
+    for s in getattr(model, "stages", None) or []:
+        if s.kind != "remote":
+            continue
+        last_ok = getattr(s.runner, "last_ok", None)
+        last_attempt = getattr(s.runner, "last_attempt", None)
+        if last_attempt is None:
+            failing = False                    # channel never exercised
+        elif last_ok is None:
+            failing = True                     # tried, never succeeded
+        else:
+            pending = last_attempt > last_ok   # newest forward unanswered
+            failing = (last_attempt - last_ok > STALE_WORKER_S
+                       or (pending and t - last_attempt > STALE_WORKER_S))
+        out.append({
+            "name": getattr(s.runner, "name", "?"),
+            "layers": [s.start, s.end],
+            "last_ok_age_s": None if last_ok is None
+            else round(t - last_ok, 3),
+            "failing": failing,
+            "ops": getattr(s.runner, "total_ops", 0),
+        })
+    return out
+
+
+async def trace(request: web.Request) -> web.Response:
+    """Chrome-trace JSON of the span ring buffer (open in Perfetto).
+    ?clear=1 drains the buffer after the snapshot. 409 while the recorder
+    is disabled (enable via CAKE_TRACE_DIR or programmatically)."""
+    if not RECORDER.enabled:
+        return web.json_response(
+            {"error": "span recorder disabled (set CAKE_TRACE_DIR)"},
+            status=409)
+    body = RECORDER.to_chrome_trace()
+    if request.query.get("clear") in ("1", "true"):
+        RECORDER.clear()
+    return web.json_response(body)
+
+
+async def health(request: web.Request) -> web.Response:
+    state: ApiState = request.app["state"]
+    workers = worker_health(state.model)
+    stale = [w["name"] for w in workers if w["failing"]]
+    body = {
+        "status": "degraded" if stale else "ok",
+        "uptime_s": max(int(time.time()) - state.created, 0),
+        "models": [m["id"] + ":" + m["kind"] for m in state.owned_models()],
+        "workers": workers,
+        "stale_workers": stale,
+        "device": _device_health(),
+    }
+    return web.json_response(body, status=200 if not stale else 503)

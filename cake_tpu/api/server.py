@@ -10,6 +10,9 @@ Routes:
   GET  /api/v1/topology         cluster topology JSON
   GET  /api/v1/layers           per-layer tensor detail (static, fetch once)
   GET  /api/v1/stats            last generation's timing snapshot
+  GET  /metrics                 Prometheus text exposition
+  GET  /health                  liveness: workers' last-seen age, HBM usage
+  GET  /api/v1/trace            Chrome-trace JSON of recorded spans
   GET  /                        embedded web UI
 """
 from __future__ import annotations
@@ -20,13 +23,36 @@ import time
 
 from aiohttp import web
 
+from ..obs import API_REQUESTS, API_REQUEST_SECONDS, now
 from . import audio as audio_routes
 from . import images as image_routes
+from . import obs_routes
 from . import text as text_routes
 from . import ui as ui_routes
 from .state import ApiState
 
 log = logging.getLogger("cake_tpu.api")
+
+
+@web.middleware
+async def metrics_middleware(request, handler):
+    """Per-request counters/latency for every route. The endpoint label is
+    the matched route's canonical pattern (bounded cardinality — arbitrary
+    404 paths all land on "unmatched")."""
+    t0 = now()
+    status = 500
+    try:
+        resp = await handler(request)
+        status = resp.status
+        return resp
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    finally:
+        resource = getattr(request.match_info.route, "resource", None)
+        endpoint = getattr(resource, "canonical", None) or "unmatched"
+        API_REQUESTS.inc(endpoint=endpoint, status=str(status))
+        API_REQUEST_SECONDS.observe(now() - t0, endpoint=endpoint)
 
 
 @web.middleware
@@ -52,7 +78,8 @@ async def basic_auth_middleware(request, handler):
 
 
 def create_app(state: ApiState, basic_auth: str | None = None) -> web.Application:
-    app = web.Application(middlewares=[basic_auth_middleware],
+    app = web.Application(middlewares=[metrics_middleware,
+                                       basic_auth_middleware],
                           client_max_size=64 * 1024 * 1024)
     state.created = int(time.time())
     app["state"] = state
@@ -67,6 +94,9 @@ def create_app(state: ApiState, basic_auth: str | None = None) -> web.Applicatio
     app.router.add_get("/api/v1/topology", ui_routes.topology)
     app.router.add_get("/api/v1/layers", ui_routes.layers)
     app.router.add_get("/api/v1/stats", ui_routes.stats)
+    app.router.add_get("/metrics", obs_routes.metrics)
+    app.router.add_get("/health", obs_routes.health)
+    app.router.add_get("/api/v1/trace", obs_routes.trace)
     app.router.add_get("/", ui_routes.index)
     return app
 

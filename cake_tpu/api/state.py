@@ -8,6 +8,7 @@ the event loop keeps streaming SSE chunks while the TPU decodes.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import threading
 import queue as queue_mod
 from dataclasses import dataclass, field
@@ -48,6 +49,16 @@ class ApiState:
         return out
 
 
+async def run_blocking(fn):
+    """Run fn in the default executor, carrying the caller's contextvars
+    (request id) into the worker thread so spans recorded inside attribute
+    to the current request — the one context-propagation idiom shared by
+    the text/image/audio handlers."""
+    loop = asyncio.get_running_loop()
+    ctx = contextvars.copy_context()
+    return await loop.run_in_executor(None, lambda: ctx.run(fn))
+
+
 def _call_generate(model, messages_or_ids, gen_kwargs: dict, on_token=None):
     """Shared messages-vs-token-ids dispatch for both endpoints."""
     kw = dict(gen_kwargs)
@@ -64,9 +75,8 @@ async def run_generation_blocking(model, messages_or_ids, gen_kwargs: dict):
     TextModel takes the single-device-call while_loop decode path (one host
     sync per cache bucket instead of one per streamed chunk). Returns
     (token_ids, stats)."""
-    loop = asyncio.get_running_loop()
-    return await loop.run_in_executor(
-        None, lambda: _call_generate(model, messages_or_ids, gen_kwargs))
+    return await run_blocking(
+        lambda: _call_generate(model, messages_or_ids, gen_kwargs))
 
 
 def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):
@@ -78,11 +88,13 @@ def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):
     q: queue_mod.Queue = queue_mod.Queue()
     DONE = object()
     result: dict = {}
+    # carry the handler's context (request id) into the generation thread
+    ctx = contextvars.copy_context()
 
     def worker():
         try:
-            toks, stats = _call_generate(model, messages_or_ids, gen_kwargs,
-                                         on_token=q.put)
+            toks, stats = ctx.run(_call_generate, model, messages_or_ids,
+                                  gen_kwargs, on_token=q.put)
             result["tokens"] = toks
             result["stats"] = stats
         except Exception as e:  # surfaced to the stream consumer

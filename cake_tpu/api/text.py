@@ -9,6 +9,7 @@ import uuid
 
 from aiohttp import web
 
+from ..obs import GENERATIONS, current_request_id, set_request_id
 from ..ops.sampling import SamplingConfig
 from .state import (ApiState, run_generation_blocking,
                     run_generation_streamed)
@@ -126,6 +127,9 @@ def _stats_snapshot(stats: dict) -> dict:
     reference surfaces topology only; the wire/compute attribution is
     what actually localizes a slow cluster)."""
     out = {"ts": int(time.time())}
+    rid = current_request_id()
+    if rid:
+        out["request_id"] = rid
     for k in ("ttft_s", "decode_tokens", "decode_s", "tok_per_s",
               "stage_rtts", "prefill"):
         if k in stats:
@@ -134,14 +138,21 @@ def _stats_snapshot(stats: dict) -> dict:
 
 
 async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
+    cid = _completion_id()
+    # the completion id doubles as the request id: spans recorded during
+    # this request's generation (model phases, cluster hops) carry it, so
+    # a trace export is joinable with API logs/responses
+    set_request_id(cid)
     async with state.lock:                  # one inference at a time
         try:
             toks, stats = await run_generation_blocking(state.model, messages,
                                                         gen_kwargs)
             state.last_stats = _stats_snapshot(stats)
         except Exception as e:
+            GENERATIONS.inc(kind="text", status="error")
             return web.json_response({"error": f"generation failed: {e}"},
                                      status=500)
+    GENERATIONS.inc(kind="text", status="ok")
     n_out = len(toks)
     n_in = _prompt_token_count(state, messages)
     ended = bool(toks) and state.model.cfg.is_eos(toks[-1])
@@ -150,7 +161,7 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
     tokenizer = state.tokenizer or getattr(state.model, "tokenizer", None)
     text = _decode_text(tokenizer, content_ids)
     return web.json_response({
-        "id": _completion_id(),
+        "id": cid,
         "object": "chat.completion",
         "created": int(time.time()),
         "model": state.model_id,
@@ -176,6 +187,7 @@ async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
     })
     await resp.prepare(request)
     cid = _completion_id()
+    set_request_id(cid)         # spans from this generation carry the cid
     created = int(time.time())
 
     def chunk(delta: dict, finish=None) -> bytes:
@@ -219,6 +231,8 @@ async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
             # with a final chunk + [DONE] so clients don't hang
             await write_safe(chunk({"content": f"\n[error: {e}]"}))
             finish = "error"
+        GENERATIONS.inc(kind="text",
+                        status="error" if finish == "error" else "ok")
         if "stats" in result:
             state.last_stats = _stats_snapshot(result["stats"])
     await write_safe(chunk({}, finish=finish))

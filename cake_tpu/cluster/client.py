@@ -15,6 +15,7 @@ import time
 
 import numpy as np
 
+from ..obs import HOP_SECONDS, now
 from . import proto
 from .auth import AuthError, _mac, CHALLENGE_LEN, MAC_LEN
 
@@ -39,7 +40,16 @@ class RemoteStage:
         self.info: dict = {}
         self._rid = 0
         from collections import deque
-        self.rtts: deque = deque(maxlen=512)       # (rtt_s, worker_fwd_s)
+        # (rtt_s, timing-echo dict in ms: read/deser/fwd/ser — empty for
+        # workers predating the echo)
+        self.rtts: deque = deque(maxlen=512)
+        # monotonic timestamps of the last forward attempt / success on
+        # this channel — /health reports the success age and flags a
+        # worker only when attempts keep happening without successes
+        # (an idle channel is not a dead one)
+        self.last_attempt: float | None = None
+        self.last_ok: float | None = None
+        self.total_ops = 0          # cumulative successes (never cleared)
 
     # -- connection --------------------------------------------------------
 
@@ -119,28 +129,53 @@ class RemoteStage:
         slot is passed through untouched (None). kv_hint: master's current
         cache bucket, so the worker sizes its cache to match."""
         self._rid += 1
-        t0 = time.monotonic()
+        t0 = now()
+        self.last_attempt = t0
         proto.write_frame_sync(self.sock, proto.forward(
             np.asarray(x), int(pos0),
             None if valid_len is None else int(valid_len), self._rid,
             kv_hint=kv_hint))
         msg = proto.read_frame_sync(self.sock)
-        rtt = time.monotonic() - t0
+        rtt = now() - t0
         if msg.get("t") == "worker_error":
             raise RuntimeError(f"worker {self.name}: {msg['error']}")
         if msg.get("rid", self._rid) != self._rid:
             raise proto.ProtocolError("response id mismatch")
         # successful replies only: error RTTs would pollute the wire stats
-        self.rtts.append((rtt, float(msg.get("fwd_ms", 0.0)) / 1e3))
+        tm = dict(msg.get("tm") or {})
+        if "fwd_ms" not in tm and msg.get("fwd_ms"):
+            tm["fwd_ms"] = float(msg["fwd_ms"])   # pre-echo workers
+        self.rtts.append((rtt, tm))
+        self.last_ok = now()
+        self.total_ops += 1
+        self._observe_hop(rtt, tm)
         return proto.unpack_tensor(msg["x"]), cache
+
+    def _observe_hop(self, rtt: float, tm: dict):
+        """Feed the per-hop histograms: whole RTT, each worker-echoed phase,
+        and the unattributed remainder (wire = TCP + response write +
+        scheduling)."""
+        HOP_SECONDS.observe(rtt, worker=self.name, phase="rtt")
+        echoed = 0.0
+        for k in self._ECHO_PHASES:
+            v = tm.get(f"{k}_ms")
+            if v is not None:
+                HOP_SECONDS.observe(v / 1e3, worker=self.name, phase=k)
+                echoed += v / 1e3
+        if echoed:
+            HOP_SECONDS.observe(max(rtt - echoed, 0.0),
+                                worker=self.name, phase="wire")
+
+    _ECHO_PHASES = ("read", "deser", "fwd", "ser")
 
     def rtt_stats(self) -> dict:
         """Per-hop round-trip accounting (ref: client.rs:96-104 per-client
         send/recv timing). mean vs p50 spread flags bimodal stalls
-        (Nagle/delayed-ACK class of bugs). Each RTT splits into the
-        worker-reported compute time (fwd_*, includes any in-band compile)
-        and the remainder (wire_*: serialization + TCP + scheduling), so a
-        tail stall is attributable to one side."""
+        (Nagle/delayed-ACK class of bugs). Each RTT splits into the phases
+        the worker echoes back (read_/deser_/fwd_/ser_*, with fwd including
+        any in-band compile) and the remainder (wire_*: TCP + response
+        write + scheduling), so a tail stall is attributable to one side
+        of the link AND one phase of the worker's message handling."""
         if not self.rtts:
             return {"count": 0}
 
@@ -151,15 +186,23 @@ class RemoteStage:
                     f"{prefix}mean_ms": round(sum(arr) / len(arr) * 1e3, 2),
                     f"{prefix}min_ms": round(arr[0] * 1e3, 2)}
 
-        rtts = [r for r, _ in self.rtts]
+        samples = list(self.rtts)
+        rtts = [r for r, _ in samples]
         out = {"count": len(rtts), **_stats(rtts, "")}
-        # split only over samples that carry a worker timing (f > 0): a
-        # worker predating fwd_ms would otherwise have its whole RTT
+        for k in self._ECHO_PHASES:
+            vals = [t[f"{k}_ms"] / 1e3 for _, t in samples
+                    if t.get(f"{k}_ms")]
+            if vals:
+                out.update(_stats(vals, f"{k}_"))
+        # wire remainder only over samples that carry a worker timing: a
+        # worker predating the echo would otherwise have its whole RTT
         # misattributed to the wire
-        timed = [(r, f) for r, f in self.rtts if f > 0]
+        timed = [(r, t) for r, t in samples if t.get("fwd_ms")]
         if timed:
-            out.update(_stats([f for _, f in timed], "fwd_"))
-            out.update(_stats([max(r - f, 0.0) for r, f in timed], "wire_"))
+            out.update(_stats(
+                [max(r - sum(t.get(f"{k}_ms", 0.0)
+                             for k in self._ECHO_PHASES) / 1e3, 0.0)
+                 for r, t in timed], "wire_"))
         return out
 
     def goodbye(self):

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import functools
 import logging
-import time
 from dataclasses import dataclass, field
 
 import jax
@@ -28,9 +27,11 @@ from ..models.common.config import ModelConfig
 from ..models.common.layers import (embed_tokens, forward_layers,
                                     lm_head_logits)
 from ..models.common.text_model import (PREFILL_BUCKETS, PREFILL_CHUNK,
-                                        LocalStage, Token, bucket_for,
+                                        LocalStage, Token,
+                                        _observe_generation, bucket_for,
                                         check_prefill_bounds,
                                         select_flash_mode)
+from ..obs import RECORDER, now
 from ..ops.sampling import SamplingConfig, push_recent_token, sample
 from .auth import cluster_hash
 from .client import RemoteStage
@@ -129,6 +130,13 @@ class DistributedTextModel:
         """One stage hop — the single definition of local/remote dispatch
         (dtype cast, flash-mode selection, kv hint) shared by the
         sequential chain and the pipelined prefill threads."""
+        with RECORDER.span("layers", cat="phase", kind=s.kind,
+                           start=s.start, end=s.end,
+                           worker=getattr(s.runner, "name", "")):
+            return self._stage_forward_inner(s, x, pos0, valid_len)
+
+    def _stage_forward_inner(self, s: Stage, x, pos0: int,
+                             valid_len: int | None):
         if s.kind == "local":
             # local prefill stages flash like TextModel.prefill
             # (full-length unwrapped caches)
@@ -243,9 +251,12 @@ class DistributedTextModel:
         return self._head(self.params, x.astype(self.dtype))
 
     def decode_logits(self, token_id: int, pos: int):
-        x = self._embed(self.params, jnp.asarray([[token_id]], jnp.int32))
+        with RECORDER.span("embed", cat="phase"):
+            x = self._embed(self.params, jnp.asarray([[token_id]], jnp.int32))
         x = self._run_stages(x, pos, None)
-        return self._head(self.params, jnp.asarray(x)[:, -1:].astype(self.dtype))
+        with RECORDER.span("lm_head", cat="phase"):
+            return self._head(self.params,
+                              jnp.asarray(x)[:, -1:].astype(self.dtype))
 
     # -- generation ---------------------------------------------------------
 
@@ -271,12 +282,14 @@ class DistributedTextModel:
         out: list[int] = []
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
 
-        t0 = time.monotonic()
-        logits = self.prefill_logits(prompt_ids)
-        rng, sk = jax.random.split(rng)
-        tok = self._sample(logits[0], sk, recent, scfg)
-        recent = push_recent_token(recent, tok)
-        ttft = time.monotonic() - t0
+        t0 = now()
+        with RECORDER.span("prefill", cat="gen", tokens=len(prompt_ids)):
+            logits = self.prefill_logits(prompt_ids)
+        with RECORDER.span("sample", cat="phase"):
+            rng, sk = jax.random.split(rng)
+            tok = self._sample(logits[0], sk, recent, scfg)
+            recent = push_recent_token(recent, tok)
+        ttft = now() - t0
 
         pos = len(prompt_ids)
         tid = int(tok)
@@ -284,22 +297,24 @@ class DistributedTextModel:
         if on_token:
             on_token(self._mk_token(tid))
 
-        t1 = time.monotonic()
+        t1 = now()
         budget = self.max_cache_len - len(prompt_ids) - 1
         max_new_tokens = min(max_new_tokens, max(budget, 1))
         while not self.cfg.is_eos(tid) and len(out) < max_new_tokens:
             if pos + 1 > self._kv_len:
                 self._grow_local(bucket_for(pos + 2, self.max_cache_len))
-            logits = self.decode_logits(tid, pos)
-            rng, sk = jax.random.split(rng)
-            tok = self._sample(logits[0], sk, recent, scfg)
-            recent = push_recent_token(recent, tok)
-            tid = int(tok)
+            with RECORDER.span("decode_token", cat="gen", pos=pos):
+                logits = self.decode_logits(tid, pos)
+                with RECORDER.span("sample", cat="phase"):
+                    rng, sk = jax.random.split(rng)
+                    tok = self._sample(logits[0], sk, recent, scfg)
+                    recent = push_recent_token(recent, tok)
+                    tid = int(tok)
             pos += 1
             out.append(tid)
             if on_token:
                 on_token(self._mk_token(tid))
-        dt = time.monotonic() - t1
+        dt = now() - t1
         stats = {"ttft_s": ttft, "decode_tokens": len(out) - 1,
                  "decode_s": dt, "prefill": dict(self._last_prefill),
                  "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0,
@@ -307,6 +322,7 @@ class DistributedTextModel:
                      f"{s.runner.name}[{s.start}:{s.end}]":
                          s.runner.rtt_stats()
                      for s in self.stages if s.kind == "remote"}}
+        _observe_generation(stats, len(out), path="cluster")
         return out, stats
 
     def _mk_token(self, tid: int) -> Token:

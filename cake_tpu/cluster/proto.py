@@ -33,6 +33,7 @@ from typing import Any
 import msgpack
 import numpy as np
 
+from ..obs import now
 from ..utils.dtypes import WIRE_DTYPES, WIRE_TAGS, from_numpy_bytes
 
 MAGIC = 0x54504B31          # "TPK1"
@@ -106,10 +107,24 @@ def decode_payload(payload: bytes) -> dict:
 
 
 async def read_frame(reader: asyncio.StreamReader) -> dict:
+    return (await read_frame_timed(reader))[0]
+
+
+async def read_frame_timed(reader: asyncio.StreamReader
+                           ) -> tuple[dict, float, float]:
+    """read_frame that also reports (payload-read seconds, decode seconds).
+
+    The clock starts AFTER the header arrives, so idle time waiting for the
+    next request is excluded — read_s is genuinely "time to pull this
+    frame's bytes off the socket" (ref: worker.rs:533-543 per-message
+    `read` phase)."""
     hdr = await reader.readexactly(_HDR.size)
     length = _parse_header(hdr)
+    t0 = now()
     payload = await reader.readexactly(length)
-    return decode_payload(payload)
+    t1 = now()
+    msg = decode_payload(payload)
+    return msg, t1 - t0, now() - t1
 
 
 async def write_frame(writer: asyncio.StreamWriter, msg: dict):
@@ -147,10 +162,21 @@ def hello(name: str, version: str = "1") -> dict:
 
 
 def worker_info(name: str, layers: list[int], backend: str, device: str,
-                memory_bytes: int, tflops: float) -> dict:
-    return {"t": "worker_info", "name": name, "layers": layers,
-            "backend": backend, "device": device,
-            "memory_bytes": memory_bytes, "tflops": tflops}
+                memory_bytes: int, tflops: float,
+                heartbeat_age_s: float | None = None,
+                ops: int | None = None) -> dict:
+    """heartbeat_age_s: seconds since this worker last handled any message
+    on its own monotonic clock (clocks aren't synchronized across nodes, so
+    an AGE is the only meaningful cross-node liveness field); ops: forwards
+    served since start."""
+    out = {"t": "worker_info", "name": name, "layers": layers,
+           "backend": backend, "device": device,
+           "memory_bytes": memory_bytes, "tflops": tflops}
+    if heartbeat_age_s is not None:
+        out["heartbeat_age_s"] = round(heartbeat_age_s, 3)
+    if ops is not None:
+        out["ops"] = int(ops)
+    return out
 
 
 def layer_assignment(model_id: str, arch: str, config: dict,
@@ -200,13 +226,26 @@ def forward(x, pos0: int, valid_len: int | None, request_id: int = 0,
 
 
 def tensor_result(arr, request_id: int = 0,
-                  fwd_ms: float | None = None) -> dict:
+                  fwd_ms: float | None = None,
+                  timing: dict | None = None) -> dict:
     """fwd_ms: worker-side compute time for this request (includes any
     in-band XLA compile) — lets the master separate wire time from worker
-    time in its per-hop RTT stats."""
-    out = {"t": "tensor", "x": pack_tensor(arr), "rid": request_id}
+    time in its per-hop RTT stats.
+
+    timing: optional per-phase echo {read_ms, deser_ms, fwd_ms, ser_ms}
+    (ref: worker.rs:533-543's read/load/fwd/ser/write breakdown) — the
+    master subtracts the echoed phases from its observed RTT to attribute
+    the remainder to the wire (TCP + response write + scheduling).
+
+    arr may be a numpy/jax array OR an already-packed wire dict (so the
+    worker can time pack_tensor as its `ser` phase without double-packing).
+    """
+    packed = arr if isinstance(arr, dict) and "dt" in arr else pack_tensor(arr)
+    out = {"t": "tensor", "x": packed, "rid": request_id}
     if fwd_ms is not None:
         out["fwd_ms"] = round(fwd_ms, 3)
+    if timing:
+        out["tm"] = {k: round(float(v), 3) for k, v in timing.items()}
     return out
 
 

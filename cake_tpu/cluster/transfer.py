@@ -22,7 +22,13 @@ import os
 import struct
 from typing import Iterable, Iterator
 
-import zstandard
+try:
+    import zstandard
+except ImportError:
+    # gated dependency: without zstd the stream degrades to uncompressed
+    # chunks (CRC + resume + caching all still work); receiving a
+    # compressed chunk without it is a hard protocol error
+    zstandard = None
 
 from ..utils.safetensors_io import TensorStorage, layer_of
 from . import proto
@@ -106,7 +112,7 @@ def should_compress(sample: bytes) -> bool:
     """zstd only pays off for compressible data — probe the first 4 KB
     (ref: sharding/mod.rs:669-694)."""
     probe = sample[:PROBE_LEN]
-    if not probe:
+    if not probe or zstandard is None:
         return False
     compressed = zstandard.ZstdCompressor(level=1).compress(probe)
     return len(compressed) < int(len(probe) * 0.9)
@@ -115,7 +121,7 @@ def should_compress(sample: bytes) -> bool:
 def encode_chunks(file_name: str, total: int, chunks: Iterable[bytes],
                   start_offset: int = 0) -> Iterator[dict]:
     """bytes chunks -> model_chunk protocol messages."""
-    cctx = zstandard.ZstdCompressor(level=1)
+    cctx = zstandard.ZstdCompressor(level=1) if zstandard else None
     offset = start_offset
     n_total = max(1, (total + CHUNK_SIZE - 1) // CHUNK_SIZE)
     i = 0
@@ -142,7 +148,7 @@ class ModelReceiver:
         self.dir = os.path.join(cache_root, key)
         os.makedirs(self.dir, exist_ok=True)
         self._files: dict[str, object] = {}
-        self._dctx = zstandard.ZstdDecompressor()
+        self._dctx = zstandard.ZstdDecompressor() if zstandard else None
 
     def path(self, file_name: str) -> str:
         safe = os.path.basename(file_name)
@@ -159,6 +165,9 @@ class ModelReceiver:
             raise proto.ProtocolError(
                 f"CRC mismatch on {msg['file']} chunk {msg['i']}")
         if msg["z"]:
+            if self._dctx is None:
+                raise proto.ProtocolError(
+                    "compressed chunk received but zstandard is unavailable")
             data = self._dctx.decompress(data, max_output_size=2 * CHUNK_SIZE)
         p = self.path(msg["file"]) + ".part"
         f = self._files.get(p)

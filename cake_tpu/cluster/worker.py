@@ -16,7 +16,6 @@ import asyncio
 import json
 import logging
 import os
-import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,6 +23,7 @@ import numpy as np
 from ..models.common.cache import cache_reset, init_cache
 from ..models.common.config import config_from_hf_dict
 from ..models.common.text_model import LocalStage, select_flash_mode
+from ..obs import PhaseTimer, WORKER_FWD_SECONDS, WORKER_HEARTBEAT, now
 from ..utils.dtypes import parse_dtype
 from ..utils.hub import cake_cache_dir
 from . import proto
@@ -76,6 +76,17 @@ class WorkerServer:
         self._server: asyncio.AbstractServer | None = None
         self._writers: set = set()       # live connections, closed on stop()
         self.stats = {"ops": 0, "tokens": 0, "fwd_s": 0.0}
+        # monotonic liveness: bumped on every handled message, reported as
+        # an AGE in worker_info (clocks aren't synchronized across nodes)
+        # and exported/logged by the heartbeat loop so /health never has to
+        # assume liveness
+        self.started = now()
+        self.last_heartbeat = now()
+        # per-message phase accounting (read/deser/fwd/ser — the obs
+        # replacement for the reference's worker.rs:533-543 breakdown);
+        # phases also land in the span recorder when tracing is on
+        self.phase = PhaseTimer()
+        self._hb_task: asyncio.Task | None = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -90,14 +101,32 @@ class WorkerServer:
             self._advertiser = WorkerAdvertiser(
                 self.name, self.cluster_key, self.port, caps=self.caps,
                 **kw).start()
+        self._hb_task = asyncio.get_running_loop().create_task(
+            self._heartbeat_loop())
         log.info("worker %s listening on %s:%d", self.name, self.host, self.port)
         return self
+
+    HEARTBEAT_INTERVAL = 15.0
+
+    async def _heartbeat_loop(self):
+        """Periodic liveness export: the gauge carries the monotonic
+        last-activity timestamp, the log line the age + phase breakdown —
+        a wedged worker is then visible as a growing age, not silence."""
+        while True:
+            await asyncio.sleep(self.HEARTBEAT_INTERVAL)
+            WORKER_HEARTBEAT.set(now() - self.last_heartbeat,
+                                 worker=self.name)
+            log.debug("worker %s heartbeat: last activity %.1fs ago, "
+                      "%d ops [%s]", self.name, now() - self.last_heartbeat,
+                      self.stats["ops"], self.phase)
 
     async def serve_forever(self):
         async with self._server:
             await self._server.serve_forever()
 
     async def stop(self):
+        if self._hb_task:
+            self._hb_task.cancel()
         if self._advertiser:
             self._advertiser.stop()
         if self._server:
@@ -144,14 +173,21 @@ class WorkerServer:
         cache = None
         try:
             while True:
-                msg = await proto.read_frame(reader)
+                msg, read_s, decode_s = await proto.read_frame_timed(reader)
+                # bump liveness on EVERY received message, before any
+                # branch can continue/raise past it; hello reports the age
+                # before this message arrived
+                prev_heartbeat = self.last_heartbeat
+                self.last_heartbeat = now()
                 t = msg.get("t")
                 if t == "hello":
                     await proto.write_frame(writer, proto.worker_info(
                         self.name,
                         list(range(self.state.start, self.state.end)),
                         self.caps["backend"], self.caps["device"],
-                        self.caps["memory_bytes"], self.caps["tflops"]))
+                        self.caps["memory_bytes"], self.caps["tflops"],
+                        heartbeat_age_s=now() - prev_heartbeat,
+                        ops=self.stats["ops"]))
                 elif t == "layer_assignment":
                     cache = None
                     await self._handle_assignment(msg, reader, writer)
@@ -160,7 +196,8 @@ class WorkerServer:
                         await proto.write_frame(writer, proto.worker_error(
                             "no layer assignment"))
                         continue
-                    cache = await self._handle_forward(msg, writer, cache)
+                    cache = await self._handle_forward(msg, writer, cache,
+                                                       read_s, decode_s)
                 elif t == "goodbye":
                     # drop (not just zero) the cache: a grown buffer must
                     # not leak its size into the next generation — the next
@@ -221,7 +258,7 @@ class WorkerServer:
             await proto.write_frame(writer, a)
 
         try:
-            t0 = time.monotonic()
+            t0 = now()
             from ..utils.loaders import load_model_params
             quant = None
             if msg.get("fp8_native"):
@@ -245,7 +282,7 @@ class WorkerServer:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._warm, msg.get("warm", "decode"))
             log.info("worker %s loaded layers [%d,%d) in %.1fs", self.name,
-                     st.start, st.end, time.monotonic() - t0)
+                     st.start, st.end, now() - t0)
             await proto.write_frame(writer, proto.worker_ready())
         except Exception as e:
             log.exception("assignment failed")
@@ -262,7 +299,7 @@ class WorkerServer:
         from ..models.common.text_model import (PREFILL_BUCKETS,
                                                 PREFILL_CHUNK)
         st = self.state
-        t0 = time.monotonic()
+        t0 = now()
         buckets = [b for b in PREFILL_BUCKETS if b <= st.max_cache_len]
         if not buckets or buckets[-1] != st.max_cache_len:
             buckets.append(st.max_cache_len)
@@ -309,7 +346,7 @@ class WorkerServer:
                                 p0, PREFILL_CHUNK, b))
                         n += 1
         log.info("worker %s warmed %d shapes (%s) in %.1fs", self.name, n,
-                 mode, time.monotonic() - t0)
+                 mode, now() - t0)
 
     async def _receive_weights(self, reader, key: str, assign_msg,
                                recv: ModelReceiver) -> str:
@@ -360,11 +397,16 @@ class WorkerServer:
             cap = bkt
         return cache, cap
 
-    async def _handle_forward(self, msg, writer, cache):
+    async def _handle_forward(self, msg, writer, cache, read_s: float = 0.0,
+                              decode_s: float = 0.0):
         st = self.state
-        t0 = time.monotonic()
+        t0 = now()
         try:
+            # deser: msgpack decode (timed by the framing layer) + raw-buffer
+            # unpack + host->device transfer/cast
+            t_d = now()
             x = jnp.asarray(proto.unpack_tensor(msg["x"])).astype(st.dtype)
+            deser_s = decode_s + (now() - t_d)
             raw_pos0 = int(msg["pos0"])
             pos0 = jnp.asarray(raw_pos0, jnp.int32)
             vl = msg.get("valid_len")
@@ -385,28 +427,46 @@ class WorkerServer:
                 # timing starts INSIDE the executor thread (queueing delay
                 # belongs to wire_, not fwd_) and ends after a real fetch
                 # (jax dispatch is async; only np.asarray syncs the device)
-                t_fwd = time.monotonic()
+                t_fwd = now()
                 yy, cc = st.stage.forward_hidden(x, cache, pos0, vl,
                                                  flash_mode=flash_mode)
                 yy = np.asarray(yy)
-                return yy, cc, (time.monotonic() - t_fwd) * 1e3
+                return yy, cc, t_fwd, (now() - t_fwd) * 1e3
 
-            y, cache, fwd_ms = await loop.run_in_executor(None, _run)
+            y, cache, t_fwd0, fwd_ms = await loop.run_in_executor(None, _run)
+            # ser timed separately so the echo attributes it: tobytes of
+            # the hidden state dominates the response path
+            t_s = now()
+            packed = proto.pack_tensor(y)
+            ser_s = now() - t_s
+            # per-phase echo: lets the master split its observed RTT into
+            # worker-side read/deser/fwd/ser and attribute the remainder
+            # to the wire (ref: worker.rs:533-543)
+            tm = {"read_ms": read_s * 1e3, "deser_ms": deser_s * 1e3,
+                  "fwd_ms": fwd_ms, "ser_ms": ser_s * 1e3}
             await proto.write_frame(
-                writer, proto.tensor_result(y, msg.get("rid", 0),
-                                            fwd_ms=fwd_ms))
+                writer, proto.tensor_result(packed, msg.get("rid", 0),
+                                            fwd_ms=fwd_ms, timing=tm))
         except Exception as e:
             log.exception("forward failed")
             await proto.write_frame(writer, proto.worker_error(str(e)))
             return cache
-        dt = time.monotonic() - t0
+        dt = now() - t0
         self.stats["ops"] += 1
         self.stats["fwd_s"] += dt
         self.stats["tokens"] += int(np.prod(np.asarray(msg["x"]["sh"][:2])))
+        WORKER_FWD_SECONDS.observe(fwd_ms / 1e3)
+        # real start timestamps so the exported spans lay out sequentially
+        # (read/decode finished just before the handler entered at t0)
+        ph = self.phase
+        ph.add("read", read_s, t0=t0 - decode_s - read_s)
+        ph.add("deser", deser_s, t0=t0 - decode_s)
+        ph.add("fwd", fwd_ms / 1e3, t0=t_fwd0)
+        ph.add("ser", ser_s, t0=t_s)
         if self.stats["ops"] % 5 == 0:   # rolling stats (ref worker.rs:566-578)
-            log.debug("worker %s: %d ops, avg %.1f ms", self.name,
+            log.debug("worker %s: %d ops, avg %.1f ms [%s]", self.name,
                       self.stats["ops"],
-                      1000 * self.stats["fwd_s"] / self.stats["ops"])
+                      1000 * self.stats["fwd_s"] / self.stats["ops"], ph)
         return cache
 
 

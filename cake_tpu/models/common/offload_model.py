@@ -14,18 +14,17 @@ cost model is dominated by expert reads, not dispatch overhead.
 """
 from __future__ import annotations
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...obs import RECORDER, now
 from ...ops.sampling import SamplingConfig, push_recent_token, sample
 from .cache import init_cache
 from .config import ModelConfig
 from .layers import embed_tokens, forward_layers, lm_head_logits
-from .text_model import (Token, bucket_for, chat_prompt_ids,
-                         check_prefill_bounds)
+from .text_model import (Token, _observe_generation, bucket_for,
+                         chat_prompt_ids, check_prefill_bounds)
 
 
 class OffloadedTextModel:
@@ -62,45 +61,53 @@ class OffloadedTextModel:
         cache = init_cache(cfg, 1, kv_len, self.dtype)
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
 
-        t0 = time.monotonic()
+        t0 = now()
         bkt = check_prefill_bounds(n, 0, kv_len, self.max_cache_len)
-        padded = np.zeros((1, bkt), np.int32)
-        padded[0, :n] = prompt_ids
-        x = embed_tokens(cfg, self.params, jnp.asarray(padded))
-        x, cache = self._forward(x, cache, 0, n)
-        logits = lm_head_logits(cfg, self.params,
-                                x[:, n - 1:n].astype(self.dtype))[:, 0]
-        rng, sk = jax.random.split(rng)
-        tok = sample(logits[0], sk, scfg, recent)
-        recent = push_recent_token(recent, tok)
-        tid = int(tok)
-        ttft = time.monotonic() - t0
+        with RECORDER.span("prefill", cat="gen", tokens=n):
+            padded = np.zeros((1, bkt), np.int32)
+            padded[0, :n] = prompt_ids
+            x = embed_tokens(cfg, self.params, jnp.asarray(padded))
+            x, cache = self._forward(x, cache, 0, n)
+            logits = lm_head_logits(cfg, self.params,
+                                    x[:, n - 1:n].astype(self.dtype))[:, 0]
+        with RECORDER.span("sample", cat="phase"):
+            rng, sk = jax.random.split(rng)
+            tok = sample(logits[0], sk, scfg, recent)
+            recent = push_recent_token(recent, tok)
+            tid = int(tok)
+        ttft = now() - t0
 
         out = [tid]
         if on_token:
             on_token(self._mk_token(tid))
         pos = n
-        t1 = time.monotonic()
+        t1 = now()
         budget = min(max_new_tokens, self.max_cache_len - n)
         while not cfg.is_eos(tid) and len(out) < budget:
-            x = embed_tokens(cfg, self.params,
-                             jnp.asarray([[tid]], jnp.int32))
-            x, cache = self._forward(x, cache, pos, None)
-            logits = lm_head_logits(cfg, self.params,
-                                    x[:, -1:].astype(self.dtype))[:, 0]
-            rng, sk = jax.random.split(rng)
-            tok = sample(logits[0], sk, scfg, recent)
-            recent = push_recent_token(recent, tok)
-            tid = int(tok)
+            with RECORDER.span("decode_token", cat="gen", pos=pos):
+                with RECORDER.span("embed", cat="phase"):
+                    x = embed_tokens(cfg, self.params,
+                                     jnp.asarray([[tid]], jnp.int32))
+                with RECORDER.span("layers", cat="phase"):
+                    x, cache = self._forward(x, cache, pos, None)
+                with RECORDER.span("lm_head", cat="phase"):
+                    logits = lm_head_logits(
+                        cfg, self.params, x[:, -1:].astype(self.dtype))[:, 0]
+                with RECORDER.span("sample", cat="phase"):
+                    rng, sk = jax.random.split(rng)
+                    tok = sample(logits[0], sk, scfg, recent)
+                    recent = push_recent_token(recent, tok)
+                    tid = int(tok)
             pos += 1
             out.append(tid)
             if on_token:
                 on_token(self._mk_token(tid))
-        dt = time.monotonic() - t1
+        dt = now() - t1
         stats = {"ttft_s": ttft, "decode_tokens": len(out) - 1,
                  "decode_s": dt,
                  "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0,
                  "expert_offload": True}
+        _observe_generation(stats, len(out), path="offload")
         return out, stats
 
     def chat_generate(self, messages: list[dict], **kw):

@@ -27,7 +27,6 @@ program.
 from __future__ import annotations
 
 import functools
-import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Iterable
@@ -36,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...obs import (DECODE_TOKEN_SECONDS, GENERATED_TOKENS, RECORDER,
+                    TTFT_SECONDS, now)
 from ...ops.sampling import SamplingConfig, push_recent_token, sample
 from .cache import grow_cache, init_cache, kv_capacity
 from .config import ModelConfig
@@ -54,6 +55,17 @@ DECODE_HEADROOM = 16
 # decode the chain CAN overlap); shared so the worker warm sweep compiles
 # the exact chunk shapes the master will send
 PREFILL_CHUNK = 512
+
+
+def _observe_generation(stats: dict, n_out: int, path: str):
+    """Feed the canonical TTFT / per-token-decode histograms and token
+    counter from a completed generation's stats dict (shared by the local,
+    offloaded and distributed models — one call site shape, three paths)."""
+    TTFT_SECONDS.observe(stats["ttft_s"])
+    ntok = stats.get("decode_tokens") or 0
+    if ntok and stats.get("decode_s", 0) > 0:
+        DECODE_TOKEN_SECONDS.observe(stats["decode_s"] / ntok)
+    GENERATED_TOKENS.inc(n_out, path=path)
 
 
 def bucket_for(n: int, max_len: int) -> int:
@@ -366,14 +378,16 @@ class TextModel:
         kv_len = bucket_for(len(prompt_ids) + first_span, self.max_cache_len)
         cache = self.new_cache(1, kv_len=kv_len)
 
-        t0 = time.monotonic()
-        logits, cache = self._prefill_start(prompt_ids, cache)
+        t0 = now()
+        with RECORDER.span("prefill", cat="gen", tokens=len(prompt_ids)):
+            logits, cache = self._prefill_start(prompt_ids, cache)
         rng, sk = jax.random.split(rng)
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
-        first = sample(logits[0], sk, scfg, recent)
-        recent = push_recent_token(recent, first)
-        tid = int(first)                  # device sync: TTFT is honest
-        ttft = time.monotonic() - t0
+        with RECORDER.span("sample", cat="phase"):
+            first = sample(logits[0], sk, scfg, recent)
+            recent = push_recent_token(recent, first)
+            tid = int(first)              # device sync: TTFT is honest
+        ttft = now() - t0
 
         out: list[int] = [tid]
         tok_arr = first[None]
@@ -381,7 +395,7 @@ class TextModel:
             on_token(self._mk_token(tid))
         done = cfg.is_eos(tid)
 
-        t1 = time.monotonic()
+        t1 = now()
         pos = len(prompt_ids)            # next write position (first token)
         if not streaming:
             # while_loop decode in cache-bucket-sized segments: each segment
@@ -397,11 +411,13 @@ class TextModel:
                     cache = self._grow_to(cache, new_len=kv_len)
                     room = kv_len - pos - 1
                 n_seg = min(n_total - emitted, room)
-                packed, cache, rng, recent = self._decode_until(
-                    self.params, tok_arr, cache, rng, recent,
-                    jnp.asarray(n_seg, jnp.int32), scfg,
-                    bucket_for(n_seg, self.max_cache_len))
-                arr = np.asarray(packed)
+                with RECORDER.span("decode_segment", cat="gen",
+                                   tokens=n_seg, pos=pos):
+                    packed, cache, rng, recent = self._decode_until(
+                        self.params, tok_arr, cache, rng, recent,
+                        jnp.asarray(n_seg, jnp.int32), scfg,
+                        bucket_for(n_seg, self.max_cache_len))
+                    arr = np.asarray(packed)
                 count = int(arr[0])
                 seg = [int(t) for t in arr[1:1 + count]]
                 out.extend(seg)
@@ -431,15 +447,19 @@ class TextModel:
                     if pos + chunk > kv_len:
                         kv_len = bucket_for(pos + chunk, self.max_cache_len)
                         cache = self._grow_to(cache, new_len=kv_len)
-                    toks, cache, rng, recent = self._decode_chunk(
-                        self.params, tok_arr, cache, rng, recent, scfg, chunk)
+                    with RECORDER.span("decode_dispatch", cat="gen",
+                                       tokens=chunk, pos=pos):
+                        toks, cache, rng, recent = self._decode_chunk(
+                            self.params, tok_arr, cache, rng, recent, scfg,
+                            chunk)
                     tok_arr = toks[-1:]     # device-side chain, no fetch
                     pos += chunk
                     inflight.append(toks)
                     disp += 1
                 if not inflight:
                     break
-                toks_np = np.asarray(inflight.popleft())
+                with RECORDER.span("decode_wait", cat="gen"):
+                    toks_np = np.asarray(inflight.popleft())
                 for t in toks_np:
                     tid = int(t)
                     out.append(tid)
@@ -464,13 +484,14 @@ class TextModel:
                     out.append(int(t))
                     if on_token:
                         on_token(self._mk_token(int(t)))
-        dt = time.monotonic() - t1
+        dt = now() - t1
         stats = {
             "ttft_s": ttft,
             "decode_tokens": max(len(out) - 1, 0),
             "decode_s": dt,
             "tok_per_s": (len(out) - 1) / dt if dt > 0 and len(out) > 1 else 0.0,
         }
+        _observe_generation(stats, len(out), path="local")
         return out, stats
 
     def _prefill_start(self, prompt_ids, cache):

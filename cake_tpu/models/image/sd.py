@@ -436,7 +436,7 @@ class SDImageModel:
         directory (ref: sd.rs:526-529 intermediary_images). trace_dir wraps
         the whole generation in a JAX profiler trace (the TPU form of the
         reference's --sd-tracing chrome-trace, sd.rs:358-384)."""
-        from ...utils.tracing import jax_trace
+        from ...obs import jax_trace
         with jax_trace(trace_dir):
             return self._generate(prompt, width, height, steps, guidance,
                                   seed, negative_prompt, init_image,

@@ -1,0 +1,81 @@
+"""Observability subsystem: metrics registry, span recorder, request-id
+propagation, and the canonical serving instruments.
+
+One import gives a hot path everything it may record into:
+
+    from ..obs import RECORDER, TTFT_SECONDS, now
+    t0 = now()
+    with RECORDER.span("prefill", cat="gen"):
+        ...
+    TTFT_SECONDS.observe(now() - t0)
+
+Instruments are process-global: the API server's /metrics endpoint renders
+REGISTRY, and a trace export (RECORDER.export()) contains spans from every
+layer — model decode phases, cluster hops, API handlers, bench probes.
+"""
+from __future__ import annotations
+
+from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS,
+                      MetricsRegistry, REGISTRY)
+from .spans import (RECORDER, SpanRecorder, current_request_id, jax_trace,
+                    new_request_id, request_scope, set_request_id)
+from .timing import PhaseTimer, now
+
+# -- canonical serving instruments -------------------------------------------
+# Declared once here so every layer shares the same series; registration is
+# idempotent, so re-import order never matters.
+
+TTFT_SECONDS = REGISTRY.histogram(
+    "cake_ttft_seconds",
+    "Time to first token per generation (prefill + first sample + fetch)")
+
+DECODE_TOKEN_SECONDS = REGISTRY.histogram(
+    "cake_decode_token_seconds",
+    "Mean per-token decode latency per generation")
+
+GENERATED_TOKENS = REGISTRY.counter(
+    "cake_generated_tokens_total",
+    "Tokens emitted by completed generations",
+    labelnames=("path",))           # local | cluster | offload
+
+GENERATIONS = REGISTRY.counter(
+    "cake_generations_total",
+    "Completed generations by workload kind",
+    labelnames=("kind", "status"))  # text | image | audio; ok | error
+
+API_REQUESTS = REGISTRY.counter(
+    "cake_api_requests_total",
+    "HTTP requests served",
+    labelnames=("endpoint", "status"))
+
+API_REQUEST_SECONDS = REGISTRY.histogram(
+    "cake_api_request_seconds",
+    "HTTP request wall time",
+    labelnames=("endpoint",))
+
+WORKER_FWD_SECONDS = REGISTRY.histogram(
+    "cake_worker_forward_seconds",
+    "Worker-side forward compute time per request (includes any in-band "
+    "XLA compile)")
+
+HOP_SECONDS = REGISTRY.histogram(
+    "cake_cluster_hop_seconds",
+    "Master-observed remote-hop latency split by phase "
+    "(rtt | read | deser | fwd | ser | wire)",
+    labelnames=("worker", "phase"))
+
+WORKER_HEARTBEAT = REGISTRY.gauge(
+    "cake_worker_heartbeat_age_seconds",
+    "Seconds since the worker last handled any message, at the last "
+    "heartbeat tick (worker-process registry)",
+    labelnames=("worker",))
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "LATENCY_BUCKETS", "RECORDER", "SpanRecorder", "PhaseTimer", "now",
+    "jax_trace", "new_request_id", "set_request_id", "current_request_id",
+    "request_scope",
+    "TTFT_SECONDS", "DECODE_TOKEN_SECONDS", "GENERATED_TOKENS",
+    "GENERATIONS", "API_REQUESTS", "API_REQUEST_SECONDS",
+    "WORKER_FWD_SECONDS", "HOP_SECONDS", "WORKER_HEARTBEAT",
+]

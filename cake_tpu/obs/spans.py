@@ -1,0 +1,179 @@
+"""Span recorder: request-scoped phase spans with Chrome-trace export.
+
+The reference exports Chrome traces via tracing-chrome (ref: --sd-tracing,
+sd.rs:358-384) and logs per-token phase breakdowns (ref:
+text_model.rs:357-365); here both collapse into one recorder: hot paths
+record bounded complete ("X") events tagged with the current request id,
+and the buffer exports as Perfetto-loadable Chrome-trace JSON
+({"traceEvents": [...]}) on demand or into $CAKE_TRACE_DIR.
+
+The recorder is off by default — a disabled span() is one attribute check —
+and turns on explicitly (RECORDER.enable()) or via the CAKE_TRACE_DIR env
+var. Timestamps are monotonic microseconds (perf_counter_ns), so exported
+events always satisfy the Perfetto monotonic-ts requirement.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+
+# -- request-id propagation --------------------------------------------------
+
+_request_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "cake_request_id", default=None)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def set_request_id(rid: str | None):
+    _request_id.set(rid)
+
+
+def current_request_id() -> str | None:
+    return _request_id.get()
+
+
+@contextlib.contextmanager
+def request_scope(rid: str | None = None):
+    """Bind a request id for the duration of the block (generates one when
+    not given); spans recorded inside carry it in their args."""
+    rid = rid or new_request_id()
+    token = _request_id.set(rid)
+    try:
+        yield rid
+    finally:
+        _request_id.reset(token)
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class SpanRecorder:
+    """Bounded ring buffer of Chrome-trace complete events."""
+
+    def __init__(self, max_events: int | None = None, enabled: bool | None = None):
+        if max_events is None:
+            max_events = int(os.environ.get("CAKE_TRACE_EVENTS", "16384"))
+        self._events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._export_seq = 0
+        if enabled is None:
+            enabled = bool(os.environ.get("CAKE_TRACE_DIR"))
+        self.enabled = enabled
+
+    def enable(self):
+        self.enabled = True
+
+    def disable(self):
+        self.enabled = False
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    # -- recording -----------------------------------------------------------
+
+    def add(self, name: str, ts_us: int, dur_us: int, cat: str = "phase",
+            **args):
+        """Record a complete event from externally measured timestamps
+        (microseconds on the perf_counter clock)."""
+        if not self.enabled:
+            return
+        rid = _request_id.get()
+        if rid is not None:
+            args.setdefault("request_id", rid)
+        ev = {"name": name, "cat": cat, "ph": "X", "ts": int(ts_us),
+              "dur": max(int(dur_us), 0), "pid": os.getpid(),
+              "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "phase", **args):
+        """Record the wrapped block as one complete event. Disabled-path
+        cost is a single attribute check."""
+        if not self.enabled:
+            yield
+            return
+        t0 = _now_us()
+        try:
+            yield
+        finally:
+            self.add(name, t0, _now_us() - t0, cat=cat, **args)
+
+    def instant(self, name: str, cat: str = "mark", **args):
+        if not self.enabled:
+            return
+        rid = _request_id.get()
+        if rid is not None:
+            args.setdefault("request_id", rid)
+        ev = {"name": name, "cat": cat, "ph": "i", "ts": _now_us(), "s": "t",
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    # -- export --------------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def to_chrome_trace(self) -> dict:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def export(self, path: str | None = None) -> str:
+        """Write the buffer as Chrome-trace JSON (open in Perfetto /
+        chrome://tracing). Default path: $CAKE_TRACE_DIR/cake-trace-<pid>-<n>.json."""
+        if path is None:
+            trace_dir = os.environ.get("CAKE_TRACE_DIR") or "."
+            os.makedirs(trace_dir, exist_ok=True)
+            with self._lock:
+                self._export_seq += 1
+                seq = self._export_seq
+            path = os.path.join(trace_dir,
+                                f"cake-trace-{os.getpid()}-{seq}.json")
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+# process-global recorder: every layer (model decode, cluster hops, API,
+# bench probe) records into this one buffer so a single export shows the
+# whole request path
+RECORDER = SpanRecorder()
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: str | None):
+    """Wrap a region in a JAX profiler trace (xprof / Perfetto viewable).
+    No-op when log_dir is None. Device-side complement to SpanRecorder's
+    host-side spans (ref: tracing-chrome behind --sd-tracing)."""
+    if not log_dir:
+        yield
+        return
+    import logging
+
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logging.getLogger("cake_tpu.obs").info(
+            "profiler trace written to %s", log_dir)

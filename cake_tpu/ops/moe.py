@@ -34,11 +34,20 @@ import jax.numpy as jnp
 RAGGED_MIN_TOKENS = 32
 
 
+def _ragged_available() -> bool:
+    """lax.ragged_dot_general landed in newer jax releases; on older ones
+    the dense combine serves every shape (same math, more FLOPs)."""
+    import jax.lax
+    return hasattr(jax.lax, "ragged_dot_general")
+
+
 def _ragged_enabled() -> bool:
     """CAKE_MOE_RAGGED=0 pins every shape to the dense combine (escape
-    hatch if a backend mishandles ragged_dot_general)."""
+    hatch if a backend mishandles ragged_dot_general); also gated on the
+    installed jax actually providing ragged_dot_general."""
     import os
-    return os.environ.get("CAKE_MOE_RAGGED", "1") != "0"
+    return (os.environ.get("CAKE_MOE_RAGGED", "1") != "0"
+            and _ragged_available())
 
 
 def router_topk(logits, k: int, norm_topk_prob: bool, gate_act: str = "softmax"):

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Hot-path timing lint: fail if ad-hoc wall-clock calls reappear in
+serving hot paths outside cake_tpu/obs/.
+
+The observability subsystem (cake_tpu/obs) is the single owner of
+wall-clock deltas on hot paths: stats code uses obs.now(), phase
+accounting uses obs.PhaseTimer / RECORDER.span. Before it existed, three
+ad-hoc idioms (time.monotonic deltas in master/worker, PhaseTimer in
+utils.tracing, fwd_ms plumbing) drifted apart; this check keeps new ones
+from creeping back in. Run via `make obs-smoke` or directly.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# serving hot paths: every per-token / per-message code path. cli/tui/
+# image pipelines and discovery keep plain time.* — they are not hot.
+HOT_PATHS = [
+    "cake_tpu/models/common/text_model.py",
+    "cake_tpu/models/common/offload_model.py",
+    "cake_tpu/cluster/master.py",
+    "cake_tpu/cluster/worker.py",
+    "cake_tpu/cluster/client.py",
+    "cake_tpu/cluster/proto.py",
+    "cake_tpu/api/state.py",
+]
+
+BANNED = ("time.monotonic(", "time.time(", "time.perf_counter(")
+
+
+def main() -> int:
+    bad = []
+    for rel in HOT_PATHS:
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path):
+            print(f"[check_hot_timing] warning: {rel} missing", file=sys.stderr)
+            continue
+        with open(path) as f:
+            for i, line in enumerate(f, 1):
+                if any(tok in line for tok in BANNED):
+                    bad.append(f"{rel}:{i}: {line.strip()}")
+    if bad:
+        print("ad-hoc wall-clock calls on hot paths — route them through "
+              "cake_tpu.obs (now() / PhaseTimer / RECORDER.span):",
+              file=sys.stderr)
+        for b in bad:
+            print("  " + b, file=sys.stderr)
+        return 1
+    print(f"[check_hot_timing] ok: {len(HOT_PATHS)} hot-path files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Observability smoke: a tiny CPU generation with tracing enabled must
+yield (a) Prometheus text with non-zero TTFT/decode histograms, (b) a
+Chrome-trace JSON that round-trips through json.loads with generation
+spans. Exits non-zero on any missing signal. Run via `make obs-smoke`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from cake_tpu import obs                                   # noqa: E402
+from cake_tpu.models import (SamplingConfig, TextModel,    # noqa: E402
+                             tiny_config)
+
+
+def main() -> int:
+    obs.RECORDER.enable()
+    obs.RECORDER.clear()
+    model = TextModel(tiny_config("qwen3"), max_cache_len=128)
+    with obs.request_scope() as rid:
+        toks, stats = model.generate([1, 2, 3, 4], max_new_tokens=8,
+                                     sampling=SamplingConfig(temperature=0.0))
+    assert toks, "generation produced no tokens"
+
+    text = obs.REGISTRY.render()
+    for needle in ("cake_ttft_seconds_count", "cake_decode_token_seconds_sum",
+                   "cake_generated_tokens_total"):
+        assert needle in text, f"/metrics missing {needle}"
+    assert obs.TTFT_SECONDS.count() >= 1, "TTFT histogram empty"
+    assert obs.DECODE_TOKEN_SECONDS.count() >= 1, "decode histogram empty"
+
+    path = obs.RECORDER.export(
+        os.path.join(tempfile.mkdtemp(prefix="cake-obs-"), "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert events, "trace export is empty"
+    names = {e["name"] for e in events}
+    assert "prefill" in names and "sample" in names, names
+    assert all(e["args"]["request_id"] == rid
+               for e in events if "args" in e
+               and "request_id" in e["args"]), "request id not propagated"
+
+    print(json.dumps({"obs_smoke": "ok", "tokens": len(toks),
+                      "ttft_s": round(stats["ttft_s"], 4),
+                      "trace_events": len(events), "trace_path": path}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""Unit tests for the observability subsystem: metrics registry semantics,
+Prometheus text exposition, span recorder / Chrome-trace export, request-id
+propagation, PhaseTimer, and the cluster client's RTT phase splits."""
+import json
+import threading
+
+import pytest
+
+from cake_tpu.obs import (PhaseTimer, REGISTRY, MetricsRegistry,
+                          SpanRecorder, current_request_id, request_scope)
+from cake_tpu.obs.metrics import _fmt
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "help", labelnames=("k",))
+    c.inc(k="a")
+    c.inc(2, k="a")
+    c.inc(k="b")
+    assert c.value(k="a") == 3
+    assert c.value(k="b") == 1
+    assert c.value(k="missing") == 0
+    with pytest.raises(ValueError):
+        c.inc(-1, k="a")                 # counters only go up
+    with pytest.raises(ValueError):
+        c.inc(wrong="a")                 # undeclared label
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("g")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+
+
+def test_histogram_semantics():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count() == 3
+    assert h.sum() == pytest.approx(5.55)
+    text = reg.render()
+    # cumulative buckets: 1 <= 0.1, 2 <= 1.0, 3 <= +Inf
+    assert 'h_seconds_bucket{le="0.1"} 1' in text
+    assert 'h_seconds_bucket{le="1"} 2' in text
+    assert 'h_seconds_bucket{le="+Inf"} 3' in text
+    assert "h_seconds_count 3" in text
+
+
+def test_registry_idempotent_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", labelnames=("k",))
+    assert reg.counter("x_total", labelnames=("k",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")             # type conflict
+    with pytest.raises(ValueError):
+        reg.counter("x_total")           # label conflict
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", 'count of "requests"', labelnames=("p",))
+    c.inc(p='va"l\\ue')
+    text = reg.render()
+    lines = text.splitlines()
+    assert '# HELP req_total count of \\"requests\\"' in lines
+    assert "# TYPE req_total counter" in lines
+    assert 'req_total{p="va\\"l\\\\ue"} 1' in lines
+    assert text.endswith("\n")
+    # integers render without a trailing .0; floats keep precision
+    assert _fmt(3.0) == "3"
+    assert _fmt(0.25) == "0.25"
+
+
+def test_registry_reset_keeps_registrations():
+    reg = MetricsRegistry()
+    c = reg.counter("y_total")
+    c.inc()
+    reg.reset()
+    assert c.value() == 0
+    c.inc()                              # same handle still live
+    assert c.value() == 1
+
+
+def test_global_registry_has_canonical_series():
+    text = REGISTRY.render()
+    for name in ("cake_ttft_seconds", "cake_decode_token_seconds",
+                 "cake_api_requests_total", "cake_cluster_hop_seconds"):
+        assert f"# TYPE {name}" in text
+
+
+# -- spans ------------------------------------------------------------------
+
+def test_span_recorder_chrome_trace_roundtrip():
+    rec = SpanRecorder(enabled=True)
+    with rec.span("prefill", cat="gen", tokens=4):
+        with rec.span("embed"):
+            pass
+    rec.instant("mark")
+    blob = json.dumps(rec.to_chrome_trace())
+    data = json.loads(blob)              # must round-trip
+    evs = data["traceEvents"]
+    assert len(evs) == 3
+    x_events = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in x_events} == {"prefill", "embed"}
+    for e in x_events:
+        assert e["dur"] >= 0 and isinstance(e["ts"], int)
+    # child span completes (and is appended) before its parent
+    embed, prefill = x_events[0], x_events[1]
+    assert embed["name"] == "embed"
+    assert prefill["ts"] <= embed["ts"]
+    assert prefill["ts"] + prefill["dur"] >= embed["ts"] + embed["dur"]
+
+
+def test_span_recorder_monotonic_ts_and_bound():
+    rec = SpanRecorder(max_events=8, enabled=True)
+    for i in range(20):
+        with rec.span(f"s{i}"):
+            pass
+    evs = rec.events()
+    assert len(evs) == 8                 # ring buffer bound
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)              # monotonic clock
+
+
+def test_span_recorder_disabled_records_nothing():
+    rec = SpanRecorder(enabled=False)
+    with rec.span("x"):
+        pass
+    rec.add("y", 0, 1)
+    assert len(rec) == 0
+
+
+def test_span_export_writes_loadable_json(tmp_path):
+    rec = SpanRecorder(enabled=True)
+    with rec.span("a"):
+        pass
+    path = rec.export(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        data = json.load(f)
+    assert data["traceEvents"][0]["name"] == "a"
+
+
+def test_request_id_propagation_into_threads():
+    rec = SpanRecorder(enabled=True)
+    seen = {}
+
+    with request_scope() as rid:
+        assert current_request_id() == rid
+        with rec.span("in_scope"):
+            pass
+
+        import contextvars
+        ctx = contextvars.copy_context()
+
+        def worker():
+            seen["rid"] = ctx.run(current_request_id)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["rid"] == rid
+    assert current_request_id() is None   # scope restored
+    assert rec.events()[0]["args"]["request_id"] == rid
+
+
+# -- PhaseTimer -------------------------------------------------------------
+
+def test_phase_timer_accumulates_and_emits_spans():
+    rec = SpanRecorder(enabled=True)
+    t = PhaseTimer(recorder=rec)
+    for _ in range(3):
+        with t("fwd"):
+            pass
+    t.add("read", 0.5)
+    rep = t.report()
+    assert rep["fwd"]["count"] == 3
+    assert rep["read"]["total_ms"] == 500.0
+    assert "fwd=" in str(t) and "read=" in str(t)
+    # every accumulated phase also landed in the recorder
+    names = [e["name"] for e in rec.events()]
+    assert names.count("fwd") == 3 and names.count("read") == 1
+    t.reset()
+    assert t.report() == {}
+
+
+# -- cluster client phase splits --------------------------------------------
+
+def test_rtt_stats_phase_splits():
+    from cake_tpu.cluster.client import RemoteStage
+    rs = RemoteStage("127.0.0.1", 0, "k", name="w0")   # no connect
+    for _ in range(10):
+        rs.rtts.append((0.010, {"read_ms": 1.0, "deser_ms": 1.0,
+                                "fwd_ms": 4.0, "ser_ms": 1.0}))
+    st = rs.rtt_stats()
+    assert st["count"] == 10
+    assert st["p50_ms"] == 10.0
+    assert st["fwd_p50_ms"] == 4.0
+    assert st["read_p50_ms"] == 1.0
+    assert st["ser_p50_ms"] == 1.0
+    # wire = rtt - (read + deser + fwd + ser) = 10 - 7 = 3 ms
+    assert st["wire_p50_ms"] == pytest.approx(3.0)
+
+
+def test_rtt_stats_pre_echo_workers():
+    """A worker that only sends top-level fwd_ms (no tm dict) still splits
+    fwd/wire; one that sends nothing contributes to the raw RTT only."""
+    from cake_tpu.cluster.client import RemoteStage
+    rs = RemoteStage("127.0.0.1", 0, "k", name="w0")
+    rs.rtts.append((0.010, {"fwd_ms": 6.0}))
+    rs.rtts.append((0.020, {}))
+    st = rs.rtt_stats()
+    assert st["count"] == 2
+    assert st["fwd_p50_ms"] == 6.0
+    assert st["wire_p50_ms"] == pytest.approx(4.0)
+    assert "read_p50_ms" not in st
+
+
+def test_worker_info_heartbeat_fields():
+    from cake_tpu.cluster import proto
+    msg = proto.worker_info("w0", [0, 1], "cpu", "cpu", 1 << 30, 1.0,
+                            heartbeat_age_s=1.23456, ops=7)
+    assert msg["heartbeat_age_s"] == 1.235
+    assert msg["ops"] == 7
+    legacy = proto.worker_info("w0", [0, 1], "cpu", "cpu", 1 << 30, 1.0)
+    assert "heartbeat_age_s" not in legacy
+
+
+def test_tensor_result_timing_echo():
+    import numpy as np
+    from cake_tpu.cluster import proto
+    arr = np.ones((1, 2), np.float32)
+    tm = {"read_ms": 0.5, "deser_ms": 0.25, "fwd_ms": 3.0, "ser_ms": 0.125}
+    msg = proto.tensor_result(arr, 3, fwd_ms=3.0, timing=tm)
+    assert msg["tm"] == tm and msg["fwd_ms"] == 3.0 and msg["rid"] == 3
+    assert (proto.unpack_tensor(msg["x"]) == arr).all()
+    # pre-packed tensors pass through without re-packing
+    packed = proto.pack_tensor(arr)
+    msg2 = proto.tensor_result(packed, 4)
+    assert msg2["x"] is packed and "tm" not in msg2

@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test bench bench-micro obs-smoke native clean docker
+.PHONY: install test bench bench-micro obs-smoke serve-smoke native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -24,6 +24,12 @@ bench-micro:
 obs-smoke:
 	python scripts/check_hot_timing.py
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# continuous-batching gate: concurrent chats 200 through the engine, a 429
+# + Retry-After under queue saturation, and non-zero serve-queue gauges in
+# /metrics while saturated (tiny CPU model, in-process aiohttp)
+serve-smoke:
+	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

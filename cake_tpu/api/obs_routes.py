@@ -26,6 +26,11 @@ from .state import ApiState
 # server just because no one is generating)
 STALE_WORKER_S = 120.0
 
+# serve engine with pending work but no completed scheduler iteration for
+# this long reports wedged (must exceed any single in-iteration XLA
+# compile — the first decode of each slot-count bucket compiles in-line)
+ENGINE_WEDGED_S = 120.0
+
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
@@ -105,12 +110,28 @@ async def health(request: web.Request) -> web.Response:
     state: ApiState = request.app["state"]
     workers = worker_health(state.model)
     stale = [w["name"] for w in workers if w["failing"]]
+    degraded = bool(stale)
     body = {
-        "status": "degraded" if stale else "ok",
         "uptime_s": max(int(time.time()) - state.created, 0),
         "models": [m["id"] + ":" + m["kind"] for m in state.owned_models()],
         "workers": workers,
         "stale_workers": stale,
         "device": _device_health(),
     }
-    return web.json_response(body, status=200 if not stale else 503)
+    engine = getattr(state, "engine", None)
+    if engine is not None:
+        # continuous-batching engine liveness: a dead scheduler thread, or
+        # one that has work (busy slots / queued requests) but hasn't
+        # iterated recently, means chat requests will hang — degrade.
+        # The threshold sits far above a per-bucket XLA compile (a first
+        # batched-decode compile happens IN-iteration, and a liveness
+        # probe must not restart a server that is merely warming up).
+        einfo = engine.health()
+        busy = einfo["slots_busy"] or einfo["queue_depth"]
+        einfo["wedged"] = bool(busy and einfo["last_step_age_s"]
+                               > ENGINE_WEDGED_S)
+        if not einfo["alive"] or einfo["wedged"]:
+            degraded = True
+        body["engine"] = einfo
+    body["status"] = "degraded" if degraded else "ok"
+    return web.json_response(body, status=503 if degraded else 200)

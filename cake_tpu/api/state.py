@@ -1,14 +1,18 @@
-"""Shared API state: one model, one inference at a time.
+"""Shared API state.
 
 The reference serializes requests through Arc<RwLock<Master>> (ref:
-api/mod.rs:71 — single shared master, one inference at a time); here an
+api/mod.rs:71 — single shared master, one inference at a time). Here that
+locked path survives as the FALLBACK for distributed/offload models: an
 asyncio.Lock guards the generator and generation runs in a worker thread so
-the event loop keeps streaming SSE chunks while the TPU decodes.
+the event loop keeps streaming SSE chunks while the TPU decodes. Plain
+TextModels instead serve concurrently through `engine` (cake_tpu/serve/),
+which batches all active requests into one decode step per token.
 """
 from __future__ import annotations
 
 import asyncio
 import contextvars
+import functools
 import threading
 import queue as queue_mod
 from dataclasses import dataclass, field
@@ -32,10 +36,14 @@ class ApiState:
     sd_trace_dir: str | None = None
     layer_tensors: dict | None = None   # per-layer tensor detail for the UI
     # last generation's timing/stats snapshot for /api/v1/stats (ttft,
-    # tok/s, per-hop RTT wire/fwd split, prefill pipelining) — written
-    # under `lock`, so readers see a consistent dict
+    # tok/s, per-hop RTT wire/fwd split, prefill pipelining). The locked
+    # path writes it under `lock`; the engine path replaces it lock-free —
+    # always assign a FRESH dict wholesale, never mutate in place
     last_stats: dict | None = None
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # continuous-batching engine (cake_tpu/serve/) — set for plain
+    # TextModels; None keeps every request on the locked fallback path
+    engine: Any = None
     created: int = 0
 
     def owned_models(self) -> list[dict]:
@@ -79,24 +87,43 @@ async def run_generation_blocking(model, messages_or_ids, gen_kwargs: dict):
         lambda: _call_generate(model, messages_or_ids, gen_kwargs))
 
 
+class GenerationCancelled(Exception):
+    """Raised inside the generation worker to abort a cancelled stream."""
+
+
 def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):
     """Run model generation in a thread; yield Token objects as they arrive.
 
-    Returns (async iterator, join function). Mirrors the reference's
-    mpsc-channel SSE bridge (ref: api/text.rs generate_text_stream).
+    Returns (async iterator, result dict, cancel event). Mirrors the
+    reference's mpsc-channel SSE bridge (ref: api/text.rs
+    generate_text_stream), with two disconnect safeguards:
+
+      * the queue reader polls with a timeout instead of a bare blocking
+        q.get — an abandoned stream never parks an executor thread forever;
+      * setting the cancel event (done automatically when the iterator is
+        finalized, e.g. the client disconnected mid-stream) aborts the
+        worker at its next token instead of decoding to the budget.
     """
     q: queue_mod.Queue = queue_mod.Queue()
     DONE = object()
     result: dict = {}
+    cancel = threading.Event()
     # carry the handler's context (request id) into the generation thread
     ctx = contextvars.copy_context()
+
+    def emit(tok):
+        if cancel.is_set():
+            raise GenerationCancelled()
+        q.put(tok)
 
     def worker():
         try:
             toks, stats = ctx.run(_call_generate, model, messages_or_ids,
-                                  gen_kwargs, on_token=q.put)
+                                  gen_kwargs, on_token=emit)
             result["tokens"] = toks
             result["stats"] = stats
+        except GenerationCancelled:
+            result["cancelled"] = True
         except Exception as e:  # surfaced to the stream consumer
             result["error"] = e
         finally:
@@ -107,13 +134,24 @@ def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):
 
     async def aiter():
         loop = asyncio.get_running_loop()
-        while True:
-            item = await loop.run_in_executor(None, q.get)
-            if item is DONE:
-                break
-            yield item
+        try:
+            while True:
+                try:
+                    item = await loop.run_in_executor(
+                        None, functools.partial(q.get, timeout=0.5))
+                except queue_mod.Empty:
+                    if not t.is_alive() and q.empty():
+                        break       # worker died without its sentinel
+                    continue
+                if item is DONE:
+                    break
+                yield item
+        finally:
+            # normal exhaustion OR abandonment (client gone): stop the
+            # worker so the next request isn't stuck behind a dead stream
+            cancel.set()
         t.join(timeout=5)
         if "error" in result:
             raise result["error"]
 
-    return aiter(), result
+    return aiter(), result, cancel

@@ -1,8 +1,17 @@
 """OpenAI-compatible chat completions: blocking JSON + SSE streaming
 (ref: cake-core/src/cake/sharding/api/text.rs:101-230 — usage accounting,
-finish_reason, stream chunks)."""
+finish_reason, stream chunks).
+
+Two execution paths share the response assembly:
+  * engine (state.engine, plain TextModels): requests are submitted to the
+    continuous-batching scheduler and decode CONCURRENTLY — a full
+    admission queue is a 429 + Retry-After, not an unbounded wait;
+  * locked fallback (distributed/offload models): the inherited
+    one-inference-at-a-time asyncio.Lock.
+"""
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 import uuid
@@ -11,7 +20,8 @@ from aiohttp import web
 
 from ..obs import GENERATIONS, current_request_id, set_request_id
 from ..ops.sampling import SamplingConfig
-from .state import (ApiState, run_generation_blocking,
+from ..serve import QueueFull
+from .state import (ApiState, run_blocking, run_generation_blocking,
                     run_generation_streamed)
 
 
@@ -86,6 +96,9 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     except (TypeError, ValueError) as e:
         return web.json_response({"error": f"invalid sampling params: {e}"},
                                  status=400)
+    if state.engine is not None:
+        return await _chat_engine(request, state, messages, gen_kwargs,
+                                  stream=bool(body.get("stream")))
     if body.get("stream"):
         return await _chat_stream(request, state, messages, gen_kwargs)
     return await _chat_blocking(request, state, messages, gen_kwargs)
@@ -137,24 +150,11 @@ def _stats_snapshot(stats: dict) -> dict:
     return out
 
 
-async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
-    cid = _completion_id()
-    # the completion id doubles as the request id: spans recorded during
-    # this request's generation (model phases, cluster hops) carry it, so
-    # a trace export is joinable with API logs/responses
-    set_request_id(cid)
-    async with state.lock:                  # one inference at a time
-        try:
-            toks, stats = await run_generation_blocking(state.model, messages,
-                                                        gen_kwargs)
-            state.last_stats = _stats_snapshot(stats)
-        except Exception as e:
-            GENERATIONS.inc(kind="text", status="error")
-            return web.json_response({"error": f"generation failed: {e}"},
-                                     status=500)
-    GENERATIONS.inc(kind="text", status="ok")
+def _completion_json(state: ApiState, cid: str, toks: list[int],
+                     stats: dict, n_in: int) -> web.Response:
+    """Assemble the blocking chat.completion body — shared by the engine
+    and locked paths so usage accounting/finish_reason cannot diverge."""
     n_out = len(toks)
-    n_in = _prompt_token_count(state, messages)
     ended = bool(toks) and state.model.cfg.is_eos(toks[-1])
     finish = "stop" if ended else "length"
     content_ids = toks[:-1] if ended else toks
@@ -179,15 +179,119 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
     })
 
 
-async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
+async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
+    cid = _completion_id()
+    # the completion id doubles as the request id: spans recorded during
+    # this request's generation (model phases, cluster hops) carry it, so
+    # a trace export is joinable with API logs/responses
+    set_request_id(cid)
+    async with state.lock:                  # one inference at a time
+        try:
+            toks, stats = await run_generation_blocking(state.model, messages,
+                                                        gen_kwargs)
+            state.last_stats = _stats_snapshot(stats)
+        except Exception as e:
+            GENERATIONS.inc(kind="text", status="error")
+            return web.json_response({"error": f"generation failed: {e}"},
+                                     status=500)
+    GENERATIONS.inc(kind="text", status="ok")
+    return _completion_json(state, cid, toks, stats,
+                            _prompt_token_count(state, messages))
+
+
+# -- continuous-batching path (state.engine) ---------------------------------
+
+
+async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
+                       stream: bool):
+    """Submit to the serve engine: concurrent decode, bounded queue."""
+    from ..models.common.text_model import chat_prompt_ids
+    cid = _completion_id()
+    set_request_id(cid)
+    tokenizer = state.tokenizer or getattr(state.model, "tokenizer", None)
+    try:
+        prompt_ids = await run_blocking(
+            lambda: chat_prompt_ids(tokenizer, messages))
+    except Exception as e:
+        return web.json_response({"error": f"chat template failed: {e}"},
+                                 status=400)
+    try:
+        req = state.engine.submit(prompt_ids,
+                                  max_new_tokens=gen_kwargs["max_new_tokens"],
+                                  sampling=gen_kwargs["sampling"],
+                                  request_id=cid)
+    except QueueFull as e:
+        # backpressure is a first-class answer: shed load instead of
+        # queueing unboundedly behind a bounded slot pool
+        return web.json_response(
+            {"error": "server overloaded: admission queue full"},
+            status=429, headers={"Retry-After": str(e.retry_after_s)})
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except RuntimeError as e:               # engine dead
+        return web.json_response({"error": str(e)}, status=503)
+    if stream:
+        aiter, result = state.engine.stream(req)
+        return await _sse_drain(request, state, cid, aiter, result,
+                                req.cancel)
+    # await completion via a done callback -> future: no executor thread
+    # is parked per in-flight request (the default executor also serves
+    # tokenization and every other endpoint — parking one thread per
+    # generation would starve the server at exactly this concurrency)
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+
+    def _on_done():
+        try:
+            loop.call_soon_threadsafe(
+                lambda: None if fut.done() else fut.set_result(None))
+        except RuntimeError:
+            pass                            # loop already closed
+    req.add_done_callback(_on_done)
+    try:
+        await fut
+    except asyncio.CancelledError:
+        req.cancel()                        # client gone: free the slot
+        raise
+    if "error" in req.result:
+        GENERATIONS.inc(kind="text", status="error")
+        return web.json_response(
+            {"error": f"generation failed: {req.result['error']}"},
+            status=500)
+    GENERATIONS.inc(kind="text", status="ok")
+    stats = req.result.get("stats", {})
+    state.last_stats = _stats_snapshot(stats)
+    return _completion_json(state, cid, req.result.get("tokens", []), stats,
+                            len(prompt_ids))
+
+
+async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
+                     cancel) -> web.StreamResponse:
+    """Drain a token stream into SSE chunks — shared by the engine and
+    locked paths. `cancel` is a thunk that aborts the producer; it fires
+    when the client disconnects mid-stream so the generation (and, on the
+    engine path, its KV slot) is reclaimed instead of decoding on."""
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
         "Connection": "keep-alive",
     })
+    try:
+        return await _sse_drain_inner(request, state, cid, aiter, result,
+                                      cancel, resp)
+    except BaseException:
+        # disconnect/cancellation BEFORE the token loop starts would skip
+        # the iterator's finalizer (an async generator that was never
+        # started runs no finally) — cancel here so an abandoned stream
+        # can never leak its generation/slot for the full budget
+        cancel()
+        raise
+
+
+async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
+                           result: dict, cancel,
+                           resp: web.StreamResponse) -> web.StreamResponse:
     await resp.prepare(request)
-    cid = _completion_id()
-    set_request_id(cid)         # spans from this generation carry the cid
     created = int(time.time())
 
     def chunk(delta: dict, finish=None) -> bytes:
@@ -203,8 +307,9 @@ async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
     client_gone = False
 
     async def write_safe(data: bytes) -> None:
-        # a disconnected client must not abort the drain below — note it
-        # and keep consuming so the worker thread/queue reader wind down
+        # a disconnected client must not abort the drain below — note it,
+        # stop the producer, and keep consuming to the DONE sentinel so
+        # the worker/slot winds down cleanly
         nonlocal client_gone
         if client_gone:
             return
@@ -212,34 +317,42 @@ async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
             await resp.write(data)
         except (ConnectionError, ConnectionResetError):
             client_gone = True
-
-    async with state.lock:
-        aiter, result = run_generation_streamed(state.model, messages,
-                                                gen_kwargs)
-        try:
-            # drain to the DONE sentinel even past EOS: breaking out would
-            # abandon the queue reader (pending executor q.get, skipped
-            # join) and drop a worker error raised after the EOS token
-            async for tok in aiter:
-                if tok.is_end_of_stream:
-                    finish = "stop"
-                    continue
-                if finish == "length" and tok.text:
-                    await write_safe(chunk({"content": tok.text}))
-        except Exception as e:
-            # mid-stream generation failure: still close the SSE stream
-            # with a final chunk + [DONE] so clients don't hang
-            await write_safe(chunk({"content": f"\n[error: {e}]"}))
-            finish = "error"
-        GENERATIONS.inc(kind="text",
-                        status="error" if finish == "error" else "ok")
-        if "stats" in result:
-            state.last_stats = _stats_snapshot(result["stats"])
+            cancel()
+    try:
+        # drain to the DONE sentinel even past EOS: breaking out would
+        # abandon pending tokens and drop a worker error raised after the
+        # EOS token (the iterator's own finalizer also cancels, covering
+        # hard disconnects that cancel this handler task outright)
+        async for tok in aiter:
+            if tok.is_end_of_stream:
+                finish = "stop"
+                continue
+            if finish == "length" and tok.text:
+                await write_safe(chunk({"content": tok.text}))
+    except Exception as e:
+        # mid-stream generation failure: still close the SSE stream
+        # with a final chunk + [DONE] so clients don't hang
+        await write_safe(chunk({"content": f"\n[error: {e}]"}))
+        finish = "error"
+    GENERATIONS.inc(kind="text",
+                    status="error" if finish == "error" else "ok")
+    if "stats" in result:
+        state.last_stats = _stats_snapshot(result["stats"])
     await write_safe(chunk({}, finish=finish))
     await write_safe(b"data: [DONE]\n\n")
     if not client_gone:
         await resp.write_eof()
     return resp
+
+
+async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
+    cid = _completion_id()
+    set_request_id(cid)         # spans from this generation carry the cid
+    async with state.lock:      # locked fallback: one inference at a time
+        aiter, result, cancel = run_generation_streamed(state.model, messages,
+                                                        gen_kwargs)
+        return await _sse_drain(request, state, cid, aiter, result,
+                                cancel.set)
 
 
 async def list_models(request: web.Request) -> web.Response:

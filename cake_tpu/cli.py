@@ -203,6 +203,16 @@ def cmd_serve(args) -> int:
                      layer_tensors=layer_tensors,
                      sd_intermediate_every=args.sd_intermediate_every,
                      sd_trace_dir=args.sd_trace_dir)
+    # continuous batching for plain local TextModels (CAKE_SERVE_SLOTS
+    # slots, CAKE_MAX_QUEUE admission bound, CAKE_SERVE_CTX per-slot
+    # context; CAKE_SERVE_SLOTS=0 disables). Distributed/offload models
+    # return None here and keep the locked one-at-a-time path.
+    from .serve import maybe_engine
+    state.engine = maybe_engine(gen)
+    if state.engine is not None:
+        print(f"[serve engine: {state.engine.slots} slots x "
+              f"{state.engine.ctx} ctx, queue {state.engine.queue.maxsize}]",
+              file=sys.stderr)
     serve(state, host=args.host, port=args.port, basic_auth=args.basic_auth)
     return 0
 

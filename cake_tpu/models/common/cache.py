@@ -157,6 +157,58 @@ def grow_cache(cfg: ModelConfig, cache: dict, new_len: int,
     return {"layers": new_layers, "pos": cache["pos"]}
 
 
+def slot_reset_layers(layers: list[dict], slot) -> list[dict]:
+    """Clear row `slot` of a batched cache pool (positions -> -1, state ->
+    zeros) without touching the other rows or reallocating — the
+    continuous-batching engine's per-request release. `slot` may be a
+    traced scalar, so one jitted program serves every slot index."""
+    out = []
+    for lc in layers:
+        o = {}
+        for name, buf in lc.items():
+            if name == "pos":
+                o[name] = buf.at[slot].set(jnp.full(buf.shape[1:], -1,
+                                                    buf.dtype))
+            else:
+                o[name] = buf.at[slot].set(jnp.zeros(buf.shape[1:], buf.dtype))
+        out.append(o)
+    return out
+
+
+def slot_assign_layers(cfg: ModelConfig, pool_layers: list[dict],
+                       src_layers: list[dict], slot,
+                       layer_range: tuple[int, int] | None = None) -> list[dict]:
+    """Write a batch-1 cache (a fresh request's bucketed prefill) into row
+    `slot` of the batched pool, replacing whatever the row held.
+
+    Entries are re-homed at position % row_size — the same remap
+    grow_layer_kv uses — so a prompt prefilled into a small-bucket cache
+    lands correctly in the pool's larger full-attention buffers and
+    sliding-window rings (the pool ring is never smaller than the source
+    ring, so the scatter stays injective). Linear-attention conv/recurrent
+    state copies through row-wise. `slot` may be a traced scalar.
+    """
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    out = []
+    for i, pl, sl in zip(range(lo, hi), pool_layers, src_layers):
+        if cfg.layer_spec(i).kind == "linear":
+            out.append({"conv": pl["conv"].at[slot].set(sl["conv"][0]),
+                        "state": pl["state"].at[slot].set(sl["state"][0])})
+            continue
+        size = pl["k"].shape[1]
+        pos = sl["pos"][0]                                 # [src_size]
+        slots = jnp.where(pos >= 0, pos % size, size)      # OOB -> dropped
+        k = jnp.zeros((size,) + pl["k"].shape[2:], pl["k"].dtype)
+        v = jnp.zeros((size,) + pl["v"].shape[2:], pl["v"].dtype)
+        p = jnp.full((size,), -1, jnp.int32)
+        out.append({
+            "k": pl["k"].at[slot].set(k.at[slots].set(sl["k"][0], mode="drop")),
+            "v": pl["v"].at[slot].set(v.at[slots].set(sl["v"][0], mode="drop")),
+            "pos": pl["pos"].at[slot].set(p.at[slots].set(pos, mode="drop")),
+        })
+    return out
+
+
 def cache_reset(cache: dict) -> dict:
     """Clear all state (ref: cache clear on Goodbye, worker.rs:364-384)."""
     def zero_layer(lc):

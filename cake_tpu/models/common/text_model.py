@@ -37,8 +37,10 @@ import numpy as np
 
 from ...obs import (DECODE_TOKEN_SECONDS, GENERATED_TOKENS, RECORDER,
                     TTFT_SECONDS, now)
-from ...ops.sampling import SamplingConfig, push_recent_token, sample
-from .cache import grow_cache, init_cache, kv_capacity
+from ...ops.sampling import (SamplingConfig, push_recent_token, sample,
+                             sample_traced)
+from .cache import (grow_cache, init_cache, kv_capacity, slot_assign_layers,
+                    slot_reset_layers)
 from .config import ModelConfig
 from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
 
@@ -285,7 +287,78 @@ class TextModel:
         def _grow(cache, new_len):
             return grow_cache(cfg, cache, new_len)
 
+        @functools.partial(jax.jit, static_argnames=("nb",),
+                           donate_argnums=(1, 2, 3, 4, 5))
+        def _decode_slots(params, layers, toks, pos, rngs, recents,
+                          temps, top_ks, top_ps, penalties, nb):
+            """One batched sampled decode step over pool rows 0..nb-1 with
+            per-slot positions, RNG keys, recent-token windows and TRACED
+            sampling params (sample_traced): the continuous-batching
+            engine's iteration unit. nb is the only static argument — one
+            executable per slot-count bucket (serve.slots.slot_bucket:
+            powers of two up to the pool size), so the serve path adds
+            O(log slots) programs total and a mixed bag of
+            client sampling configs cannot grow the compile cache (the
+            api/text.py quantization grid stays the only bound on the
+            legacy static-SamplingConfig programs).
+
+            The per-slot step is the SAME embed -> layers -> head ->
+            sample pipeline as sampled_step, vmapped over the slot axis:
+            rows are independent, so a free slot in the prefix decodes
+            harmless garbage confined to its own row (wiped by
+            slot_assign on the next admission)."""
+            def one(tok, lcs, p, rng, recent, temp, tk, tp, pen):
+                cache = {"layers": jax.tree_util.tree_map(
+                    lambda a: a[None], lcs), "pos": p}
+                x = embed_tokens(cfg, params, tok[None, None])
+                x, cache = forward_layers(cfg, params, x, cache, p)
+                logits = lm_head_logits(cfg, params, x)[0, -1]
+                rng, sk = jax.random.split(rng)
+                nxt = sample_traced(logits, sk, temp, tk, tp, pen, recent)
+                recent = push_recent_token(recent, nxt)
+                return (nxt, jax.tree_util.tree_map(
+                    lambda a: a[0], cache["layers"]), rng, recent)
+
+            # the fetch target packs [input token ; sampled token] per slot:
+            # a freshly admitted slot's first token (sampled at admission,
+            # never fetched — admission stays sync-free) rides the SAME
+            # device->host transfer as this step's ids, so an iteration
+            # costs exactly one fetch no matter how many slots joined
+            if nb == toks.shape[0]:
+                # full-occupancy fast path: no prefix slice / write-back —
+                # the donated pool buffers update in place instead of
+                # round-tripping through slice copies every token
+                nxt, layers, rngs, recents = jax.vmap(one)(
+                    toks, layers, pos, rngs, recents, temps, top_ks,
+                    top_ps, penalties)
+                return (jnp.stack([toks, nxt]), layers, nxt, pos + 1, rngs,
+                        recents)
+            sub = jax.tree_util.tree_map(lambda a: a[:nb], layers)
+            nxt, new_sub, new_rngs, new_recents = jax.vmap(one)(
+                toks[:nb], sub, pos[:nb], rngs[:nb], recents[:nb],
+                temps[:nb], top_ks[:nb], top_ps[:nb], penalties[:nb])
+            layers = jax.tree_util.tree_map(
+                lambda full, s: full.at[:nb].set(s), layers, new_sub)
+            # the whole per-slot carry advances ON DEVICE: the engine ships
+            # nothing per iteration and fetches only the packed ids
+            return (jnp.stack([toks[:nb], nxt]), layers,
+                    toks.at[:nb].set(nxt), pos.at[:nb].add(1),
+                    rngs.at[:nb].set(new_rngs),
+                    recents.at[:nb].set(new_recents))
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _slot_assign(layers, src_layers, slot):
+            return slot_assign_layers(cfg, layers, src_layers, slot)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _slot_reset(layers, slot):
+            return slot_reset_layers(layers, slot)
+
         self._prefill = _prefill
+        self._decode_slots = _decode_slots
+        self._slot_assign = _slot_assign
+        self._slot_reset = _slot_reset
+        self._sample_traced = jax.jit(sample_traced)
         self._decode_chunk = _decode_chunk
         self._decode_until = _decode_until
         self._decode_step = _decode_step
@@ -307,6 +380,43 @@ class TextModel:
         head axis split explicit rather than propagation-dependent)."""
         from ...parallel.sharding import shard_cache
         return shard_cache(self._grow(cache, new_len=new_len), self.mesh)
+
+    # -- continuous-batching slot programs (serve engine) -------------------
+
+    def decode_slots(self, layers, toks, pos, rngs, recents,
+                     temps, top_ks, top_ps, penalties, nb: int):
+        """One batched sampled decode step over pool rows 0..nb-1.
+
+        layers: a pool cache's per-layer list (leaves [B, ...]); toks/pos:
+        [B] int32; rngs: [B] PRNG keys; recents: [B, N] int32;
+        temps/top_ps/penalties: [B] f32; top_ks: [B] int32 (>= vocab
+        disables). All per-slot carries are device-resident and DONATED —
+        the scheduler keeps passing the returned arrays back in. nb:
+        static slot-count bucket (occupied slots must sit below it).
+        Returns (packed_ids [2, nb] = [input token ; sampled token] per
+        slot — one fetch serves this step's ids AND any just-admitted
+        slot's unfetched first token — then layers, toks, pos, rngs,
+        recents).
+        """
+        return self._decode_slots(self.params, layers, toks, pos, rngs,
+                                  recents, temps, top_ks, top_ps, penalties,
+                                  nb=nb)
+
+    def slot_assign(self, layers, src_cache: dict, slot: int):
+        """Re-home a batch-1 prefilled cache into pool row `slot` (row is
+        reset first; pool is donated). One executable per source bucket."""
+        return self._slot_assign(layers, src_cache["layers"],
+                                 jnp.asarray(slot, jnp.int32))
+
+    def slot_release(self, layers, slot: int):
+        """Clear pool row `slot` (positions -1, state zeroed; donated)."""
+        return self._slot_reset(layers, jnp.asarray(slot, jnp.int32))
+
+    def sample_one(self, logits, rng, temp, top_k, top_p, penalty, recent):
+        """Traced-parameter sampling of a single token (the engine's
+        first-token sample off the prefill logits)."""
+        return self._sample_traced(logits, rng, temp, top_k, top_p, penalty,
+                                   recent)
 
     # -- inference ----------------------------------------------------------
 

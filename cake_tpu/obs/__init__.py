@@ -64,6 +64,23 @@ HOP_SECONDS = REGISTRY.histogram(
     "(rtt | read | deser | fwd | ser | wire)",
     labelnames=("worker", "phase"))
 
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "cake_serve_queue_depth",
+    "Requests waiting in the continuous-batching admission queue")
+
+SERVE_SLOTS_BUSY = REGISTRY.gauge(
+    "cake_serve_slots_busy",
+    "KV-cache slots currently decoding in the serve engine")
+
+SERVE_QUEUE_WAIT_SECONDS = REGISTRY.histogram(
+    "cake_serve_queue_wait_seconds",
+    "Admission-queue wait per request (enqueue to slot assignment)")
+
+SERVE_BATCH_OCCUPANCY = REGISTRY.histogram(
+    "cake_serve_batch_occupancy",
+    "Occupied slots per batched decode iteration",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+
 WORKER_HEARTBEAT = REGISTRY.gauge(
     "cake_worker_heartbeat_age_seconds",
     "Seconds since the worker last handled any message, at the last "
@@ -78,4 +95,6 @@ __all__ = [
     "TTFT_SECONDS", "DECODE_TOKEN_SECONDS", "GENERATED_TOKENS",
     "GENERATIONS", "API_REQUESTS", "API_REQUEST_SECONDS",
     "WORKER_FWD_SECONDS", "HOP_SECONDS", "WORKER_HEARTBEAT",
+    "SERVE_QUEUE_DEPTH", "SERVE_SLOTS_BUSY", "SERVE_QUEUE_WAIT_SECONDS",
+    "SERVE_BATCH_OCCUPANCY",
 ]

@@ -116,6 +116,49 @@ def sample(logits, rng, cfg: SamplingConfig, recent_tokens=None):
     return sample_top_k_top_p(logits, rng, cfg.top_k, cfg.top_p, cfg.temperature)
 
 
+def sample_traced(logits, rng, temperature, top_k, top_p, repeat_penalty,
+                  recent_tokens):
+    """Fully-traced sampling: every parameter is a runtime value, so ONE
+    compiled program serves any mix of per-request configs — the batched
+    continuous-batching decode step cannot afford a static SamplingConfig
+    (each slot would multiply the executable count by the whole grid).
+
+    logits: [V]; temperature/top_p/repeat_penalty: traced f32 scalars;
+    top_k: traced int32 (>= V disables); recent_tokens: [N] int32, -1 padded.
+    Disabled values: temperature <= 0 -> argmax, top_p >= 1.0 -> off,
+    repeat_penalty == 1.0 -> identity (naturally, via the arithmetic).
+
+    Equivalence to the static `sample` dispatch: temperature <= 0 matches
+    sample_argmax after the same penalty (argsort of the negated logits is
+    stable, so ties break to the lowest id exactly like jnp.argmax); the
+    stochastic paths draw gumbel noise over the full sorted vocab instead
+    of the top-k prefix, so they match in distribution, not per-key.
+    """
+    v = logits.shape[-1]
+    lf = logits.astype(jnp.float32)
+    # sign-aware repeat penalty with a traced strength (identity at 1.0)
+    idx = jnp.where(recent_tokens < 0, v, recent_tokens)
+    flagged = jnp.zeros((v,), jnp.bool_).at[idx].set(True, mode="drop")
+    penalized = jnp.where(lf >= 0, lf / repeat_penalty, lf * repeat_penalty)
+    lf = jnp.where(flagged, penalized, lf)
+    # one descending sort serves argmax (rank 0), top-k (rank mask) and
+    # top-p (cumulative-mass mask) — same O(V log V) the static top-p pays
+    scaled = lf / jnp.maximum(temperature, 1e-6)
+    order = jnp.argsort(-scaled)                       # stable: ties -> low id
+    sorted_logits = scaled[order]
+    rank = jnp.arange(v, dtype=jnp.int32)
+    # top-p mass is measured on the top-k-truncated RENORMALIZED
+    # distribution, matching sample_top_k_top_p's softmax-within-top-k
+    # (with top_k >= V the where is identity, so pure top-p matches too)
+    probs = jax.nn.softmax(jnp.where(rank < top_k, sorted_logits, -jnp.inf))
+    prev_mass = jnp.cumsum(probs) - probs
+    keep = (rank < top_k) & (prev_mass < top_p)
+    keep = keep.at[0].set(True)                        # never mask every token
+    z = jnp.where(keep, sorted_logits, -jnp.inf) + _gumbel(rng, (v,))
+    choice = order[jnp.argmax(z)]
+    return jnp.where(temperature > 0.0, choice, order[0]).astype(jnp.int32)
+
+
 def push_recent_token(recent_tokens, token):
     """Shift a new token into the device-resident recent-token ring
     (drives the repeat penalty without host round-trips)."""

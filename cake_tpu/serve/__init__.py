@@ -1,0 +1,8 @@
+"""Continuous-batching serving subsystem: slot-pooled batched decode with
+bounded admission (see docs/serving.md)."""
+from .admission import AdmissionQueue, QueueFull
+from .engine import ServeEngine, ServeRequest, maybe_engine
+from .slots import SlotPool
+
+__all__ = ["AdmissionQueue", "QueueFull", "ServeEngine", "ServeRequest",
+           "SlotPool", "maybe_engine"]

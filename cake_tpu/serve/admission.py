@@ -1,0 +1,82 @@
+"""Bounded admission queue for the continuous-batching engine.
+
+The reference (and the inherited locked path) queues unboundedly on an
+asyncio.Lock — under overload every client waits forever and memory grows
+with the backlog. Here admission is explicit: a bounded FIFO whose
+overflow raises QueueFull, which the API layer converts into a 429 with a
+Retry-After hint, so clients shed load instead of piling up.
+
+Thread-safe: producers are API handler threads, the consumer is the
+scheduler thread. Depth is mirrored into the cake_serve_queue_depth gauge
+on every transition.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..obs import SERVE_QUEUE_DEPTH
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity; retry_after_s is the 429 hint."""
+
+    def __init__(self, depth: int, retry_after_s: int = 1):
+        super().__init__(f"admission queue full ({depth} waiting)")
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionQueue:
+    def __init__(self, maxsize: int = 64):
+        if maxsize < 1:
+            raise ValueError(f"queue maxsize must be >= 1, got {maxsize}")
+        self.maxsize = maxsize
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+
+    def put(self, item, allow_extra: int = 0) -> None:
+        """allow_extra raises the bound transiently — the engine passes its
+        free-slot count so a BURST against an idle pool is never 429ed
+        just because arrivals outpace the one-admission-per-iteration
+        drain (maxsize bounds requests waiting BEYOND available slots)."""
+        with self._lock:
+            if len(self._items) >= self.maxsize + max(allow_extra, 0):
+                # hint scales with backlog: a deep queue means a longer wait
+                raise QueueFull(len(self._items),
+                                retry_after_s=max(1, len(self._items) // 8))
+            self._items.append(item)
+            SERVE_QUEUE_DEPTH.set(len(self._items))
+
+    def pop(self):
+        """FIFO pop; None when empty."""
+        with self._lock:
+            if not self._items:
+                return None
+            item = self._items.popleft()
+            SERVE_QUEUE_DEPTH.set(len(self._items))
+            return item
+
+    def depth(self) -> int:
+        return len(self._items)
+
+    def purge(self, pred) -> list:
+        """Remove and return every queued item matching pred — the
+        scheduler's per-iteration sweep of requests whose client vanished
+        while waiting, so abandoned entries stop pinning queue capacity
+        (and 429ing live clients) until they reach the head."""
+        with self._lock:
+            dropped = [it for it in self._items if pred(it)]
+            if dropped:
+                self._items = deque(it for it in self._items
+                                    if not pred(it))
+                SERVE_QUEUE_DEPTH.set(len(self._items))
+            return dropped
+
+    def drain(self) -> list:
+        """Remove and return everything queued (engine shutdown/crash)."""
+        with self._lock:
+            items = list(self._items)
+            self._items.clear()
+            SERVE_QUEUE_DEPTH.set(0)
+            return items

@@ -1,0 +1,502 @@
+"""Continuous-batching scheduler: slot-based batched decode for concurrent
+text serving.
+
+The reference serializes every request through Arc<RwLock<Master>> (ref:
+api/mod.rs:71) and the inherited locked path does the same — request N+1
+waits for request N's entire decode. This engine applies iteration-level
+scheduling (Orca, OSDI'22) with a fixed slot pool (vLLM's slot idea minus
+paging — slots here are whole KV rows of a preallocated batch-B cache):
+
+  * a bounded admission queue feeds a single scheduler thread;
+  * each iteration ADMITS at most one queued request — bucketed batch-1
+    prefill through the model's existing compiled prefill programs, then
+    slot_assign re-homes the KV into a free pool row — and then runs ONE
+    batched `decode_slots` step over the occupied prefix (per-slot
+    positions, RNG keys, recent-token windows, traced sampling params),
+    fanning each slot's sampled token out to its request's stream;
+  * EOS / budget / client-cancel free the slot for the next admission.
+
+Every jax call happens on the scheduler thread, so the engine needs no
+device-side locking; API handlers only touch thread-safe queues/events.
+Greedy outputs are bit-identical to the sequential path (masked slots
+contribute exactly-zero attention weight), which the tier-1 e2e test pins.
+"""
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import threading
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_QUEUE_WAIT_SECONDS,
+                   SERVE_SLOTS_BUSY, now, set_request_id)
+from ..ops.sampling import SamplingConfig
+from .admission import AdmissionQueue, QueueFull
+from .slots import SlotPool, slot_bucket
+
+__all__ = ["ServeEngine", "ServeRequest", "QueueFull", "maybe_engine"]
+
+# device-resident repeat-penalty window per slot — derived from the
+# SamplingConfig default so the engine's window can never silently diverge
+# from the sequential path's (the API grid never varies repeat_last_n, so
+# one static width serves all)
+RECENT_N = SamplingConfig().repeat_last_n
+
+# default pool row length when the model's max_cache_len is unbounded-ish:
+# the pool is B x ctx x layers of KV, allocated up front
+DEFAULT_CTX = 4096
+
+
+class ServeRequest:
+    """One submitted generation: token stream + terminal state.
+
+    The engine fills `tokens`/`stats`/`error` (mirroring the legacy
+    streamed-path result dict) and feeds `out_q` with Token objects ending
+    in DONE. `cancel()` may be called from any thread — the scheduler
+    frees the slot on its next iteration.
+    """
+
+    DONE = object()
+
+    def __init__(self, prompt_ids: list[int], max_new_tokens: int,
+                 sampling: SamplingConfig, request_id: str | None = None):
+        self.id = request_id or "serve-" + uuid.uuid4().hex[:16]
+        self.prompt_ids = list(prompt_ids)
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling or SamplingConfig()
+        self.out_q: queue_mod.Queue = queue_mod.Queue()
+        self.cancelled = threading.Event()
+        self.done = threading.Event()
+        self.result: dict = {}          # tokens / stats / error, like the
+                                        # legacy streamed-path result dict
+        self.tokens: list[int] = []
+        self.stats: dict = {}
+        self.t_enqueue = now()
+        self._sub_lock = threading.Lock()
+        self._token_cb = None           # push-mode subscriber (SSE bridge)
+        self._done_cbs: list = []
+        # scheduler-owned fields
+        self.slot: int | None = None
+        self.budget = 0                 # decode tokens left after the first
+        self.t_first = 0.0              # first-token timestamp (decode t0)
+        self._first_pending = False     # first token sampled, not fetched
+        self._engine = None
+
+    def cancel(self):
+        """Client disconnect: release the slot at the next iteration."""
+        self.cancelled.set()
+        eng = self._engine
+        if eng is not None:
+            eng._wake.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.done.wait(timeout)
+
+    # -- delivery: push subscribers beat thread-parking -------------------
+    # API handlers register callbacks instead of blocking an executor
+    # thread per in-flight request (the default executor also serves
+    # tokenization and every other endpoint — parking a thread per
+    # generation would deadlock the server at exactly the concurrency
+    # this engine exists to provide).
+
+    def subscribe(self, cb) -> list:
+        """Route future token/DONE deliveries through cb (invoked on the
+        scheduler thread); returns the backlog accumulated so far."""
+        backlog = []
+        with self._sub_lock:
+            while True:
+                try:
+                    backlog.append(self.out_q.get_nowait())
+                except queue_mod.Empty:
+                    break
+            self._token_cb = cb
+        return backlog
+
+    def add_done_callback(self, cb):
+        """cb fires (scheduler thread) when the request completes; fires
+        immediately (caller thread) if it already has."""
+        with self._sub_lock:
+            if not self.done.is_set():
+                self._done_cbs.append(cb)
+                return
+        cb()
+
+    def _deliver(self, item):           # scheduler thread
+        with self._sub_lock:
+            cb = self._token_cb
+            if cb is None:
+                self.out_q.put(item)
+        if cb is not None:
+            try:
+                cb(item)
+            except Exception:
+                pass                    # subscriber's loop may be gone
+
+    def _fire_done(self):               # scheduler thread
+        with self._sub_lock:
+            self.done.set()
+            cbs, self._done_cbs = self._done_cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                pass
+
+
+class ServeEngine:
+    """Owns the slot pool, the admission queue, and the scheduler thread."""
+
+    def __init__(self, model, slots: int = 4, max_queue: int = 64,
+                 ctx_len: int | None = None, seed: int = 0):
+        if not hasattr(model, "decode_slots"):
+            raise TypeError(
+                f"{type(model).__name__} has no batched slot decode; the "
+                "engine serves plain TextModels only (distributed/offload "
+                "models keep the locked path)")
+        self.model = model
+        self.slots = slots
+        self.ctx = min(ctx_len or DEFAULT_CTX, model.max_cache_len)
+        self.pool = SlotPool(slots)
+        self.queue = AdmissionQueue(max_queue)
+
+        pool_cache = model.new_cache(slots, kv_len=self.ctx)
+        self._layers = pool_cache["layers"]
+        vocab = model.cfg.vocab_size
+        self._vocab = vocab
+        # ALL per-slot state is device-resident: rows are written at
+        # admission/release only, and the whole carry (tokens, positions,
+        # RNG, recent windows) advances inside the batched decode program
+        # — an iteration ships nothing host->device and fetches only the
+        # nb sampled ids
+        self._toks = jnp.zeros((slots,), jnp.int32)
+        self._pos = jnp.zeros((slots,), jnp.int32)
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._top_ks = jnp.full((slots,), vocab, jnp.int32)
+        self._top_ps = jnp.ones((slots,), jnp.float32)
+        self._pens = jnp.ones((slots,), jnp.float32)
+        self._rngs = jnp.stack([jax.random.PRNGKey(seed + i)
+                                for i in range(slots)])
+        self._recents = jnp.full((slots, RECENT_N), -1, jnp.int32)
+        self._base_rng = jax.random.PRNGKey(seed)
+        self._reqs: list[ServeRequest | None] = [None] * slots
+        self._seq = 0
+
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self.steps = 0                  # completed scheduler iterations
+        self.last_step = now()
+        self.dead: BaseException | None = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cake-serve")
+        self._thread.start()
+
+    # -- client surface (any thread) ----------------------------------------
+
+    def submit(self, prompt_ids: list[int], max_new_tokens: int = 256,
+               sampling: SamplingConfig | None = None,
+               request_id: str | None = None) -> ServeRequest:
+        """Enqueue a generation. Raises QueueFull under backpressure and
+        ValueError for prompts the pool can never hold."""
+        if self.dead is not None or not self._thread.is_alive():
+            raise RuntimeError(f"serve engine is down: {self.dead}")
+        n = len(prompt_ids)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n > self.ctx - 2:
+            raise ValueError(
+                f"prompt length {n} exceeds the serve context "
+                f"({self.ctx} tokens per slot)")
+        req = ServeRequest(prompt_ids, max_new_tokens, sampling, request_id)
+        req._engine = self
+        # free slots extend the bound: a burst that fits the idle pool is
+        # admitted even though the scheduler drains one per iteration
+        self.queue.put(req, allow_extra=self.pool.free_count)
+        self._wake.set()
+        if self.dead is not None:
+            # the scheduler crashed between the liveness check above and
+            # the put: its crash drain may have missed this request, so
+            # release the waiter ourselves (double-fail is harmless)
+            self.queue.purge(lambda r: r is req)
+            err = RuntimeError(f"serve engine is down: {self.dead}")
+            self._fail(req, err)
+            raise err
+        return req
+
+    def stream(self, req: ServeRequest):
+        """(async iterator, result dict) over the request's token stream —
+        the same contract as the legacy run_generation_streamed, so the SSE
+        writer is path-agnostic. Tokens are pushed from the scheduler
+        thread straight into an asyncio queue (call_soon_threadsafe): no
+        executor thread is parked per stream, and the iterator's finalizer
+        cancels the request on abandonment so a client disconnect frees
+        the slot instead of leaking it. Must be called on the event loop."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        aq: asyncio.Queue = asyncio.Queue()
+
+        def pump(item):
+            try:
+                loop.call_soon_threadsafe(aq.put_nowait, item)
+            except RuntimeError:
+                pass                    # loop closed; finalizer cancels
+
+        for item in req.subscribe(pump):
+            aq.put_nowait(item)
+
+        async def aiter():
+            try:
+                while True:
+                    item = await aq.get()
+                    if item is ServeRequest.DONE:
+                        break
+                    yield item
+            finally:
+                req.cancel()
+            if "error" in req.result:
+                raise req.result["error"]
+
+        return aiter(), req.result
+
+    def health(self) -> dict:
+        return {
+            "alive": self.dead is None and self._thread.is_alive(),
+            "slots": self.slots,
+            "slots_busy": self.pool.busy_count,
+            "queue_depth": self.queue.depth(),
+            "ctx_len": self.ctx,
+            "steps": self.steps,
+            "last_step_age_s": round(now() - self.last_step, 3),
+        }
+
+    def close(self, timeout: float = 5.0):
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+        for req in self.queue.drain():
+            self._fail(req, RuntimeError("serve engine shut down"))
+        if self._thread.is_alive():
+            # scheduler still inside a device call (e.g. a long compile):
+            # release the waiters but do NOT touch pool/_reqs/_layers —
+            # racing the live thread's state would crash it mid-step
+            # (_fail is benign if the scheduler later finishes the slot)
+            self.dead = self.dead or RuntimeError(
+                "serve engine shutdown timed out")
+            for req in list(self._reqs):
+                if req is not None:
+                    self._fail(req, RuntimeError("serve engine shut down"))
+            return
+        for i, req in enumerate(self._reqs):
+            if req is not None:
+                self._finish(i, req, cancelled=True)
+
+    # -- scheduler thread ---------------------------------------------------
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                worked = self._step()
+                self.last_step = now()
+                if worked:
+                    self.steps += 1
+                else:
+                    # idle: block on the wake event (submit/cancel/close
+                    # all set it); the 0.5s timeout is only a heartbeat
+                    # for last_step, not a polling cadence
+                    self._wake.wait(0.5)
+                    self._wake.clear()
+        except BaseException as e:  # fail loudly: every waiter is released
+            self.dead = e
+            for req in self.queue.drain():
+                self._fail(req, e)
+            for i, req in enumerate(self._reqs):
+                if req is not None:
+                    req.result.setdefault("error", e)
+                    self._finish(i, req, cancelled=True, release=False)
+
+    def _step(self) -> bool:
+        busy = self.pool.busy()
+        queued = self.queue.depth() > 0
+        cancels = [i for i in busy if self._reqs[i].cancelled.is_set()]
+        if not (busy or queued):
+            return False
+        with RECORDER.span("serve.step", cat="serve", slots=len(busy),
+                           queued=self.queue.depth()):
+            for i in cancels:
+                self._finish(i, self._reqs[i], cancelled=True)
+            # abandoned-while-queued requests must not pin queue capacity
+            # (they would 429 live clients while slots sit idle)
+            for req in self.queue.purge(lambda r: r.cancelled.is_set()):
+                self._fail(req, None)
+            if self.pool.free_count > 0:
+                self._admit_one()
+            busy = self.pool.busy()
+            if busy:
+                self._decode(busy)
+        return True
+
+    def _admit_one(self):
+        """Pop the first live queued request and prefill it into a slot."""
+        while True:
+            req = self.queue.pop()
+            if req is None:
+                return
+            if req.cancelled.is_set():
+                self._fail(req, None)   # abandoned while queued
+                continue
+            break
+        SERVE_QUEUE_WAIT_SECONDS.observe(now() - req.t_enqueue)
+        slot = self.pool.alloc()
+        # register BEFORE any fallible device work: if anything below (or
+        # the loop itself) dies, the crash handler finds the request in
+        # _reqs and releases its waiter instead of hanging the client
+        self._reqs[slot] = req
+        req.slot = slot
+        n = len(req.prompt_ids)
+        scfg = req.sampling
+        set_request_id(req.id)      # prefill spans attribute to the request
+        try:
+            with RECORDER.span("serve.prefill", cat="serve", tokens=n,
+                               slot=slot):
+                from ..models.common.text_model import bucket_for
+                cache1 = self.model.new_cache(
+                    1, kv_len=bucket_for(n, self.ctx))
+                logits, cache1 = self.model.prefill(cache1, req.prompt_ids)
+                self._layers = self.model.slot_assign(self._layers, cache1,
+                                                      slot)
+            rng = jax.random.fold_in(self._base_rng, self._seq)
+            self._seq += 1
+            rng, sk = jax.random.split(rng)
+            recent = jnp.full((RECENT_N,), -1, jnp.int32)
+            # first token stays ON DEVICE: admission performs no host
+            # sync — the id rides the next decode iteration's packed
+            # fetch (through a high-latency device link every per-token
+            # fetch costs a fixed RTT; admissions must not add one each)
+            tid = self.model.sample_one(
+                logits[0], sk, jnp.float32(scfg.temperature),
+                jnp.int32(scfg.top_k or self._vocab),
+                jnp.float32(scfg.top_p if scfg.top_p is not None else 1.0),
+                jnp.float32(scfg.repeat_penalty), recent)
+            self._rngs = self._rngs.at[slot].set(rng)
+            self._recents = self._recents.at[slot].set(
+                recent.at[-1].set(tid))
+            self._toks = self._toks.at[slot].set(tid)
+            self._pos = self._pos.at[slot].set(n)
+            self._temps = self._temps.at[slot].set(scfg.temperature)
+            self._top_ks = self._top_ks.at[slot].set(
+                scfg.top_k or self._vocab)
+            self._top_ps = self._top_ps.at[slot].set(
+                scfg.top_p if scfg.top_p is not None else 1.0)
+            self._pens = self._pens.at[slot].set(scfg.repeat_penalty)
+        except Exception as e:
+            self._reqs[slot] = None
+            self.pool.free(slot)
+            self._fail(req, e)
+            return
+        finally:
+            set_request_id(None)
+        req.budget = min(req.max_new_tokens - 1, self.ctx - n - 1)
+        req._first_pending = True       # emitted at the next decode fetch
+        # ttft_s is stamped when the first token is FETCHED (everything
+        # above is an async dispatch — stamping here would understate the
+        # client's real wait); queue wait is the pop-to-enqueue delta
+        req.stats = {"queue_wait_s": now() - req.t_enqueue}
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+
+    def _decode(self, busy: list[int]):
+        """One batched decode step over the occupied prefix."""
+        nb = slot_bucket(busy[-1] + 1, self.slots)
+        SERVE_BATCH_OCCUPANCY.observe(len(busy))
+        (packed, self._layers, self._toks, self._pos, self._rngs,
+         self._recents) = self.model.decode_slots(
+            self._layers, self._toks, self._pos, self._rngs, self._recents,
+            self._temps, self._top_ks, self._top_ps, self._pens, nb=nb)
+        # ONE host fetch per iteration: row 0 carries each slot's input
+        # token (a just-admitted slot's unemitted FIRST token), row 1 the
+        # token this step sampled
+        arr = np.asarray(packed)
+        for i in busy:
+            req = self._reqs[i]
+            if req._first_pending:
+                req._first_pending = False
+                req.t_first = now()     # first token actually on host:
+                req.stats["ttft_s"] = req.t_first - req.t_enqueue
+                first = int(arr[0, i])
+                self._emit(req, first)
+                if self.model.cfg.is_eos(first) or req.budget <= 0:
+                    # this step's overshoot token is discarded — one
+                    # wasted slot-row step, no recompute
+                    self._finish(i, req)
+                    continue
+            tid = int(arr[1, i])
+            req.budget -= 1
+            self._emit(req, tid)
+            if self.model.cfg.is_eos(tid) or req.budget <= 0:
+                self._finish(i, req)
+
+    def _emit(self, req: ServeRequest, tid: int):
+        req.tokens.append(tid)
+        if not req.cancelled.is_set():
+            req._deliver(self.model._mk_token(tid))
+
+    def _finish(self, slot: int, req: ServeRequest, cancelled: bool = False,
+                release: bool = True):
+        self.pool.free(slot)
+        self._reqs[slot] = None
+        if release:
+            # wipe the row so a cancelled/finished request's KV never
+            # lingers into the next occupant's prefix, and pin its
+            # position back to 0 so an idle row inside the decode prefix
+            # can't drift past the rope table (freed rows still step —
+            # their garbage is confined to their own row)
+            self._layers = self.model.slot_release(self._layers, slot)
+            self._toks = self._toks.at[slot].set(0)
+            self._pos = self._pos.at[slot].set(0)
+        dt = now() - req.t_first if req.t_first else 0.0
+        ndec = max(len(req.tokens) - 1, 0)
+        req.stats.update({
+            "decode_tokens": ndec, "decode_s": dt,
+            "tok_per_s": ndec / dt if dt > 0 and ndec else 0.0,
+        })
+        req.result["tokens"] = req.tokens
+        req.result["stats"] = req.stats
+        if not cancelled and req.tokens:
+            from ..models.common.text_model import _observe_generation
+            _observe_generation(req.stats, len(req.tokens), path="serve")
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        req._deliver(ServeRequest.DONE)
+        req._fire_done()
+
+    def _fail(self, req: ServeRequest, error: BaseException | None):
+        if error is not None:
+            req.result["error"] = error
+        req.result.setdefault("tokens", req.tokens)
+        req.result.setdefault("stats", {})
+        req._deliver(ServeRequest.DONE)
+        req._fire_done()
+
+
+def maybe_engine(model, slots: int | None = None,
+                 max_queue: int | None = None,
+                 ctx_len: int | None = None) -> ServeEngine | None:
+    """Engine for serve-capable models, tuned by env: CAKE_SERVE_SLOTS
+    (default 4, 0 disables), CAKE_MAX_QUEUE (default 64), CAKE_SERVE_CTX
+    (default 4096, capped by the model's max_cache_len). Distributed /
+    offloaded models return None — the API keeps its locked fallback."""
+    from ..models.common.text_model import TextModel
+    if not isinstance(model, TextModel):
+        return None
+    if slots is None:
+        slots = int(os.environ.get("CAKE_SERVE_SLOTS", "4"))
+    if slots <= 0:
+        return None
+    if max_queue is None:
+        max_queue = int(os.environ.get("CAKE_MAX_QUEUE", "64"))
+    if ctx_len is None:
+        ctx_len = int(os.environ.get("CAKE_SERVE_CTX", str(DEFAULT_CTX)))
+    return ServeEngine(model, slots=slots, max_queue=max_queue,
+                       ctx_len=ctx_len)

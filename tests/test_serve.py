@@ -1,0 +1,613 @@
+"""Continuous-batching serve engine: slot/queue units (no model), batched
+slot-decode cache ops, and end-to-end concurrent serving through the
+aiohttp API on a tiny CPU model — the tier-1 pin for ISSUE 2's acceptance:
+concurrent requests interleave, greedy outputs match the sequential path
+exactly, backpressure answers 429, and disconnects reclaim slots."""
+import asyncio
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models import TextModel, tiny_config
+from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.serve import (AdmissionQueue, QueueFull, ServeEngine,
+                            SlotPool, maybe_engine)
+
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+# ---------------------------------------------------------------------------
+# units: no model required
+# ---------------------------------------------------------------------------
+
+
+def test_slot_pool_lowest_first():
+    p = SlotPool(3)
+    assert [p.alloc(), p.alloc(), p.alloc()] == [0, 1, 2]
+    assert p.alloc() is None and p.free_count == 0
+    p.free(1)
+    assert p.alloc() == 1                 # lowest free index, not LIFO
+    p.free(0)
+    p.free(2)
+    assert p.busy() == [1] and p.prefix_len() == 2
+    p.free(1)
+    assert p.prefix_len() == 0
+    with pytest.raises(ValueError):
+        p.free(1)                         # double free
+
+
+def test_slot_bucket_powers_of_two():
+    from cake_tpu.serve.slots import slot_bucket
+    assert [slot_bucket(n, 8) for n in (1, 2, 3, 4, 5, 8)] == \
+        [1, 2, 4, 4, 8, 8]
+    assert slot_bucket(3, 4) == 4 and slot_bucket(1, 1) == 1
+    # the whole point vs bucket_for: a lone request decodes 1 row, not 32
+    assert slot_bucket(1, 4) == 1
+
+
+def test_admission_queue_purge():
+    q = AdmissionQueue(maxsize=4)
+    for x in ("a", "bb", "c", "dd"):
+        q.put(x)
+    dropped = q.purge(lambda s: len(s) == 2)
+    assert dropped == ["bb", "dd"]
+    assert q.pop() == "a" and q.pop() == "c" and q.pop() is None
+    from cake_tpu.obs import SERVE_QUEUE_DEPTH
+    assert SERVE_QUEUE_DEPTH.value() == 0
+
+
+def test_admission_queue_fifo_and_bound():
+    from cake_tpu.obs import SERVE_QUEUE_DEPTH
+    q = AdmissionQueue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    assert SERVE_QUEUE_DEPTH.value() == 2
+    with pytest.raises(QueueFull) as ei:
+        q.put("c")
+    assert ei.value.retry_after_s >= 1
+    assert q.pop() == "a" and q.pop() == "b" and q.pop() is None
+    assert SERVE_QUEUE_DEPTH.value() == 0
+    q.put("d")
+    assert q.drain() == ["d"] and q.depth() == 0
+
+
+def test_slot_assign_and_reset_rehome():
+    """slot_assign re-homes a batch-1 bucketed cache into one pool row
+    (position -> slot remap, padding dropped) leaving other rows alone;
+    slot_reset clears exactly one row. Pure cache ops, no model."""
+    from cake_tpu.models.common.cache import (init_cache, slot_assign_layers,
+                                              slot_reset_layers)
+    cfg = tiny_config("llama")
+    pool = init_cache(cfg, 3, 64, jnp.float32)
+    # make row 0 and 2 recognizably non-empty
+    layers = pool["layers"]
+    layers = [{**lc, "k": lc["k"].at[0].set(7.0).at[2].set(9.0),
+               "pos": lc["pos"].at[0, :4].set(jnp.arange(4))}
+              for lc in layers]
+
+    src = init_cache(cfg, 1, 32, jnp.float32)
+    n = 5
+    src_layers = []
+    for lc in src["layers"]:
+        k = lc["k"].at[0, :n].set(
+            jnp.arange(n, dtype=jnp.float32)[:, None, None] + 1.0)
+        pos = lc["pos"].at[0, :n].set(jnp.arange(n))
+        src_layers.append({**lc, "k": k, "v": lc["v"], "pos": pos})
+
+    out = slot_assign_layers(cfg, layers, src_layers, jnp.asarray(1))
+    for lc in out:
+        np.testing.assert_array_equal(np.asarray(lc["pos"][1, :n]),
+                                      np.arange(n))
+        assert int(jnp.max(lc["pos"][1, n:])) == -1      # rest of row empty
+        np.testing.assert_allclose(np.asarray(lc["k"][1, :n, 0, 0]),
+                                   np.arange(n) + 1.0)
+        # neighbors untouched
+        assert float(lc["k"][0, 0, 0, 0]) == 7.0
+        assert float(lc["k"][2, 0, 0, 0]) == 9.0
+        np.testing.assert_array_equal(np.asarray(lc["pos"][0, :4]),
+                                      np.arange(4))
+
+    out = slot_reset_layers(out, jnp.asarray(1))
+    for lc in out:
+        assert int(jnp.max(lc["pos"][1])) == -1
+        assert float(jnp.abs(lc["k"][1]).max()) == 0.0
+        assert float(lc["k"][0, 0, 0, 0]) == 7.0         # row 0 survives
+
+
+def test_sample_traced_matches_static_greedy():
+    """The traced sampler (one executable for every per-slot config mix)
+    must agree with the static dispatch on greedy, incl. repeat penalty
+    and tie-breaking; stochastic draws must respect the top-k set."""
+    from cake_tpu.ops.sampling import sample, sample_traced
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (97,)) * 3
+    recent = jnp.full((8,), -1, jnp.int32).at[:3].set(jnp.asarray([5, 9, 5]))
+    for pen in (1.0, 1.3):
+        a = sample(logits, rng,
+                   SamplingConfig(temperature=0.0, repeat_penalty=pen),
+                   recent)
+        b = sample_traced(logits, rng, jnp.float32(0.0), jnp.int32(97),
+                          jnp.float32(1.0), jnp.float32(pen), recent)
+        assert int(a) == int(b)
+    tie = jnp.zeros((10,)).at[3].set(5.0).at[7].set(5.0)
+    none = jnp.full((4,), -1, jnp.int32)
+    assert int(sample_traced(tie, rng, jnp.float32(0.0), jnp.int32(10),
+                             jnp.float32(1.0), jnp.float32(1.0), none)) == 3
+    topk = set(np.asarray(jax.lax.top_k(logits, 5)[1]).tolist())
+    for i in range(20):
+        t = sample_traced(logits, jax.random.PRNGKey(100 + i),
+                          jnp.float32(0.8), jnp.int32(5), jnp.float32(1.0),
+                          jnp.float32(1.0), recent)
+        assert int(t) in topk
+
+
+def test_sample_traced_topk_topp_renormalizes():
+    """Combined top_k+top_p must measure top-p mass on the top-k-truncated
+    RENORMALIZED distribution (sample_top_k_top_p semantics): 5 equal-top
+    logits with k=5, p=0.5 keep ranks 0-2 (prev mass 0, .2, .4), never
+    ranks 3-4 — under full-vocab mass all 5 would pass."""
+    from cake_tpu.ops.sampling import sample_traced
+    v = 64
+    logits = jnp.full((v,), 1.9).at[:5].set(2.0)   # spread the tail mass
+    none = jnp.full((4,), -1, jnp.int32)
+    seen = set()
+    for i in range(60):
+        t = sample_traced(logits, jax.random.PRNGKey(i), jnp.float32(1.0),
+                          jnp.int32(5), jnp.float32(0.5), jnp.float32(1.0),
+                          none)
+        seen.add(int(t))
+    assert seen <= {0, 1, 2}, seen
+    assert len(seen) > 1                           # actually stochastic
+
+
+def test_maybe_engine_gating(monkeypatch):
+    """Only plain TextModels get an engine; CAKE_SERVE_SLOTS=0 disables."""
+    class NotATextModel:
+        pass
+    assert maybe_engine(NotATextModel()) is None
+    monkeypatch.setenv("CAKE_SERVE_SLOTS", "0")
+    # a real TextModel with slots=0 must also be None — checked via the
+    # env without building a model (slots resolves before the isinstance
+    # fails), so construct the cheapest possible one
+    m = _model()
+    assert maybe_engine(m) is None
+    monkeypatch.setenv("CAKE_SERVE_SLOTS", "2")
+    eng = maybe_engine(m, ctx_len=64)
+    try:
+        assert eng is not None and eng.slots == 2 and eng.ctx == 64
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: tiny CPU model
+# ---------------------------------------------------------------------------
+
+CTX = 256
+
+
+class TinyTok:
+    """Deterministic toy tokenizer: per-token decode concatenates exactly
+    like whole-sequence decode, so streamed and blocking text agree."""
+
+    def encode(self, text):
+        return [3 + (sum(w.encode()) % 200) for w in text.split()][:24] or [3]
+
+    def decode(self, ids):
+        return "".join(f"<{i}>" for i in ids)
+
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                           max_cache_len=CTX)
+        _MODEL.tokenizer = TinyTok()
+    return _MODEL
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    eng = ServeEngine(model, slots=4, max_queue=8, ctx_len=CTX)
+    yield eng
+    eng.close()
+
+
+def _ref(model, prompt, n, sampling=GREEDY):
+    toks, _ = model.generate(list(prompt), max_new_tokens=n,
+                             sampling=sampling)
+    return toks
+
+
+P_LONG = [3, 17, 42, 99, 7]
+P_A = [8, 8, 1, 30]
+P_B = [100, 2, 5, 9, 11, 40]
+
+
+def test_engine_greedy_matches_sequential(model, engine):
+    """3 concurrent greedy requests each reproduce the sequential path
+    bit-for-bit (masked pool slots contribute exactly-zero attention)."""
+    reqs = [engine.submit(p, max_new_tokens=n, sampling=GREEDY)
+            for p, n in ((P_LONG, 12), (P_A, 6), (P_B, 9))]
+    for r, (p, n) in zip(reqs, ((P_LONG, 12), (P_A, 6), (P_B, 9))):
+        assert r.wait(120)
+        assert r.result["tokens"] == _ref(model, p, n)
+        assert r.result["stats"]["ttft_s"] > 0
+
+
+def test_engine_repeat_penalty_parity(model, engine):
+    """Traced per-slot repeat penalty matches the static sequential path
+    (same recent-token window seeding: generated tokens only)."""
+    scfg = SamplingConfig(temperature=0.0, repeat_penalty=1.3)
+    r = engine.submit(P_LONG, max_new_tokens=10, sampling=scfg)
+    assert r.wait(120)
+    assert r.result["tokens"] == _ref(model, P_LONG, 10, scfg)
+
+
+def test_engine_interleaves_short_past_long(model, engine):
+    """Iteration-level scheduling: two short requests admitted after a
+    long one finish while it is still decoding — impossible on the
+    serialized locked path."""
+    long_ref = _ref(model, P_LONG, 48)
+    assert len(long_ref) >= 24            # precondition: no early EOS
+    r_long = engine.submit(P_LONG, max_new_tokens=48, sampling=GREEDY)
+    while not r_long.tokens:              # admitted and decoding
+        time.sleep(0.005)
+    r_a = engine.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+    r_b = engine.submit(P_B, max_new_tokens=4, sampling=GREEDY)
+    assert r_a.wait(60) and r_b.wait(60)
+    assert not r_long.done.is_set(), \
+        "short requests should complete while the long one still decodes"
+    assert r_long.wait(120)
+    assert r_long.result["tokens"] == long_ref
+
+
+def test_engine_concurrent_wallclock(model, engine):
+    """4 concurrent requests complete in < 2x one request's wall-clock:
+    the batched decode amortizes the per-iteration cost across slots.
+    Generations are long enough that decode (the thing that batches)
+    dominates the 4 serialized admissions, and the measurement is
+    min-of-3 interleaved trials (timing on shared CI is noisy)."""
+    # warm every executable: long enough that occupancy actually reaches
+    # 4 (nb=1/2/4 buckets all compile before the timed region)
+    warm = [engine.submit(P_LONG, max_new_tokens=24, sampling=GREEDY)
+            for _ in range(4)]
+    assert all(r.wait(120) for r in warm)
+
+    ratios = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        r = engine.submit(P_LONG, max_new_tokens=96, sampling=GREEDY)
+        assert r.wait(120)
+        t_single = time.monotonic() - t0
+
+        t0 = time.monotonic()
+        rs = [engine.submit(P_LONG, max_new_tokens=96, sampling=GREEDY)
+              for _ in range(4)]
+        assert all(r.wait(120) for r in rs)
+        t_four = time.monotonic() - t0
+        ratios.append(t_four / t_single)
+    assert min(ratios) < 2.0, ratios
+
+
+def test_engine_cancel_frees_slot(model, engine):
+    """Client disconnect mid-stream reclaims the slot: slots_busy returns
+    to 0 and the generation stops well short of its budget."""
+    from cake_tpu.obs import SERVE_SLOTS_BUSY
+    r = engine.submit(P_LONG, max_new_tokens=180, sampling=GREEDY)
+    while len(r.tokens) < 3:
+        time.sleep(0.005)
+    assert SERVE_SLOTS_BUSY.value() >= 1
+    r.cancel()
+    deadline = time.monotonic() + 10
+    while SERVE_SLOTS_BUSY.value() != 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert SERVE_SLOTS_BUSY.value() == 0
+    assert r.done.is_set()
+    assert len(r.tokens) < 170            # budget was NOT decoded out
+
+
+def test_engine_backpressure_queue_full(model):
+    """slots=1 + max_queue=1: one decoding, one queued, the third submit
+    raises QueueFull with a retry hint."""
+    eng = ServeEngine(model, slots=1, max_queue=1, ctx_len=CTX)
+    try:
+        r_busy = eng.submit(P_LONG, max_new_tokens=180, sampling=GREEDY)
+        while not r_busy.tokens:
+            time.sleep(0.005)
+        r_queued = eng.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+        with pytest.raises(QueueFull) as ei:
+            eng.submit(P_B, max_new_tokens=4, sampling=GREEDY)
+        assert ei.value.retry_after_s >= 1
+        r_busy.cancel()
+        assert r_queued.wait(120)         # queued one still served
+        assert r_queued.result["tokens"] == _ref(model, P_A, 4)
+    finally:
+        eng.close()
+
+
+def test_engine_burst_fills_idle_slots_without_429(model):
+    """A burst of slots+queue submissions against an IDLE pool is fully
+    admitted: the bound counts requests waiting beyond free slots, so
+    arrivals outpacing the one-admission-per-iteration drain don't shed
+    load while capacity sits idle (found by driving the live server)."""
+    eng = ServeEngine(model, slots=4, max_queue=1, ctx_len=CTX)
+    try:
+        rs = [eng.submit(P_A, max_new_tokens=6, sampling=GREEDY)
+              for _ in range(5)]               # 4 slots + 1 queued: all in
+        assert all(r.wait(120) for r in rs)
+        ref = _ref(model, P_A, 6)
+        assert all(r.result["tokens"] == ref for r in rs)
+    finally:
+        eng.close()
+
+
+def test_engine_cancelled_queued_purged(model):
+    """A request abandoned while QUEUED stops pinning queue capacity at
+    the next iteration — live clients are not 429ed behind ghosts."""
+    eng = ServeEngine(model, slots=1, max_queue=1, ctx_len=CTX)
+    try:
+        r_busy = eng.submit(P_LONG, max_new_tokens=180, sampling=GREEDY)
+        while not r_busy.tokens:
+            time.sleep(0.005)
+        r_ghost = eng.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+        r_ghost.cancel()                  # client vanished while waiting
+        deadline = time.monotonic() + 10
+        while eng.queue.depth() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.queue.depth() == 0
+        assert r_ghost.done.is_set()
+        # capacity is back: a live client gets in instead of a 429
+        r_live = eng.submit(P_B, max_new_tokens=4, sampling=GREEDY)
+        r_busy.cancel()
+        assert r_live.wait(120)
+        assert r_live.result["tokens"] == _ref(model, P_B, 4)
+    finally:
+        eng.close()
+
+
+def test_engine_rejects_oversize_prompt(model, engine):
+    with pytest.raises(ValueError):
+        engine.submit(list(range(CTX)), max_new_tokens=4, sampling=GREEDY)
+
+
+# ---------------------------------------------------------------------------
+# e2e through the aiohttp API
+# ---------------------------------------------------------------------------
+
+
+def _api_state(model, engine):
+    from cake_tpu.api import ApiState
+    st = ApiState(model=model, tokenizer=model.tokenizer,
+                  model_id="tiny-serve")
+    st.engine = engine
+    return st
+
+
+def _run(coro):
+    asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_api_concurrent_chat_parity(model, engine):
+    """3 concurrent API chats through the engine: all 200, greedy text
+    identical to the sequential reference, shorts finish before the long
+    one (wall-clock interleaving at the HTTP layer)."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api import create_app
+    from cake_tpu.models.common.text_model import chat_prompt_ids
+
+    msgs = [[{"role": "user", "content": f"hello world {i}"}]
+            for i in range(3)]
+    budgets = [40, 5, 5]
+    refs = []
+    for mm, n in zip(msgs, budgets):
+        ids = chat_prompt_ids(model.tokenizer, mm)
+        toks = _ref(model, ids, n)
+        ended = model.cfg.is_eos(toks[-1])
+        refs.append(model.tokenizer.decode(toks[:-1] if ended else toks))
+
+    done_at = {}
+
+    async def scenario():
+        app = create_app(_api_state(model, engine))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            async def one(i):
+                r = await client.post("/v1/chat/completions", json={
+                    "messages": msgs[i], "max_tokens": budgets[i],
+                    "temperature": 0.0})
+                assert r.status == 200, await r.text()
+                done_at[i] = time.monotonic()
+                return await r.json()
+            # long request first so it is admitted before the shorts
+            t_long = asyncio.ensure_future(one(0))
+            await asyncio.sleep(0.05)
+            d1, d2 = await asyncio.gather(one(1), one(2))
+            d0 = await t_long
+            for i, d in enumerate((d0, d1, d2)):
+                assert d["choices"][0]["message"]["content"] == refs[i], i
+                assert d["usage"]["completion_tokens"] >= 1
+            assert done_at[1] < done_at[0] and done_at[2] < done_at[0], \
+                "short chats must complete while the long one decodes"
+        finally:
+            await client.close()
+    _run(scenario())
+
+
+def test_api_stream_engine_path(model, engine):
+    """SSE through the engine: chunked content equals the blocking text,
+    stream terminates with finish_reason + [DONE]."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api import create_app
+    from cake_tpu.models.common.text_model import chat_prompt_ids
+
+    msg = [{"role": "user", "content": "stream me"}]
+    ids = chat_prompt_ids(model.tokenizer, msg)
+    toks = _ref(model, ids, 8)
+    ended = model.cfg.is_eos(toks[-1])
+    want = model.tokenizer.decode(toks[:-1] if ended else toks)
+
+    async def scenario():
+        app = create_app(_api_state(model, engine))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": msg, "max_tokens": 8, "temperature": 0.0,
+                "stream": True})
+            assert r.status == 200
+            body = (await r.read()).decode()
+            chunks = [json.loads(line[6:]) for line in body.split("\n\n")
+                      if line.startswith("data: ") and line != "data: [DONE]"]
+            text = "".join(c["choices"][0]["delta"].get("content", "")
+                           for c in chunks)
+            assert text == want
+            assert chunks[-1]["choices"][0]["finish_reason"] in ("stop",
+                                                                 "length")
+            assert body.strip().endswith("data: [DONE]")
+        finally:
+            await client.close()
+    _run(scenario())
+
+
+def test_api_backpressure_429(model):
+    """Queue saturation answers 429 + Retry-After instead of waiting."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api import create_app
+
+    eng = ServeEngine(model, slots=1, max_queue=1, ctx_len=CTX)
+    try:
+        r_busy = eng.submit(P_LONG, max_new_tokens=180, sampling=GREEDY)
+        while not r_busy.tokens:
+            time.sleep(0.005)
+        r_queued = eng.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+
+        async def scenario():
+            app = create_app(_api_state(model, eng))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.post("/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "x"}]})
+                assert r.status == 429
+                assert int(r.headers["Retry-After"]) >= 1
+                assert "overloaded" in (await r.json())["error"]
+            finally:
+                await client.close()
+        _run(scenario())
+        r_busy.cancel()
+        assert r_queued.wait(120)
+    finally:
+        eng.close()
+
+
+def test_api_disconnect_mid_stream_frees_slot(model, engine):
+    """Closing the SSE connection mid-generation cancels the request and
+    the engine's busy gauge returns to 0 (the acceptance assertion)."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api import create_app
+    from cake_tpu.obs import SERVE_SLOTS_BUSY
+
+    async def scenario():
+        app = create_app(_api_state(model, engine))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "disconnect"}],
+                "max_tokens": 200, "temperature": 0.0, "stream": True})
+            assert r.status == 200
+            await r.content.read(64)          # a few chunks, then vanish
+            deadline = time.monotonic() + 10  # poll past the admission race
+            while SERVE_SLOTS_BUSY.value() < 1 and time.monotonic() < deadline:
+                await asyncio.sleep(0.005)
+            assert SERVE_SLOTS_BUSY.value() >= 1
+            r.close()                          # client disconnect
+        finally:
+            await client.close()
+        deadline = time.monotonic() + 15
+        while SERVE_SLOTS_BUSY.value() != 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert SERVE_SLOTS_BUSY.value() == 0
+    _run(scenario())
+
+
+def test_api_health_and_metrics_engine(model, engine):
+    """/health exposes engine liveness; /metrics carries the serve series
+    after traffic."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api import create_app
+
+    async def scenario():
+        app = create_app(_api_state(model, engine))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0.0})
+            assert r.status == 200
+            r = await client.get("/health")
+            assert r.status == 200
+            h = await r.json()
+            assert h["engine"]["alive"] is True
+            assert h["engine"]["slots"] == 4
+            assert h["engine"]["last_step_age_s"] < 30
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "cake_serve_slots_busy" in text
+            assert "cake_serve_queue_wait_seconds_count" in text
+            assert "cake_serve_batch_occupancy_count" in text
+        finally:
+            await client.close()
+    _run(scenario())
+
+
+def test_stream_leak_fix_cancel_event():
+    """Legacy locked path: abandoning the stream iterator stops the
+    generation worker (no executor thread parked on q.get forever, no
+    decode-to-budget after disconnect)."""
+    from cake_tpu.api.state import run_generation_streamed
+    from cake_tpu.models.common.text_model import Token
+
+    produced = []
+    release = threading.Event()
+
+    class SlowModel:
+        def chat_generate(self, messages, on_token=None, **kw):
+            for i in range(500):
+                release.wait(0.002)
+                on_token(Token(id=i, text=f"t{i}", is_end_of_stream=False))
+                produced.append(i)
+            return list(range(500)), {}
+
+    async def scenario():
+        aiter, result, cancel = run_generation_streamed(
+            SlowModel(), [{"role": "user", "content": "x"}], {})
+        seen = 0
+        async for tok in aiter:
+            seen += 1
+            if seen >= 3:
+                break                     # client walks away mid-stream
+        await aiter.aclose()              # finalizer must cancel the worker
+        assert cancel.is_set()
+        return seen
+    asyncio.new_event_loop().run_until_complete(scenario())
+    n_at_close = len(produced)
+    time.sleep(0.3)
+    assert len(produced) <= n_at_close + 2, "worker kept generating"
+    assert len(produced) < 500

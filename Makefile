@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test bench bench-micro obs-smoke serve-smoke native clean docker
+.PHONY: install test bench bench-micro obs-smoke serve-smoke serve-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -26,10 +26,18 @@ obs-smoke:
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 # continuous-batching gate: concurrent chats 200 through the engine, a 429
-# + Retry-After under queue saturation, and non-zero serve-queue gauges in
-# /metrics while saturated (tiny CPU model, in-process aiohttp)
+# + Retry-After under queue saturation, non-zero serve-queue gauges in
+# /metrics while saturated, and non-zero prefix-cache hits on repeated
+# prompts (tiny CPU model, in-process aiohttp)
 serve-smoke:
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# serve scheduler bench: TTFT p50/p99 + tok/s for a shared-system-prompt
+# workload cold (no prefix cache) vs warm (prefix cached), and the
+# decode-interference probe (tokens still flowing while a long prompt is
+# admitted chunk-by-chunk). Writes BENCH_SERVE_<tag>.json.
+serve-bench:
+	JAX_PLATFORMS=cpu python scripts/serve_bench.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

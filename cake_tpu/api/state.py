@@ -6,7 +6,9 @@ locked path survives as the FALLBACK for distributed/offload models: an
 asyncio.Lock guards the generator and generation runs in a worker thread so
 the event loop keeps streaming SSE chunks while the TPU decodes. Plain
 TextModels instead serve concurrently through `engine` (cake_tpu/serve/),
-which batches all active requests into one decode step per token.
+which batches all active requests into one decode step per token, admits
+prompts in bounded chunks (no full-prompt stall of active decodes) and
+reuses shared-prefix KV across requests (prefix_cache.py).
 """
 from __future__ import annotations
 

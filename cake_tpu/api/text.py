@@ -144,7 +144,8 @@ def _stats_snapshot(stats: dict) -> dict:
     if rid:
         out["request_id"] = rid
     for k in ("ttft_s", "decode_tokens", "decode_s", "tok_per_s",
-              "stage_rtts", "prefill"):
+              "stage_rtts", "prefill", "queue_wait_s", "prefill_chunks",
+              "prefix_hit_tokens"):
         if k in stats:
             out[k] = stats[k]
     return out

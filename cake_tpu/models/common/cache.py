@@ -209,6 +209,74 @@ def slot_assign_layers(cfg: ModelConfig, pool_layers: list[dict],
     return out
 
 
+def slot_extract_block_layers(cfg: ModelConfig, pool_layers: list[dict],
+                              slot, start, width: int,
+                              layer_range: tuple[int, int] | None = None
+                              ) -> list[dict]:
+    """Copy one prefix BLOCK (absolute positions start .. start+width-1) out
+    of pool row `slot` into a batch-1 pytree — the shared-prefix cache's
+    insert path. Must be called right after prefill has advanced the row to
+    exactly start+width:
+
+      * full/SWA layers: gather the block's K/V/pos through the ring map
+        (index = position % buffer); valid as long as width <= the smallest
+        sliding window, which the PrefixCache gates at construction;
+      * linear layers: the conv + recurrent state IS the prefix summary at
+        this boundary, so the snapshot is exact only at the current
+        position — the reason blocks are captured at chunk boundaries
+        during prefill instead of after the fact.
+
+    `slot`/`start` may be traced scalars; `width` is static (one program
+    per block size)."""
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    out = []
+    for i, pl in zip(range(lo, hi), pool_layers):
+        if cfg.layer_spec(i).kind == "linear":
+            out.append({"conv": pl["conv"][slot][None],
+                        "state": pl["state"][slot][None]})
+            continue
+        size = pl["k"].shape[1]
+        idx = (start + jnp.arange(width, dtype=jnp.int32)) % size
+        out.append({"k": pl["k"][slot][idx][None],
+                    "v": pl["v"][slot][idx][None],
+                    "pos": pl["pos"][slot][idx][None]})
+    return out
+
+
+def slot_splice_block_layers(cfg: ModelConfig, pool_layers: list[dict],
+                             src_layers: list[dict], slot, final,
+                             layer_range: tuple[int, int] | None = None
+                             ) -> list[dict]:
+    """Scatter a cached prefix block (slot_extract_block_layers output) into
+    pool row `slot` WITHOUT resetting the rest of the row, so consecutive
+    blocks of a matched prefix chain merge — admission then only prefills
+    the suffix. Entries land at position % row_size (the slot_assign remap);
+    the row must have been wiped at release, so everything outside the
+    spliced prefix is still empty.
+
+    `final` (traced bool): linear-attention conv/recurrent state is a
+    block-END snapshot, so only the LAST block of the chain may install it.
+    """
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    out = []
+    for i, pl, sl in zip(range(lo, hi), pool_layers, src_layers):
+        if cfg.layer_spec(i).kind == "linear":
+            conv = jnp.where(final, sl["conv"][0], pl["conv"][slot])
+            state = jnp.where(final, sl["state"][0], pl["state"][slot])
+            out.append({"conv": pl["conv"].at[slot].set(conv),
+                        "state": pl["state"].at[slot].set(state)})
+            continue
+        size = pl["k"].shape[1]
+        pos = sl["pos"][0]                                 # [width]
+        slots = jnp.where(pos >= 0, pos % size, size)      # OOB -> dropped
+        out.append({
+            "k": pl["k"].at[slot, slots].set(sl["k"][0], mode="drop"),
+            "v": pl["v"].at[slot, slots].set(sl["v"][0], mode="drop"),
+            "pos": pl["pos"].at[slot, slots].set(pos, mode="drop"),
+        })
+    return out
+
+
 def cache_reset(cache: dict) -> dict:
     """Clear all state (ref: cache clear on Goodbye, worker.rs:364-384)."""
     def zero_layer(lc):

@@ -40,7 +40,8 @@ from ...obs import (DECODE_TOKEN_SECONDS, GENERATED_TOKENS, RECORDER,
 from ...ops.sampling import (SamplingConfig, push_recent_token, sample,
                              sample_traced)
 from .cache import (grow_cache, init_cache, kv_capacity, slot_assign_layers,
-                    slot_reset_layers)
+                    slot_extract_block_layers, slot_reset_layers,
+                    slot_splice_block_layers)
 from .config import ModelConfig
 from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
 
@@ -290,7 +291,7 @@ class TextModel:
         @functools.partial(jax.jit, static_argnames=("nb",),
                            donate_argnums=(1, 2, 3, 4, 5))
         def _decode_slots(params, layers, toks, pos, rngs, recents,
-                          temps, top_ks, top_ps, penalties, nb):
+                          temps, top_ks, top_ps, penalties, active, nb):
             """One batched sampled decode step over pool rows 0..nb-1 with
             per-slot positions, RNG keys, recent-token windows and TRACED
             sampling params (sample_traced): the continuous-batching
@@ -303,22 +304,35 @@ class TextModel:
             legacy static-SamplingConfig programs).
 
             The per-slot step is the SAME embed -> layers -> head ->
-            sample pipeline as sampled_step, vmapped over the slot axis:
-            rows are independent, so a free slot in the prefix decodes
-            harmless garbage confined to its own row (wiped by
-            slot_assign on the next admission)."""
-            def one(tok, lcs, p, rng, recent, temp, tk, tp, pen):
+            sample pipeline as sampled_step, vmapped over the slot axis.
+            `active` [B] bool masks rows OUT of the step without changing
+            the executable: an inactive row (free, or mid-way through a
+            CHUNKED admission prefill) runs the forward with valid_len=0 —
+            its KV/conv/recurrent state is left byte-identical (the scatter
+            is dropped, the GDN scan masks the state advance) and its
+            token/pos/rng/recent carries pass through unchanged. That is
+            what lets a chunked prefill build a row IN PLACE across
+            iterations while the surrounding slots keep decoding — decode
+            can never smear a garbage KV entry into a half-built prefix.
+            For an ACTIVE row valid_len=1 is numerically identical to the
+            unmasked step, so greedy parity with the sequential path is
+            untouched."""
+            def one(tok, lcs, p, rng, recent, temp, tk, tp, pen, act):
                 cache = {"layers": jax.tree_util.tree_map(
                     lambda a: a[None], lcs), "pos": p}
                 x = embed_tokens(cfg, params, tok[None, None])
-                x, cache = forward_layers(cfg, params, x, cache, p)
+                x, cache = forward_layers(cfg, params, x, cache, p,
+                                          valid_len=act.astype(jnp.int32))
                 logits = lm_head_logits(cfg, params, x)[0, -1]
-                rng, sk = jax.random.split(rng)
+                rng2, sk = jax.random.split(rng)
                 nxt = sample_traced(logits, sk, temp, tk, tp, pen, recent)
-                recent = push_recent_token(recent, nxt)
+                nxt = jnp.where(act, nxt, tok)
                 return (nxt, jax.tree_util.tree_map(
-                    lambda a: a[0], cache["layers"]), rng, recent)
+                    lambda a: a[0], cache["layers"]),
+                    jnp.where(act, rng2, rng),
+                    jnp.where(act, push_recent_token(recent, nxt), recent))
 
+            step = active[:nb].astype(jnp.int32)
             # the fetch target packs [input token ; sampled token] per slot:
             # a freshly admitted slot's first token (sampled at admission,
             # never fetched — admission stays sync-free) rides the SAME
@@ -330,19 +344,20 @@ class TextModel:
                 # round-tripping through slice copies every token
                 nxt, layers, rngs, recents = jax.vmap(one)(
                     toks, layers, pos, rngs, recents, temps, top_ks,
-                    top_ps, penalties)
-                return (jnp.stack([toks, nxt]), layers, nxt, pos + 1, rngs,
-                        recents)
+                    top_ps, penalties, active)
+                return (jnp.stack([toks, nxt]), layers, nxt, pos + step,
+                        rngs, recents)
             sub = jax.tree_util.tree_map(lambda a: a[:nb], layers)
             nxt, new_sub, new_rngs, new_recents = jax.vmap(one)(
                 toks[:nb], sub, pos[:nb], rngs[:nb], recents[:nb],
-                temps[:nb], top_ks[:nb], top_ps[:nb], penalties[:nb])
+                temps[:nb], top_ks[:nb], top_ps[:nb], penalties[:nb],
+                active[:nb])
             layers = jax.tree_util.tree_map(
                 lambda full, s: full.at[:nb].set(s), layers, new_sub)
             # the whole per-slot carry advances ON DEVICE: the engine ships
             # nothing per iteration and fetches only the packed ids
             return (jnp.stack([toks[:nb], nxt]), layers,
-                    toks.at[:nb].set(nxt), pos.at[:nb].add(1),
+                    toks.at[:nb].set(nxt), pos.at[:nb].add(step),
                     rngs.at[:nb].set(new_rngs),
                     recents.at[:nb].set(new_recents))
 
@@ -354,10 +369,50 @@ class TextModel:
         def _slot_reset(layers, slot):
             return slot_reset_layers(layers, slot)
 
+        @functools.partial(jax.jit, donate_argnums=(2,),
+                           static_argnames=("flash_mode",))
+        def _prefill_slot(params, tokens, layers, slot, pos0, valid_len,
+                          flash_mode):
+            """Prefill one CHUNK of a prompt directly into pool row `slot`
+            at absolute position pos0 — the serve engine's incremental
+            admission unit. The row is gathered to a batch-1 view, run
+            through the same forward_layers as every other prefill program
+            (chunk queries attend over [row prefix ; in-pass chunk], so a
+            prompt split into chunks reproduces the monolithic prefill
+            exactly — the cluster's pipelined prefill pins the same
+            invariant), then scattered back. One executable per
+            (chunk-bucket, flash_mode); slot/pos0/valid_len are traced.
+            Returns (logits at the last valid chunk position, layers)."""
+            row = jax.tree_util.tree_map(lambda a: a[slot][None], layers)
+            x = embed_tokens(cfg, params, tokens)
+            x, rcache = forward_layers(cfg, params, x,
+                                       {"layers": row, "pos": pos0}, pos0,
+                                       valid_len=valid_len,
+                                       flash_mode=flash_mode, mesh=mesh)
+            idx = jnp.clip(valid_len - 1, 0, x.shape[1] - 1)
+            x_last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
+            logits = lm_head_logits(cfg, params, x_last)[:, 0]
+            layers = jax.tree_util.tree_map(
+                lambda full, r: full.at[slot].set(r[0]), layers,
+                rcache["layers"])
+            return logits, layers
+
+        @functools.partial(jax.jit, static_argnames=("width",))
+        def _slot_extract(layers, slot, start, width):
+            return slot_extract_block_layers(cfg, layers, slot, start, width)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _slot_splice(layers, src_layers, slot, final):
+            return slot_splice_block_layers(cfg, layers, src_layers, slot,
+                                            final)
+
         self._prefill = _prefill
         self._decode_slots = _decode_slots
         self._slot_assign = _slot_assign
         self._slot_reset = _slot_reset
+        self._prefill_slot = _prefill_slot
+        self._slot_extract = _slot_extract
+        self._slot_splice = _slot_splice
         self._sample_traced = jax.jit(sample_traced)
         self._decode_chunk = _decode_chunk
         self._decode_until = _decode_until
@@ -384,14 +439,17 @@ class TextModel:
     # -- continuous-batching slot programs (serve engine) -------------------
 
     def decode_slots(self, layers, toks, pos, rngs, recents,
-                     temps, top_ks, top_ps, penalties, nb: int):
+                     temps, top_ks, top_ps, penalties, active, nb: int):
         """One batched sampled decode step over pool rows 0..nb-1.
 
         layers: a pool cache's per-layer list (leaves [B, ...]); toks/pos:
         [B] int32; rngs: [B] PRNG keys; recents: [B, N] int32;
         temps/top_ps/penalties: [B] f32; top_ks: [B] int32 (>= vocab
-        disables). All per-slot carries are device-resident and DONATED —
-        the scheduler keeps passing the returned arrays back in. nb:
+        disables); active: [B] bool — False rows (free, or mid-chunked-
+        prefill) are carried through untouched with their row state left
+        byte-identical. All per-slot carries are device-resident and
+        DONATED except `active` (the scheduler mutates it only at
+        admission/release transitions and keeps its own handle). nb:
         static slot-count bucket (occupied slots must sit below it).
         Returns (packed_ids [2, nb] = [input token ; sampled token] per
         slot — one fetch serves this step's ids AND any just-admitted
@@ -400,7 +458,45 @@ class TextModel:
         """
         return self._decode_slots(self.params, layers, toks, pos, rngs,
                                   recents, temps, top_ks, top_ps, penalties,
-                                  nb=nb)
+                                  active, nb=nb)
+
+    def prefill_chunk(self, layers, slot: int, token_ids, pos0: int):
+        """Prefill one chunk of a prompt into pool row `slot` at absolute
+        position pos0 (the serve engine's incremental admission step; the
+        row must already hold exactly positions 0..pos0-1). The chunk is
+        right-padded to a power-of-two bucket; flash dispatch follows the
+        same host-static select_flash_mode as every other prefill path.
+        Returns (logits [1, V] at the chunk's last valid position — only
+        meaningful when this is the prompt's final chunk — and the updated
+        pool layers)."""
+        ids = np.asarray(list(token_ids), np.int32).ravel()
+        n = int(ids.shape[0])
+        cap = kv_capacity(self.cfg, {"layers": layers})
+        bkt = check_prefill_bounds(n, pos0, cap, self.max_cache_len)
+        padded = np.zeros((1, bkt), np.int32)
+        padded[0, :n] = ids
+        flash_mode = select_flash_mode(pos0, bkt, cap)
+        return self._prefill_slot(self.params, jnp.asarray(padded), layers,
+                                  jnp.asarray(slot, jnp.int32),
+                                  jnp.asarray(pos0, jnp.int32),
+                                  jnp.asarray(n, jnp.int32),
+                                  flash_mode=flash_mode)
+
+    def slot_extract(self, layers, slot: int, start: int, width: int):
+        """Copy the prefix block [start, start+width) out of pool row
+        `slot` as a batch-1 layers pytree (prefix-cache insert). Static
+        width: one executable per block size."""
+        return self._slot_extract(layers, jnp.asarray(slot, jnp.int32),
+                                  jnp.asarray(start, jnp.int32), width=width)
+
+    def slot_splice(self, layers, src_layers, slot: int, final: bool):
+        """Scatter a cached prefix block into pool row `slot` without
+        resetting the rest of the row (prefix-cache hit). `final` marks the
+        last block of the matched chain — the only one whose linear-attn
+        state snapshot is installed."""
+        return self._slot_splice(layers, src_layers,
+                                 jnp.asarray(slot, jnp.int32),
+                                 jnp.asarray(final))
 
     def slot_assign(self, layers, src_cache: dict, slot: int):
         """Re-home a batch-1 prefilled cache into pool row `slot` (row is

@@ -81,6 +81,28 @@ SERVE_BATCH_OCCUPANCY = REGISTRY.histogram(
     "Occupied slots per batched decode iteration",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128))
 
+SERVE_PREFILL_CHUNKS = REGISTRY.histogram(
+    "cake_serve_prefill_chunks",
+    "Prefill chunks per admission (chunked-admission scheduling; 1 = the "
+    "whole prompt fit one chunk)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+
+SERVE_PREFIX_HITS = REGISTRY.counter(
+    "cake_serve_prefix_cache_hits_total",
+    "Admissions that spliced at least one cached prefix block")
+
+SERVE_PREFIX_MISSES = REGISTRY.counter(
+    "cake_serve_prefix_cache_misses_total",
+    "Admissions that found no reusable prefix block")
+
+SERVE_PREFIX_EVICTIONS = REGISTRY.counter(
+    "cake_serve_prefix_cache_evictions_total",
+    "Prefix blocks evicted (LRU) to stay under CAKE_PREFIX_CACHE_MB")
+
+SERVE_PREFIX_BYTES = REGISTRY.gauge(
+    "cake_serve_prefix_cache_bytes",
+    "Device bytes held by cached prefix blocks")
+
 WORKER_HEARTBEAT = REGISTRY.gauge(
     "cake_worker_heartbeat_age_seconds",
     "Seconds since the worker last handled any message, at the last "
@@ -96,5 +118,6 @@ __all__ = [
     "GENERATIONS", "API_REQUESTS", "API_REQUEST_SECONDS",
     "WORKER_FWD_SECONDS", "HOP_SECONDS", "WORKER_HEARTBEAT",
     "SERVE_QUEUE_DEPTH", "SERVE_SLOTS_BUSY", "SERVE_QUEUE_WAIT_SECONDS",
-    "SERVE_BATCH_OCCUPANCY",
+    "SERVE_BATCH_OCCUPANCY", "SERVE_PREFILL_CHUNKS", "SERVE_PREFIX_HITS",
+    "SERVE_PREFIX_MISSES", "SERVE_PREFIX_EVICTIONS", "SERVE_PREFIX_BYTES",
 ]

@@ -1,8 +1,10 @@
 """Continuous-batching serving subsystem: slot-pooled batched decode with
-bounded admission (see docs/serving.md)."""
+bounded admission, chunked prefill and shared-prefix KV reuse (see
+docs/serving.md)."""
 from .admission import AdmissionQueue, QueueFull
 from .engine import ServeEngine, ServeRequest, maybe_engine
+from .prefix_cache import PrefixCache
 from .slots import SlotPool
 
-__all__ = ["AdmissionQueue", "QueueFull", "ServeEngine", "ServeRequest",
-           "SlotPool", "maybe_engine"]
+__all__ = ["AdmissionQueue", "QueueFull", "PrefixCache", "ServeEngine",
+           "ServeRequest", "SlotPool", "maybe_engine"]

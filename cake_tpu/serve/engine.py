@@ -8,18 +8,28 @@ scheduling (Orca, OSDI'22) with a fixed slot pool (vLLM's slot idea minus
 paging — slots here are whole KV rows of a preallocated batch-B cache):
 
   * a bounded admission queue feeds a single scheduler thread;
-  * each iteration ADMITS at most one queued request — bucketed batch-1
-    prefill through the model's existing compiled prefill programs, then
-    slot_assign re-homes the KV into a free pool row — and then runs ONE
-    batched `decode_slots` step over the occupied prefix (per-slot
-    positions, RNG keys, recent-token windows, traced sampling params),
-    fanning each slot's sampled token out to its request's stream;
+  * admission is CHUNKED (Sarathi-Serve, OSDI'24): a queued request takes
+    a free slot immediately (splicing any shared-prefix KV the PrefixCache
+    already holds — see prefix_cache.py), then each iteration advances at
+    most ONE in-flight admission by one CAKE_PREFILL_CHUNK-token chunk
+    (`TextModel.prefill_chunk` scatters straight into the pool row at
+    pos0), round-robin over in-flight prefills so a huge prompt cannot
+    starve the queue behind it;
+  * each iteration also runs ONE batched `decode_slots` step over the
+    occupied prefix (per-slot positions, RNG keys, recent-token windows,
+    traced sampling params, and an `active` mask that freezes rows still
+    mid-prefill), fanning each slot's sampled token out to its request's
+    stream — decode latency under admission is bounded by the CHUNK, not
+    the prompt, which kills the head-of-line blocking a monolithic
+    prefill imposed on every active decode;
   * EOS / budget / client-cancel free the slot for the next admission.
 
 Every jax call happens on the scheduler thread, so the engine needs no
 device-side locking; API handlers only touch thread-safe queues/events.
 Greedy outputs are bit-identical to the sequential path (masked slots
-contribute exactly-zero attention weight), which the tier-1 e2e test pins.
+contribute exactly-zero attention weight; chunked prefill reproduces the
+monolithic program's numerics; a prefix-cache hit splices the exact bytes
+a miss would recompute), which the tier-1 e2e tests pin.
 """
 from __future__ import annotations
 
@@ -32,10 +42,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_QUEUE_WAIT_SECONDS,
-                   SERVE_SLOTS_BUSY, now, set_request_id)
+from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
+                   SERVE_QUEUE_WAIT_SECONDS, SERVE_SLOTS_BUSY, now,
+                   set_request_id)
 from ..ops.sampling import SamplingConfig
 from .admission import AdmissionQueue, QueueFull
+from .prefix_cache import PrefixCache
 from .slots import SlotPool, slot_bucket
 
 __all__ = ["ServeEngine", "ServeRequest", "QueueFull", "maybe_engine"]
@@ -49,6 +61,46 @@ RECENT_N = SamplingConfig().repeat_last_n
 # default pool row length when the model's max_cache_len is unbounded-ish:
 # the pool is B x ctx x layers of KV, allocated up front
 DEFAULT_CTX = 4096
+
+# default per-iteration prefill token budget (CAKE_PREFILL_CHUNK): one
+# chunk of at most this many prompt tokens advances per scheduler
+# iteration, so a decode step is never stalled behind more than one
+# chunk's worth of prefill compute
+DEFAULT_CHUNK = 256
+
+# default shared-prefix KV cache capacity in MB (CAKE_PREFIX_CACHE_MB);
+# 0 disables prefix reuse entirely
+DEFAULT_PREFIX_MB = 256.0
+
+
+def _pow2_chunk(n: int, ctx: int) -> int:
+    """Clamp the prefill chunk to a power of two in [16, ctx] — fixed
+    chunk buckets keep the per-(bucket, flash_mode) executable count at
+    O(log chunk), and block-size == chunk-size keeps prefix-cache splice
+    boundaries aligned with chunk boundaries (the bit-parity invariant)."""
+    n = max(16, min(int(n), ctx))
+    b = 16
+    while b * 2 <= n:
+        b *= 2
+    return b
+
+
+class _Prefill:
+    """Scheduler-private state of one in-flight chunked admission."""
+
+    __slots__ = ("req", "slot", "ids", "n", "pos", "chunks", "next_block",
+                 "hit_tokens", "keys")
+
+    def __init__(self, req: "ServeRequest", slot: int):
+        self.req = req
+        self.slot = slot
+        self.ids = req.prompt_ids
+        self.n = len(self.ids)
+        self.pos = 0            # next prompt position to prefill
+        self.chunks = 0         # chunks dispatched so far
+        self.next_block = 0     # next prefix-cache block index to capture
+        self.hit_tokens = 0     # tokens skipped via prefix-cache splice
+        self.keys: list = []    # per-block hash chain (computed once)
 
 
 class ServeRequest:
@@ -151,7 +203,9 @@ class ServeEngine:
     """Owns the slot pool, the admission queue, and the scheduler thread."""
 
     def __init__(self, model, slots: int = 4, max_queue: int = 64,
-                 ctx_len: int | None = None, seed: int = 0):
+                 ctx_len: int | None = None, seed: int = 0,
+                 prefill_chunk: int | None = None,
+                 prefix_cache_mb: float | None = None):
         if not hasattr(model, "decode_slots"):
             raise TypeError(
                 f"{type(model).__name__} has no batched slot decode; the "
@@ -160,6 +214,15 @@ class ServeEngine:
         self.model = model
         self.slots = slots
         self.ctx = min(ctx_len or DEFAULT_CTX, model.max_cache_len)
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get("CAKE_PREFILL_CHUNK",
+                                               str(DEFAULT_CHUNK)))
+        self.chunk = _pow2_chunk(prefill_chunk, self.ctx)
+        if prefix_cache_mb is None:
+            prefix_cache_mb = float(os.environ.get("CAKE_PREFIX_CACHE_MB",
+                                                   str(DEFAULT_PREFIX_MB)))
+        self.prefix_cache = PrefixCache.build(model, self.ctx, self.chunk,
+                                              prefix_cache_mb)
         self.pool = SlotPool(slots)
         self.queue = AdmissionQueue(max_queue)
 
@@ -181,8 +244,15 @@ class ServeEngine:
         self._rngs = jnp.stack([jax.random.PRNGKey(seed + i)
                                 for i in range(slots)])
         self._recents = jnp.full((slots, RECENT_N), -1, jnp.int32)
+        # decode-eligibility mask: True only for slots whose prefill has
+        # COMPLETED. Mutated at transitions only (prefill done / release),
+        # never donated — the engine keeps its handle across iterations,
+        # so steady-state decode still ships nothing host->device
+        self._act = jnp.zeros((slots,), jnp.bool_)
         self._base_rng = jax.random.PRNGKey(seed)
         self._reqs: list[ServeRequest | None] = [None] * slots
+        self._prefills: list[_Prefill] = []   # in-flight chunked admissions
+        self._rr = 0                          # round-robin cursor over them
         self._seq = 0
 
         self._wake = threading.Event()
@@ -263,15 +333,20 @@ class ServeEngine:
         return aiter(), req.result
 
     def health(self) -> dict:
-        return {
+        h = {
             "alive": self.dead is None and self._thread.is_alive(),
             "slots": self.slots,
             "slots_busy": self.pool.busy_count,
             "queue_depth": self.queue.depth(),
             "ctx_len": self.ctx,
+            "prefill_chunk": self.chunk,
+            "prefilling": len(self._prefills),
             "steps": self.steps,
             "last_step_age_s": round(now() - self.last_step, 3),
         }
+        if self.prefix_cache is not None:
+            h["prefix_cache"] = self.prefix_cache.occupancy()
+        return h
 
     def close(self, timeout: float = 5.0):
         self._stop.set()
@@ -290,6 +365,7 @@ class ServeEngine:
                 if req is not None:
                     self._fail(req, RuntimeError("serve engine shut down"))
             return
+        self._prefills.clear()
         for i, req in enumerate(self._reqs):
             if req is not None:
                 self._finish(i, req, cancelled=True)
@@ -311,6 +387,7 @@ class ServeEngine:
                     self._wake.clear()
         except BaseException as e:  # fail loudly: every waiter is released
             self.dead = e
+            self._prefills.clear()      # their reqs are in _reqs below
             for req in self.queue.drain():
                 self._fail(req, e)
             for i, req in enumerate(self._reqs):
@@ -321,30 +398,71 @@ class ServeEngine:
     def _step(self) -> bool:
         busy = self.pool.busy()
         queued = self.queue.depth() > 0
-        cancels = [i for i in busy if self._reqs[i].cancelled.is_set()]
         if not (busy or queued):
             return False
         with RECORDER.span("serve.step", cat="serve", slots=len(busy),
                            queued=self.queue.depth()):
-            for i in cancels:
-                self._finish(i, self._reqs[i], cancelled=True)
-            # abandoned-while-queued requests must not pin queue capacity
-            # (they would 429 live clients while slots sit idle)
+            # 1. cancel sweeps: decoding slots, mid-prefill slots, and
+            # abandoned-while-queued requests (those would otherwise pin
+            # queue capacity and 429 live clients while slots sit idle)
+            prefilling = {p.slot for p in self._prefills}
+            for i in busy:
+                req = self._reqs[i]
+                if req is not None and req.cancelled.is_set() \
+                        and i not in prefilling:
+                    self._finish(i, req, cancelled=True)
+            for pf in [p for p in self._prefills
+                       if p.req.cancelled.is_set()]:
+                self._abort_prefill(pf, None)
             for req in self.queue.purge(lambda r: r.cancelled.is_set()):
                 self._fail(req, None)
-            if self.pool.free_count > 0:
-                self._admit_one()
-            busy = self.pool.busy()
-            if busy:
-                self._decode(busy)
+            # 2. every queued request takes a free slot NOW (cheap: at
+            # most a prefix-cache splice — the prefill itself is chunked
+            # below), so multiple admissions are in flight concurrently
+            while self.pool.free_count > 0 and self._start_admission():
+                pass
+            # 3. dispatch ONE batched decode step over the slots whose
+            # prefill has completed (mid-prefill rows ride along frozen
+            # under the active mask)...
+            prefilling = {p.slot for p in self._prefills}   # post-admission
+            active = [i for i in self.pool.busy()
+                      if self._reqs[i] is not None and i not in prefilling]
+            packed = None
+            if active:
+                nb = slot_bucket(active[-1] + 1, self.slots)
+                SERVE_BATCH_OCCUPANCY.observe(len(active))
+                (packed, self._layers, self._toks, self._pos, self._rngs,
+                 self._recents) = self.model.decode_slots(
+                    self._layers, self._toks, self._pos, self._rngs,
+                    self._recents, self._temps, self._top_ks, self._top_ps,
+                    self._pens, self._act, nb=nb)
+            # 4. ...then advance at most ONE in-flight admission by one
+            # chunk, round-robin so every queued prompt makes progress.
+            # Dispatch order matters: the decode program is already queued
+            # on the device, so the packed-ids fetch below never waits for
+            # this chunk — on real hardware the chunk overlaps the host's
+            # token fan-out
+            if self._prefills:
+                idx = self._rr % len(self._prefills)
+                if self._advance_prefill(self._prefills[idx]):
+                    self._rr = idx + 1      # still in flight: move past it
+                else:
+                    self._rr = idx          # removed: next job slid here
+            # 5. ONE host fetch per iteration: fan the sampled ids out
+            if packed is not None:
+                self._fanout(active, np.asarray(packed))
         return True
 
-    def _admit_one(self):
-        """Pop the first live queued request and prefill it into a slot."""
+    # -- chunked admission --------------------------------------------------
+
+    def _start_admission(self) -> bool:
+        """Move the first live queued request into a free slot as an
+        in-flight chunked prefill; splice any cached shared prefix so only
+        the suffix needs compute. Returns False when the queue is empty."""
         while True:
             req = self.queue.pop()
             if req is None:
-                return
+                return False
             if req.cancelled.is_set():
                 self._fail(req, None)   # abandoned while queued
                 continue
@@ -356,70 +474,121 @@ class ServeEngine:
         # _reqs and releases its waiter instead of hanging the client
         self._reqs[slot] = req
         req.slot = slot
-        n = len(req.prompt_ids)
-        scfg = req.sampling
-        set_request_id(req.id)      # prefill spans attribute to the request
+        req.stats = {"queue_wait_s": now() - req.t_enqueue}
+        pf = _Prefill(req, slot)
+        set_request_id(req.id)
         try:
-            with RECORDER.span("serve.prefill", cat="serve", tokens=n,
-                               slot=slot):
-                from ..models.common.text_model import bucket_for
-                cache1 = self.model.new_cache(
-                    1, kv_len=bucket_for(n, self.ctx))
-                logits, cache1 = self.model.prefill(cache1, req.prompt_ids)
-                self._layers = self.model.slot_assign(self._layers, cache1,
-                                                      slot)
-            rng = jax.random.fold_in(self._base_rng, self._seq)
-            self._seq += 1
-            rng, sk = jax.random.split(rng)
-            recent = jnp.full((RECENT_N,), -1, jnp.int32)
-            # first token stays ON DEVICE: admission performs no host
-            # sync — the id rides the next decode iteration's packed
-            # fetch (through a high-latency device link every per-token
-            # fetch costs a fixed RTT; admissions must not add one each)
-            tid = self.model.sample_one(
-                logits[0], sk, jnp.float32(scfg.temperature),
-                jnp.int32(scfg.top_k or self._vocab),
-                jnp.float32(scfg.top_p if scfg.top_p is not None else 1.0),
-                jnp.float32(scfg.repeat_penalty), recent)
-            self._rngs = self._rngs.at[slot].set(rng)
-            self._recents = self._recents.at[slot].set(
-                recent.at[-1].set(tid))
-            self._toks = self._toks.at[slot].set(tid)
-            self._pos = self._pos.at[slot].set(n)
-            self._temps = self._temps.at[slot].set(scfg.temperature)
-            self._top_ks = self._top_ks.at[slot].set(
-                scfg.top_k or self._vocab)
-            self._top_ps = self._top_ps.at[slot].set(
-                scfg.top_p if scfg.top_p is not None else 1.0)
-            self._pens = self._pens.at[slot].set(scfg.repeat_penalty)
+            if self.prefix_cache is not None:
+                pf.keys = self.prefix_cache.chain_keys(pf.ids)
+                matched = self.prefix_cache.match(pf.ids, pf.keys)
+                if matched:
+                    self._layers = self.prefix_cache.splice(
+                        self._layers, slot, pf.keys, matched)
+                    pf.pos = matched * self.chunk
+                    pf.next_block = matched
+                    pf.hit_tokens = pf.pos
         except Exception as e:
-            self._reqs[slot] = None
-            self.pool.free(slot)
-            self._fail(req, e)
-            return
+            self._abort_prefill(pf, e, register=False)
+            return True
         finally:
             set_request_id(None)
-        req.budget = min(req.max_new_tokens - 1, self.ctx - n - 1)
+        self._prefills.append(pf)
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        return True
+
+    def _advance_prefill(self, pf: _Prefill) -> bool:
+        """Prefill ONE chunk of an in-flight admission into its pool row;
+        capture any block the chunk completed into the prefix cache; on
+        the final chunk, sample the first token and activate the slot for
+        decode. Returns True while the job remains in flight."""
+        take = min(self.chunk, pf.n - pf.pos)
+        set_request_id(pf.req.id)
+        try:
+            with RECORDER.span("serve.prefill_chunk", cat="serve",
+                               tokens=take, pos0=pf.pos, slot=pf.slot):
+                logits, self._layers = self.model.prefill_chunk(
+                    self._layers, pf.slot, pf.ids[pf.pos:pf.pos + take],
+                    pf.pos)
+            pf.pos += take
+            pf.chunks += 1
+            # a chunk boundary at a block multiple completed a new block;
+            # capture it while the row state IS that exact prefix (the
+            # linear-attention snapshot is only right at this boundary).
+            # The last prompt token is never cached (its logits must be
+            # computed live to seed sampling), hence the n-1 cap.
+            if self.prefix_cache is not None:
+                while (pf.next_block + 1) * self.chunk <= min(pf.pos,
+                                                              pf.n - 1):
+                    self.prefix_cache.insert(self._layers, pf.slot, pf.ids,
+                                             pf.next_block, pf.keys)
+                    pf.next_block += 1
+            if pf.pos >= pf.n:
+                self._complete_prefill(pf, logits)
+                return False
+            return True
+        except Exception as e:
+            self._abort_prefill(pf, e)
+            return False
+        finally:
+            set_request_id(None)
+
+    def _complete_prefill(self, pf: _Prefill, logits):
+        """Final chunk done: sample the first token (device-resident — it
+        rides the next decode iteration's packed fetch) and hand the slot
+        to the batched decode."""
+        req, slot, scfg = pf.req, pf.slot, pf.req.sampling
+        rng = jax.random.fold_in(self._base_rng, self._seq)
+        self._seq += 1
+        rng, sk = jax.random.split(rng)
+        recent = jnp.full((RECENT_N,), -1, jnp.int32)
+        tid = self.model.sample_one(
+            logits[0], sk, jnp.float32(scfg.temperature),
+            jnp.int32(scfg.top_k or self._vocab),
+            jnp.float32(scfg.top_p if scfg.top_p is not None else 1.0),
+            jnp.float32(scfg.repeat_penalty), recent)
+        self._rngs = self._rngs.at[slot].set(rng)
+        self._recents = self._recents.at[slot].set(recent.at[-1].set(tid))
+        self._toks = self._toks.at[slot].set(tid)
+        self._pos = self._pos.at[slot].set(pf.n)
+        self._temps = self._temps.at[slot].set(scfg.temperature)
+        self._top_ks = self._top_ks.at[slot].set(scfg.top_k or self._vocab)
+        self._top_ps = self._top_ps.at[slot].set(
+            scfg.top_p if scfg.top_p is not None else 1.0)
+        self._pens = self._pens.at[slot].set(scfg.repeat_penalty)
+        self._act = self._act.at[slot].set(True)
+        self._prefills.remove(pf)
+        req.budget = min(req.max_new_tokens - 1, self.ctx - pf.n - 1)
         req._first_pending = True       # emitted at the next decode fetch
         # ttft_s is stamped when the first token is FETCHED (everything
         # above is an async dispatch — stamping here would understate the
-        # client's real wait); queue wait is the pop-to-enqueue delta
-        req.stats = {"queue_wait_s": now() - req.t_enqueue}
-        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        # client's real wait)
+        req.stats["prefill_chunks"] = pf.chunks
+        req.stats["prefix_hit_tokens"] = pf.hit_tokens
+        SERVE_PREFILL_CHUNKS.observe(max(pf.chunks, 1))
 
-    def _decode(self, busy: list[int]):
-        """One batched decode step over the occupied prefix."""
-        nb = slot_bucket(busy[-1] + 1, self.slots)
-        SERVE_BATCH_OCCUPANCY.observe(len(busy))
-        (packed, self._layers, self._toks, self._pos, self._rngs,
-         self._recents) = self.model.decode_slots(
-            self._layers, self._toks, self._pos, self._rngs, self._recents,
-            self._temps, self._top_ks, self._top_ps, self._pens, nb=nb)
-        # ONE host fetch per iteration: row 0 carries each slot's input
-        # token (a just-admitted slot's unemitted FIRST token), row 1 the
-        # token this step sampled
-        arr = np.asarray(packed)
-        for i in busy:
+    def _abort_prefill(self, pf: _Prefill, error: BaseException | None,
+                       register: bool = True):
+        """Tear down a mid-prefill admission (client cancel or device
+        failure): release the waiter, free the slot, wipe the half-built
+        row. The wipe comes LAST and is allowed to raise — splice and
+        prefill_chunk assume a clean row, so a failed wipe must kill the
+        engine (the crash handler releases everyone) rather than silently
+        hand ghost KV to the row's next occupant."""
+        if register:
+            self._prefills.remove(pf)
+        self._reqs[pf.slot] = None
+        self.pool.free(pf.slot)
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        self._fail(pf.req, error)
+        self._layers = self.model.slot_release(self._layers, pf.slot)
+
+    # -- batched decode -----------------------------------------------------
+
+    def _fanout(self, active: list[int], arr: np.ndarray):
+        """Fan one decode iteration's packed ids out to the streams: row 0
+        carries each slot's input token (a just-activated slot's unemitted
+        FIRST token), row 1 the token this step sampled."""
+        for i in active:
             req = self._reqs[i]
             if req._first_pending:
                 req._first_pending = False
@@ -449,13 +618,14 @@ class ServeEngine:
         self._reqs[slot] = None
         if release:
             # wipe the row so a cancelled/finished request's KV never
-            # lingers into the next occupant's prefix, and pin its
-            # position back to 0 so an idle row inside the decode prefix
-            # can't drift past the rope table (freed rows still step —
-            # their garbage is confined to their own row)
+            # lingers into the next occupant's prefix (prefix-cache splice
+            # and chunked prefill both assume a clean row), and drop the
+            # slot from the active mask — a freed row inside the decode
+            # prefix is frozen outright, not stepped
             self._layers = self.model.slot_release(self._layers, slot)
             self._toks = self._toks.at[slot].set(0)
             self._pos = self._pos.at[slot].set(0)
+            self._act = self._act.at[slot].set(False)
         dt = now() - req.t_first if req.t_first else 0.0
         ndec = max(len(req.tokens) - 1, 0)
         req.stats.update({
@@ -475,7 +645,9 @@ class ServeEngine:
         if error is not None:
             req.result["error"] = error
         req.result.setdefault("tokens", req.tokens)
-        req.result.setdefault("stats", {})
+        # keep whatever stats accrued (queue_wait_s, prefill progress) —
+        # failed/cancelled requests are the ones worth diagnosing
+        req.result.setdefault("stats", req.stats)
         req._deliver(ServeRequest.DONE)
         req._fire_done()
 
@@ -485,8 +657,11 @@ def maybe_engine(model, slots: int | None = None,
                  ctx_len: int | None = None) -> ServeEngine | None:
     """Engine for serve-capable models, tuned by env: CAKE_SERVE_SLOTS
     (default 4, 0 disables), CAKE_MAX_QUEUE (default 64), CAKE_SERVE_CTX
-    (default 4096, capped by the model's max_cache_len). Distributed /
-    offloaded models return None — the API keeps its locked fallback."""
+    (default 4096, capped by the model's max_cache_len), CAKE_PREFILL_CHUNK
+    (default 256 — per-iteration chunked-admission token budget) and
+    CAKE_PREFIX_CACHE_MB (default 256, 0 disables shared-prefix KV reuse;
+    both read inside ServeEngine). Distributed / offloaded models return
+    None — the API keeps its locked fallback."""
     from ..models.common.text_model import TextModel
     if not isinstance(model, TextModel):
         return None

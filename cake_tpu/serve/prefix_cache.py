@@ -1,0 +1,178 @@
+"""Shared-prefix KV cache: device-resident LRU of prefill'd prefix blocks.
+
+Chat traffic shares its system prompt across requests, and the engine used
+to recompute the identical prefix KV on every admission. This cache keeps
+that work (RadixAttention's insight — SGLang, Zheng et al. 2023 — minus
+the radix tree): the prompt's prefix is cut into fixed BLOCK-sized pieces
+at chunked-prefill boundaries, each block's KV (plus the linear-attention
+conv/recurrent state snapshot at the block's end) is copied out of the
+pool row right after the chunk that completed it, and a later admission
+whose prompt starts with the same tokens splices the matched chain back
+into its row and prefills only the suffix.
+
+Matching is a hash CHAIN, which gives longest-prefix-match without a trie:
+block b's key is blake2b(prompt[: (b+1)*block]) — equal key chains iff
+equal prefixes — so lookup walks b = 0, 1, ... until the first miss. The
+stored token prefix is compared on every hit, so a hash collision can
+degrade performance but never output correctness. Reuse is capped at
+n-1 tokens: the final prompt token is always prefilled live, because its
+logits seed the first sampled token.
+
+Capacity is CAKE_PREFIX_CACHE_MB of device bytes (LRU over blocks; a
+middle eviction just shortens the matchable chain). Everything here runs
+on the engine's scheduler thread — no locking; the entries are plain jnp
+arrays, so eviction is a dict pop and the buffers free with their last
+reference.
+
+Greedy outputs are BIT-identical between a hit and a miss: splicing
+copies the exact bytes prefill wrote, and the suffix chunks land on the
+same chunk-bucket boundaries either way (block size == chunk size), so
+every matmul sees the same shapes and inputs.
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..obs import (SERVE_PREFIX_BYTES, SERVE_PREFIX_EVICTIONS,
+                   SERVE_PREFIX_HITS, SERVE_PREFIX_MISSES)
+
+__all__ = ["PrefixCache"]
+
+
+@dataclass
+class _Block:
+    tokens: np.ndarray      # the FULL prefix this block completes (verify)
+    layers: list            # batch-1 layers pytree, this block's KV + state
+    nbytes: int
+
+
+def _tree_bytes(layers) -> int:
+    total = 0
+    for lc in layers:
+        for buf in lc.values():
+            total += int(np.prod(buf.shape)) * buf.dtype.itemsize
+    return total
+
+
+class PrefixCache:
+    """LRU of prefix blocks for ONE engine (scheduler-thread only)."""
+
+    def __init__(self, model, block: int, capacity_bytes: int):
+        self.model = model
+        self.block = block
+        self.capacity = capacity_bytes
+        self._blocks: OrderedDict[bytes, _Block] = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @classmethod
+    def build(cls, model, ctx: int, block: int,
+              capacity_mb: float) -> "PrefixCache | None":
+        """None when disabled (capacity <= 0) or structurally unsound: a
+        sliding window smaller than the block would evict a block's own
+        entries from the ring before they could be extracted, and
+        linear-attention snapshots need the block to fit the row."""
+        if capacity_mb <= 0 or block > ctx:
+            return None
+        for spec in model.cfg.layer_specs():
+            if spec.window is not None and spec.window < block:
+                return None
+        return cls(model, block, int(capacity_mb * 1024 * 1024))
+
+    # -- admission-side API -------------------------------------------------
+
+    def chain_keys(self, prompt_ids: list[int]) -> list[bytes]:
+        """Key of every block this prompt could match OR contribute
+        ((n-1)//block of them — reuse keeps >= 1 live suffix token, and
+        the same cap bounds what prefill can capture). One incremental
+        blake2b pass per ADMISSION; the engine holds the list for the
+        admission's lifetime so match/splice/insert never re-hash."""
+        ids = np.asarray(prompt_ids, np.int32)
+        h = hashlib.blake2b(digest_size=16)
+        keys = []
+        for b in range((len(ids) - 1) // self.block):
+            h.update(ids[b * self.block:(b + 1) * self.block].tobytes())
+            keys.append(h.digest())
+        return keys
+
+    def match(self, prompt_ids: list[int], keys: list[bytes]) -> int:
+        """Longest cached block chain usable for this prompt, in BLOCKS
+        (0 = miss). Refreshes LRU recency of every matched block and
+        records the hit/miss counters — except for prompts structurally
+        too short to ever hit (<= block tokens, zero keys), which would
+        otherwise skew the hit ratio an operator sizes the cache by."""
+        if not keys:
+            return 0
+        ids = np.asarray(prompt_ids, np.int32)
+        matched = 0
+        for key in keys:
+            blk = self._blocks.get(key)
+            if blk is None or not np.array_equal(
+                    blk.tokens, ids[:len(blk.tokens)]):
+                break
+            self._blocks.move_to_end(key)
+            matched += 1
+        if matched:
+            self.hits += 1
+            SERVE_PREFIX_HITS.inc()
+        else:
+            self.misses += 1
+            SERVE_PREFIX_MISSES.inc()
+        return matched
+
+    def splice(self, layers, slot: int, keys: list[bytes], matched: int):
+        """Write the matched chain's KV into pool row `slot` (row must be
+        freshly wiped). Returns the updated pool layers."""
+        for b in range(matched):
+            layers = self.model.slot_splice(
+                layers, self._blocks[keys[b]].layers, slot,
+                final=(b == matched - 1))
+        return layers
+
+    def insert(self, layers, slot: int, prompt_ids: list[int],
+               block_index: int, keys: list[bytes]) -> None:
+        """Capture block `block_index` out of row `slot`. Must be called at
+        the chunk boundary that completed the block — the row then holds
+        exactly prefix_len tokens, so the linear-attention snapshot is the
+        exact prefix state. Dedupes on key; evicts LRU past capacity."""
+        end = (block_index + 1) * self.block
+        ids = np.asarray(prompt_ids[:end], np.int32)
+        key = keys[block_index]
+        if key in self._blocks:
+            self._blocks.move_to_end(key)
+            return
+        entry_layers = self.model.slot_extract(
+            layers, slot, block_index * self.block, self.block)
+        blk = _Block(tokens=ids, layers=entry_layers,
+                     nbytes=_tree_bytes(entry_layers))
+        if blk.nbytes > self.capacity:
+            return                          # could never fit; don't thrash
+        while self.bytes + blk.nbytes > self.capacity and self._blocks:
+            _, old = self._blocks.popitem(last=False)
+            self.bytes -= old.nbytes
+            self.evictions += 1
+            SERVE_PREFIX_EVICTIONS.inc()
+        self._blocks[key] = blk
+        self.bytes += blk.nbytes
+        SERVE_PREFIX_BYTES.set(self.bytes)
+
+    # -- introspection ------------------------------------------------------
+
+    def occupancy(self) -> dict:
+        return {
+            "blocks": len(self._blocks),
+            "block_tokens": self.block,
+            "bytes": self.bytes,
+            "capacity_bytes": self.capacity,
+            "utilization": round(self.bytes / self.capacity, 4)
+            if self.capacity else 0.0,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }

@@ -1,0 +1,254 @@
+"""Shared-prefix KV cache (ISSUE 3): block extract/splice cache ops, the
+hash-chain LRU, hit-vs-miss bit parity through the serve engine, and
+eviction-under-pressure correctness — all on the tiny CPU model."""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models import TextModel, tiny_config
+from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.serve import PrefixCache, ServeEngine
+
+GREEDY = SamplingConfig(temperature=0.0)
+CTX = 128
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                           max_cache_len=CTX)
+    return _MODEL
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+def _ref(model, prompt, n):
+    toks, _ = model.generate(list(prompt), max_new_tokens=n, sampling=GREEDY)
+    return toks
+
+
+PROMPT = [3 + (i * 7) % 200 for i in range(50)]
+
+
+# ---------------------------------------------------------------------------
+# cache ops: extract / splice roundtrip (no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_slot_extract_splice_roundtrip(model):
+    """Blocks copied out of a prefilled row and spliced into a clean row of
+    ANOTHER pool reproduce the original prefix bytes exactly, leave the
+    rest of the row empty, and touch no neighbor."""
+    chunk = 16
+    layers = model.new_cache(3, kv_len=64)["layers"]
+    for s in range(0, 32, chunk):
+        _, layers = model.prefill_chunk(layers, 1, PROMPT[s:s + chunk], s)
+    blocks = [model.slot_extract(layers, 1, b * chunk, chunk)
+              for b in range(2)]
+    for b, blk in enumerate(blocks):
+        for lc in blk:
+            np.testing.assert_array_equal(
+                np.asarray(lc["pos"][0]),
+                np.arange(b * chunk, (b + 1) * chunk))
+
+    layers2 = model.new_cache(3, kv_len=64)["layers"]
+    layers2 = model.slot_splice(layers2, blocks[0], 2, final=False)
+    layers2 = model.slot_splice(layers2, blocks[1], 2, final=True)
+    for lc_src, lc_dst in zip(layers, layers2):
+        np.testing.assert_array_equal(np.asarray(lc_src["k"][1, :32]),
+                                      np.asarray(lc_dst["k"][2, :32]))
+        np.testing.assert_array_equal(np.asarray(lc_src["v"][1, :32]),
+                                      np.asarray(lc_dst["v"][2, :32]))
+        np.testing.assert_array_equal(np.asarray(lc_dst["pos"][2, :32]),
+                                      np.arange(32))
+        assert int(jnp.max(lc_dst["pos"][2, 32:])) == -1
+        assert float(jnp.abs(lc_dst["k"][0]).max()) == 0.0   # neighbors
+        assert float(jnp.abs(lc_dst["k"][1]).max()) == 0.0
+
+
+def test_spliced_prefix_continues_bitwise(model):
+    """Prefilling the SUFFIX on top of a spliced prefix yields the same
+    final logits as prefilling the whole prompt into the row — the
+    hit-path numerics are the miss-path numerics."""
+    chunk = 16
+    miss = model.new_cache(2, kv_len=64)["layers"]
+    for s in range(0, len(PROMPT), chunk):
+        ref_logits, miss = model.prefill_chunk(miss, 0,
+                                               PROMPT[s:s + chunk], s)
+    blocks = [model.slot_extract(miss, 0, b * chunk, chunk)
+              for b in range(3)]
+    hit = model.new_cache(2, kv_len=64)["layers"]
+    for b, blk in enumerate(blocks):
+        hit = model.slot_splice(hit, blk, 1, final=(b == 2))
+    hit_logits, hit = model.prefill_chunk(hit, 1, PROMPT[48:], 48)
+    np.testing.assert_array_equal(np.asarray(hit_logits),
+                                  np.asarray(ref_logits))
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_build_gating(model):
+    assert PrefixCache.build(model, CTX, 16, 0) is None        # disabled
+    assert PrefixCache.build(model, CTX, CTX * 2, 64) is None  # block > ctx
+    pc = PrefixCache.build(model, CTX, 16, 64)
+    assert pc is not None and pc.block == 16
+
+
+def test_prefix_cache_match_requires_live_suffix(model):
+    """Reuse is capped at n-1 tokens: a prompt exactly equal to a cached
+    chain still prefills its final token live (its logits seed sampling)."""
+    pc = PrefixCache.build(model, CTX, 16, 64)
+    layers = model.new_cache(2, kv_len=64)["layers"]
+    for s in range(0, 32, 16):
+        _, layers = model.prefill_chunk(layers, 0, PROMPT[s:s + 16], s)
+    keys = pc.chain_keys(PROMPT)
+    pc.insert(layers, 0, PROMPT, 0, keys)
+    pc.insert(layers, 0, PROMPT, 1, keys)
+    assert len(pc._blocks) == 2
+
+    def match(p):
+        return pc.match(p, pc.chain_keys(p))
+    assert match(PROMPT[:50]) == 2           # 32 < 50-1: both blocks usable
+    assert match(PROMPT[:33]) == 2           # 32 == 33-1: still ok
+    assert match(PROMPT[:32]) == 1           # full match would leave 0 live
+    assert match(PROMPT[:16] + [9] * 16) == 1      # diverges after block 0
+    assert match([9] * 40) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine e2e: hit == miss, eviction under pressure
+# ---------------------------------------------------------------------------
+
+
+def test_engine_prefix_hit_matches_miss(model):
+    """The tentpole acceptance pin on the HIT side: greedy output is
+    bit-identical whether the prefix was spliced from cache or computed,
+    and the stats/metrics record the reuse."""
+    ref = _ref(model, PROMPT, 10)
+    eng = ServeEngine(model, slots=2, max_queue=4, ctx_len=CTX,
+                      prefill_chunk=16, prefix_cache_mb=64)
+    try:
+        r1 = eng.submit(PROMPT, max_new_tokens=10, sampling=GREEDY)
+        assert r1.wait(120)
+        assert r1.result["tokens"] == ref
+        assert r1.stats["prefix_hit_tokens"] == 0
+        assert r1.stats["prefill_chunks"] == 4
+
+        r2 = eng.submit(PROMPT, max_new_tokens=10, sampling=GREEDY)
+        assert r2.wait(120)
+        assert r2.result["tokens"] == ref                  # bit-identical
+        assert r2.stats["prefix_hit_tokens"] == 48         # 3 blocks of 16
+        assert r2.stats["prefill_chunks"] == 1             # suffix only
+
+        # divergent suffix sharing 32 leading tokens: partial chain reuse
+        p3 = PROMPT[:32] + [9, 9, 4, 4, 1]
+        r3 = eng.submit(p3, max_new_tokens=10, sampling=GREEDY)
+        assert r3.wait(120)
+        assert r3.result["tokens"] == _ref(model, p3, 10)
+        assert r3.stats["prefix_hit_tokens"] == 32
+
+        occ = eng.health()["prefix_cache"]
+        assert occ["hits"] == 2 and occ["blocks"] >= 3
+        assert occ["bytes"] > 0
+    finally:
+        eng.close()
+
+
+def test_engine_prefix_eviction_under_pressure(model):
+    """A capacity small enough for ~2 blocks forces LRU evictions while
+    distinct prefixes stream through; outputs stay correct before, during
+    and after eviction (a shortened chain only costs compute)."""
+    eng = ServeEngine(model, slots=1, max_queue=8, ctx_len=CTX,
+                      prefill_chunk=16, prefix_cache_mb=0.04)
+    try:
+        prompts = [[5 + j] * 1 + [(j * 31 + i * 7) % 200 + 3
+                                  for i in range(39)] for j in range(3)]
+        refs = [_ref(model, p, 6) for p in prompts]
+        for p, want in zip(prompts, refs):
+            r = eng.submit(p, max_new_tokens=6, sampling=GREEDY)
+            assert r.wait(120)
+            assert r.result["tokens"] == want
+        occ = eng.health()["prefix_cache"]
+        assert occ["evictions"] > 0, occ
+        assert occ["bytes"] <= occ["capacity_bytes"]
+        # the first prefix was evicted: resubmitting it must still be
+        # correct (miss or partial hit, never wrong)
+        r = eng.submit(prompts[0], max_new_tokens=6, sampling=GREEDY)
+        assert r.wait(120)
+        assert r.result["tokens"] == refs[0]
+    finally:
+        eng.close()
+
+
+def test_engine_prefix_cache_disabled(model):
+    eng = ServeEngine(model, slots=1, max_queue=2, ctx_len=CTX,
+                      prefill_chunk=16, prefix_cache_mb=0)
+    try:
+        assert eng.prefix_cache is None
+        r = eng.submit(PROMPT, max_new_tokens=6, sampling=GREEDY)
+        assert r.wait(120)
+        assert r.result["tokens"] == _ref(model, PROMPT, 6)
+        assert "prefix_cache" not in eng.health()
+    finally:
+        eng.close()
+
+
+def test_engine_prefix_hit_matches_miss_gdn():
+    """Same hit==miss pin through a qwen3_5-style model with LINEAR
+    (GDN) layers: the per-block conv/recurrent-state snapshot — captured
+    at the chunk boundary, installed only from the final matched block —
+    must reproduce the sequential path bit-for-bit too."""
+    m = TextModel(tiny_config("qwen3_5"), dtype=jnp.float32,
+                  max_cache_len=CTX)
+    prompt = [3 + (i * 11) % 200 for i in range(40)]
+    ref, _ = m.generate(list(prompt), max_new_tokens=6, sampling=GREEDY)
+    eng = ServeEngine(m, slots=2, max_queue=4, ctx_len=CTX,
+                      prefill_chunk=16, prefix_cache_mb=64)
+    try:
+        r1 = eng.submit(prompt, max_new_tokens=6, sampling=GREEDY)
+        assert r1.wait(300)
+        assert r1.result["tokens"] == ref
+        assert r1.stats["prefix_hit_tokens"] == 0
+        r2 = eng.submit(prompt, max_new_tokens=6, sampling=GREEDY)
+        assert r2.wait(300)
+        assert r2.result["tokens"] == ref                  # bit-identical
+        assert r2.stats["prefix_hit_tokens"] == 32         # 2 blocks of 16
+    finally:
+        eng.close()
+
+
+def test_engine_cancel_mid_prefill_frees_slot(model):
+    """Cancelling a request while its CHUNKED prefill is still in flight
+    aborts the admission, wipes the half-built row and frees the slot."""
+    eng = ServeEngine(model, slots=1, max_queue=2, ctx_len=CTX,
+                      prefill_chunk=16, prefix_cache_mb=0)
+    try:
+        long_prompt = [3 + (i * 13) % 200 for i in range(120)]
+        r = eng.submit(long_prompt, max_new_tokens=6, sampling=GREEDY)
+        deadline = time.monotonic() + 30
+        while not eng.health()["prefilling"] and time.monotonic() < deadline:
+            time.sleep(0.001)
+        r.cancel()
+        assert r.wait(30)
+        assert not r.tokens
+        deadline = time.monotonic() + 30
+        while eng.pool.busy_count and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.pool.busy_count == 0
+        # the slot is clean: the next request reproduces the reference
+        r2 = eng.submit(PROMPT, max_new_tokens=6, sampling=GREEDY)
+        assert r2.wait(120)
+        assert r2.result["tokens"] == _ref(model, PROMPT, 6)
+    finally:
+        eng.close()

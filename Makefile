@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test bench bench-micro obs-smoke serve-smoke serve-bench native clean docker
+.PHONY: install test bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -31,6 +31,13 @@ obs-smoke:
 # prompts (tiny CPU model, in-process aiohttp)
 serve-smoke:
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# fault-tolerance gate: master + 2 real workers on localhost, one worker
+# killed mid-stream by a deterministic fault plan — the generation must
+# complete bit-identical to the unfailed run with exactly one replay
+# prefill, and the recovery counters must be non-zero in /metrics
+chaos-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
 # serve scheduler bench: TTFT p50/p99 + tok/s for a shared-system-prompt
 # workload cold (no prefix cache) vs warm (prefix cached), and the

@@ -86,14 +86,23 @@ def worker_health(model) -> list[dict]:
             pending = last_attempt > last_ok   # newest forward unanswered
             failing = (last_attempt - last_ok > STALE_WORKER_S
                        or (pending and t - last_attempt > STALE_WORKER_S))
-        out.append({
+        entry = {
             "name": getattr(s.runner, "name", "?"),
             "layers": [s.start, s.end],
             "last_ok_age_s": None if last_ok is None
             else round(t - last_ok, 3),
             "failing": failing,
             "ops": getattr(s.runner, "total_ops", 0),
-        })
+        }
+        # gray failure: slow-but-alive — ops succeed but the rolling RTT
+        # p95 sits above CAKE_HOP_DEGRADED_MS. Surfaced BEFORE the per-op
+        # deadline turns the slowness into a hard failure; never a 503 on
+        # its own (a slow cluster still serves)
+        if getattr(s.runner, "degraded_ms", 0) > 0:
+            entry["degraded"] = bool(getattr(s.runner, "gray_degraded",
+                                             False))
+            entry["rtt_p95_ms"] = s.runner.rtt_p95_ms()
+        out.append(entry)
     return out
 
 
@@ -121,8 +130,27 @@ async def health(request: web.Request) -> web.Response:
         "models": [m["id"] + ":" + m["kind"] for m in state.owned_models()],
         "workers": workers,
         "stale_workers": stale,
+        # gray failures: flagged, never 503 — a slow cluster still serves,
+        # and a liveness probe must not restart it for being slow
+        "degraded_workers": [w["name"] for w in workers
+                             if w.get("degraded")],
         "device": _device_health(),
     }
+    if getattr(state, "draining", False):
+        body["draining"] = True
+    # hard cluster degradation: a worker is quarantined with the recovery
+    # retry budget exhausted — requests fail fast (ClusterDegradedError),
+    # so the balancer should route elsewhere until the restore loop
+    # revives the worker. This one IS a 503.
+    dead = getattr(state.model, "degraded", None)
+    if dead:
+        degraded = True
+        body["cluster"] = {
+            "degraded": True,
+            "worker": dead["worker"],
+            "down_for_s": round(now() - dead["since"], 1),
+            "error": dead["error"],
+        }
     engine = getattr(state, "engine", None)
     if engine is not None:
         # continuous-batching engine liveness: a dead scheduler thread, or

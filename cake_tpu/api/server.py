@@ -17,8 +17,10 @@ Routes:
 """
 from __future__ import annotations
 
+import asyncio
 import base64
 import logging
+import os
 import time
 
 from aiohttp import web
@@ -101,9 +103,39 @@ def create_app(state: ApiState, basic_auth: str | None = None) -> web.Applicatio
     return app
 
 
+async def graceful_drain(app: web.Application):
+    """SIGTERM/SIGINT drain (runs as aiohttp's on_shutdown, i.e. after the
+    listener stopped accepting but while in-flight handlers still run):
+    stop admission — new chat requests on kept-alive connections answer
+    503 + Retry-After — let active slots finish up to CAKE_DRAIN_TIMEOUT_S,
+    then close the engine so whatever is left gets its final chunks
+    instead of a severed socket."""
+    state = app["state"]
+    state.draining = True
+    engine = getattr(state, "engine", None)
+    if engine is None:
+        return
+    timeout = float(os.environ.get("CAKE_DRAIN_TIMEOUT_S", "30"))
+    log.info("draining serve engine (up to %.0fs): %d busy, %d queued",
+             timeout, engine.pool.busy_count, engine.queue.depth())
+    # drain() busy-waits — keep the event loop free to stream the final
+    # SSE chunks of exactly the requests being drained
+    loop = asyncio.get_running_loop()
+    clean = await loop.run_in_executor(None, lambda: engine.drain(timeout))
+    if not clean:
+        log.warning("drain timed out; failing remaining requests")
+    engine.close()
+
+
 def serve(state: ApiState, host: str = "0.0.0.0", port: int = 8000,
           basic_auth: str | None = None):
     """Blocking server entry (ref: `cake serve`)."""
     app = create_app(state, basic_auth)
+    # graceful drain on SIGTERM/SIGINT (web.run_app installs the signal
+    # handlers; on_shutdown runs after the listener stops accepting).
+    # Registered HERE and not in create_app: the server entry owns the
+    # engine's lifecycle — an embedding test/app closing its TestClient
+    # must not drain an engine it merely borrowed.
+    app.on_shutdown.append(graceful_drain)
     log.info("serving API on http://%s:%d", host, port)
     web.run_app(app, host=host, port=port, print=None)

@@ -46,6 +46,10 @@ class ApiState:
     # continuous-batching engine (cake_tpu/serve/) — set for plain
     # TextModels; None keeps every request on the locked fallback path
     engine: Any = None
+    # graceful-shutdown drain (SIGTERM/SIGINT): while True, new chat
+    # requests on kept-alive connections answer 503 + Retry-After and
+    # active generations run to completion (up to CAKE_DRAIN_TIMEOUT_S)
+    draining: bool = False
     created: int = 0
 
     def owned_models(self) -> list[dict]:

@@ -20,7 +20,7 @@ from aiohttp import web
 
 from ..obs import GENERATIONS, current_request_id, set_request_id
 from ..ops.sampling import SamplingConfig
-from ..serve import QueueFull
+from ..serve import EngineDraining, QueueDeadlineExceeded, QueueFull
 from .state import (ApiState, run_blocking, run_generation_blocking,
                     run_generation_streamed)
 
@@ -77,6 +77,25 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     state: ApiState = request.app["state"]
     if state.model is None:
         return web.json_response({"error": "no text model loaded"}, status=503)
+    if state.draining:
+        # graceful shutdown in progress: requests arriving on kept-alive
+        # connections are shed so the balancer fails them over while
+        # in-flight generations finish their final chunks
+        return web.json_response(
+            {"error": "server draining for shutdown"},
+            status=503, headers={"Retry-After": "5"})
+    degraded = getattr(state.model, "degraded", None)
+    if degraded:
+        # quarantined worker with the recovery retry budget exhausted:
+        # fail fast with the SAME 503 on every path — the streaming path
+        # would otherwise have committed to a 200 SSE response before
+        # generate() could raise, hiding the reroute signal from the
+        # balancer (the restore loop clears the flag when the worker
+        # comes back)
+        return web.json_response(
+            {"error": f"cluster degraded: worker {degraded['worker']} "
+                      "down; recovery in progress"},
+            status=503, headers={"Retry-After": "10"})
     try:
         body = await request.json()
     except Exception:
@@ -193,6 +212,15 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
             state.last_stats = _stats_snapshot(stats)
         except Exception as e:
             GENERATIONS.inc(kind="text", status="error")
+            # lazy import, error path only: the API layer must not drag
+            # the whole cluster subpackage (and faults.py's CAKE_FAULT_PLAN
+            # env activation) into single-node servers at import time
+            from ..cluster.master import ClusterDegradedError
+            if isinstance(e, ClusterDegradedError):
+                # typed fast-fail: a worker is quarantined with its retry
+                # budget spent — 503 (retryable elsewhere), not a 500
+                return web.json_response({"error": str(e)}, status=503,
+                                         headers={"Retry-After": "10"})
             return web.json_response({"error": f"generation failed: {e}"},
                                      status=500)
     GENERATIONS.inc(kind="text", status="ok")
@@ -227,11 +255,32 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         return web.json_response(
             {"error": "server overloaded: admission queue full"},
             status=429, headers={"Retry-After": str(e.retry_after_s)})
+    except EngineDraining as e:
+        return web.json_response(
+            {"error": str(e)}, status=503,
+            headers={"Retry-After": str(e.retry_after_s)})
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
     except RuntimeError as e:               # engine dead
         return web.json_response({"error": str(e)}, status=503)
     if stream:
+        # with a queue deadline armed, don't commit to a 200 SSE while the
+        # request can still be shed: wait for admission (or a terminal
+        # failure) first, so an expired wait answers the documented 503 +
+        # Retry-After instead of an in-band error chunk no balancer sees
+        if state.engine.queue_deadline_s > 0:
+            try:
+                while not (req.admitted.is_set() or req.done.is_set()):
+                    await asyncio.sleep(0.02)
+            except asyncio.CancelledError:
+                req.cancel()            # client gone while queued
+                raise
+            err = req.result.get("error")
+            if isinstance(err, QueueDeadlineExceeded):
+                GENERATIONS.inc(kind="text", status="error")
+                return web.json_response(
+                    {"error": str(err)}, status=503,
+                    headers={"Retry-After": str(err.retry_after_s)})
         aiter, result = state.engine.stream(req)
         return await _sse_drain(request, state, cid, aiter, result,
                                 req.cancel)
@@ -255,10 +304,16 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         req.cancel()                        # client gone: free the slot
         raise
     if "error" in req.result:
+        err = req.result["error"]
         GENERATIONS.inc(kind="text", status="error")
+        if isinstance(err, QueueDeadlineExceeded):
+            # the client's patience is presumed spent; 503 tells honest
+            # retriers to come back rather than blaming the request
+            return web.json_response(
+                {"error": str(err)}, status=503,
+                headers={"Retry-After": str(err.retry_after_s)})
         return web.json_response(
-            {"error": f"generation failed: {req.result['error']}"},
-            status=500)
+            {"error": f"generation failed: {err}"}, status=500)
     GENERATIONS.inc(kind="text", status="ok")
     stats = req.result.get("stats", {})
     state.last_stats = _stats_snapshot(stats)

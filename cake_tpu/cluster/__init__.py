@@ -4,12 +4,13 @@ weight streaming, master/worker/client (ref: cake-core/src/cake/sharding/).
 Pipeline-style layer sharding over the LAN — the reference's core strategy
 (SURVEY §2g) — with each node's contiguous range compiled to one XLA call.
 """
+from . import faults
 from .auth import AuthError, cluster_hash
-from .client import RemoteStage
+from .client import RemoteStage, StageFailure
 from .discovery import (WorkerAdvertiser, detect_capabilities,
                         discover_workers)
-from .master import (DistributedTextModel, MasterSetup, Stage,
-                     master_setup, plan_assignments)
+from .master import (ClusterDegradedError, DistributedTextModel, MasterSetup,
+                     Stage, master_setup, plan_assignments)
 from .strategy import DefaultStrategy, WorkerCapacity, estimate_layer_bytes
 from .topology import Node, Topology, expand_layer_specs
 from .worker import WorkerServer, run_worker

@@ -10,19 +10,44 @@ pipeline is a chain), so async buys nothing on this path.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import time
 
 import numpy as np
 
-from ..obs import HOP_SECONDS, now
-from . import proto
+from ..obs import CLUSTER_HOP_DEGRADED, CLUSTER_STAGE_FAILURES, HOP_SECONDS, now
+from . import faults, proto
 from .auth import AuthError, _mac, CHALLENGE_LEN, MAC_LEN
 
 log = logging.getLogger("cake_tpu.client")
 
 CONNECT_RETRIES = 3          # ref: sharding/mod.rs:385-431 exp backoff
 CONNECT_BACKOFF = 1.0
+
+# rolling window the gray-failure detector computes its RTT p95 over, and
+# the minimum samples before it may trip (one slow op is noise, not gray)
+GRAY_WINDOW = 64
+GRAY_MIN_SAMPLES = 4
+
+
+class StageFailure(RuntimeError):
+    """One classified failure of a remote hop. `kind` drives the recovery
+    policy and the failure-counter labels:
+
+      timeout       per-op deadline expired (worker stalled or wedged)
+      eof           peer closed the connection (worker crash / drop)
+      conn          other transport failure (refused, reset, no channel)
+      corrupt       undecodable / desynced frame
+      worker_error  the worker answered worker_error (op failed in-place;
+                    the connection itself stayed up)
+    """
+
+    def __init__(self, kind: str, worker: str, detail: str):
+        super().__init__(f"worker {worker}: {kind}: {detail}")
+        self.kind = kind
+        self.worker = worker
+        self.detail = detail
 
 
 class RemoteStage:
@@ -31,11 +56,29 @@ class RemoteStage:
     SETUP_TIMEOUT = 1800.0   # weight load + whole-range XLA compile
 
     def __init__(self, host: str, port: int, cluster_key: str,
-                 name: str = "?", timeout: float = 120.0):
+                 name: str = "?", timeout: float | None = None):
         self.host, self.port = host, port
         self.cluster_key = cluster_key
         self.name = name
-        self.timeout = timeout
+        # per-op deadline: every forward's socket reads must complete
+        # within this, or the op is classified `timeout` and recovery
+        # takes over (CAKE_HOP_TIMEOUT_S; generous default — LAN/TPU
+        # tunnels sit at 66-90ms RTT, so even seconds is "stalled")
+        self.timeout = timeout if timeout is not None else float(
+            os.environ.get("CAKE_HOP_TIMEOUT_S", "120"))
+        # gray-failure threshold: rolling RTT p95 above this flags the hop
+        # degraded in /health WITHOUT failing anything (0 = disabled)
+        self.degraded_ms = float(os.environ.get("CAKE_HOP_DEGRADED_MS",
+                                                "0") or 0)
+        # the FIRST forward after a reestablish() may include an in-band
+        # XLA compile on the freshly re-assigned worker (warm="decode"/
+        # "none", or a shape outside the warm sweep) — it gets this grace
+        # deadline instead of the per-op one, or a tight CAKE_HOP_TIMEOUT_S
+        # would kill every replay and burn the retry budget on a healthy
+        # worker
+        self.revive_grace_s = float(os.environ.get("CAKE_REVIVE_GRACE_S",
+                                                   "60"))
+        self._revive_grace = False
         self.sock: socket.socket | None = None
         self.info: dict = {}
         self._rid = 0
@@ -50,28 +93,39 @@ class RemoteStage:
         self.last_attempt: float | None = None
         self.last_ok: float | None = None
         self.total_ops = 0          # cumulative successes (never cleared)
+        # recovery memory, filled in by master_setup: the assignment to
+        # replay on reconnect and a weight-repush thunk for the (rare)
+        # case the worker lost its content-keyed cache too
+        self.assignment: dict | None = None
+        self.repush = None
 
     # -- connection --------------------------------------------------------
 
-    def connect(self):
+    def connect(self, attempts: int | None = None,
+                backoff: float | None = None):
+        """Connect + mutual auth + hello. Recovery passes attempts=1 and
+        runs its own jittered backoff around the call."""
+        attempts = CONNECT_RETRIES if attempts is None else max(attempts, 1)
+        backoff = CONNECT_BACKOFF if backoff is None else backoff
         last = None
-        for attempt in range(CONNECT_RETRIES):
+        for attempt in range(attempts):
             try:
                 self.sock = socket.create_connection(
                     (self.host, self.port), timeout=self.timeout)
                 self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                faults.tag(self.sock, self.name)
                 self._auth()
                 proto.write_frame_sync(self.sock, proto.hello("master"))
                 self.info = proto.read_frame_sync(self.sock)
                 return self
-            except (OSError, AuthError) as e:
+            except (OSError, AuthError, proto.ProtocolError) as e:
                 last = e
                 if self.sock:
                     self.sock.close()
                     self.sock = None
-                if attempt == CONNECT_RETRIES - 1:
+                if attempt == attempts - 1:
                     break               # no dead wait after the final attempt
-                wait = CONNECT_BACKOFF * (2 ** attempt)
+                wait = backoff * (2 ** attempt)
                 log.warning("connect to %s:%d failed (%s), retry in %.1fs",
                             self.host, self.port, e, wait)
                 time.sleep(wait)
@@ -95,9 +149,36 @@ class RemoteStage:
         while len(buf) < n:
             chunk = self.sock.recv(n - len(buf))
             if not chunk:
-                raise ConnectionError("socket closed during auth")
+                # a truncated handshake IS an auth failure (the worker
+                # bailed after a bad MAC) — same mapping as auth._read
+                raise AuthError("peer closed during auth handshake")
             buf += chunk
         return buf
+
+    def reestablish(self):
+        """One reconnect + re-auth + re-assign + ready cycle from the
+        remembered assignment — the recovery path's revive step. The
+        weight push is skipped when the worker still acks its
+        content-keyed cache (`transfer_cached`); a worker that lost the
+        cache too gets the weights re-streamed via the repush thunk."""
+        self.close()
+        self.connect(attempts=1)
+        if self.assignment is None:
+            return self
+        resp = self.assign(self.assignment)
+        if resp.get("t") == "worker_error":
+            raise RuntimeError(
+                f"worker {self.name} re-assign failed: {resp['error']}")
+        if self.assignment.get("push_weights") and not resp.get("cached",
+                                                                False):
+            if self.repush is None:
+                raise RuntimeError(
+                    f"worker {self.name} lost its weight cache and no "
+                    "repush source is available")
+            self.repush(self, resp)
+        self.wait_ready()
+        self._revive_grace = True
+        return self
 
     # -- setup -------------------------------------------------------------
 
@@ -127,29 +208,93 @@ class RemoteStage:
     def forward_hidden(self, x, cache, pos0, valid_len, kv_hint=None):
         """cache is managed worker-side per connection; the local `cache`
         slot is passed through untouched (None). kv_hint: master's current
-        cache bucket, so the worker sizes its cache to match."""
+        cache bucket, so the worker sizes its cache to match.
+
+        Every failure mode surfaces as a classified StageFailure so the
+        master's recovery loop (master._recover) can decide policy; after
+        a transport-level failure the channel is closed — its stream state
+        is unknowable, and a late reply would desync request ids."""
         self._rid += 1
         t0 = now()
         self.last_attempt = t0
-        proto.write_frame_sync(self.sock, proto.forward(
-            np.asarray(x), int(pos0),
-            None if valid_len is None else int(valid_len), self._rid,
-            kv_hint=kv_hint))
-        msg = proto.read_frame_sync(self.sock)
+        graced = False
+        try:
+            if self.sock is None:
+                raise self._classify("conn", "not connected", close=False)
+            if self._revive_grace:
+                self._revive_grace = False
+                graced = True
+                self.sock.settimeout(max(self.timeout, self.revive_grace_s))
+            proto.write_frame_sync(self.sock, proto.forward(
+                np.asarray(x), int(pos0),
+                None if valid_len is None else int(valid_len), self._rid,
+                kv_hint=kv_hint))
+            msg = proto.read_frame_sync(self.sock)
+        except StageFailure:
+            raise
+        except (socket.timeout, TimeoutError) as e:
+            raise self._classify("timeout", e, close=True) from e
+        except ConnectionError as e:
+            raise self._classify("eof", e, close=True) from e
+        except OSError as e:
+            raise self._classify("conn", e, close=True) from e
+        except proto.ProtocolError as e:
+            raise self._classify("corrupt", e, close=True) from e
+        finally:
+            if graced and self.sock is not None:
+                self.sock.settimeout(self.timeout)
         rtt = now() - t0
         if msg.get("t") == "worker_error":
-            raise RuntimeError(f"worker {self.name}: {msg['error']}")
+            # the op failed in-place but the connection loop is alive
+            # (ref: worker.rs:425-431) — no teardown
+            raise self._classify("worker_error", msg["error"], close=False)
         if msg.get("rid", self._rid) != self._rid:
-            raise proto.ProtocolError("response id mismatch")
+            raise self._classify("corrupt", "response id mismatch",
+                                 close=True)
         # successful replies only: error RTTs would pollute the wire stats
         tm = dict(msg.get("tm") or {})
         if "fwd_ms" not in tm and msg.get("fwd_ms"):
             tm["fwd_ms"] = float(msg["fwd_ms"])   # pre-echo workers
-        self.rtts.append((rtt, tm))
+        if not graced:
+            # the graced post-revive op may carry a multi-second in-band
+            # compile — one such sample would pin the rolling p95 and
+            # false-flag a freshly recovered hop as gray for a whole
+            # window
+            self.rtts.append((rtt, tm))
         self.last_ok = now()
         self.total_ops += 1
         self._observe_hop(rtt, tm)
+        if self.degraded_ms > 0:
+            CLUSTER_HOP_DEGRADED.set(1.0 if self.gray_degraded else 0.0,
+                                     worker=self.name)
         return proto.unpack_tensor(msg["x"]), cache
+
+    def _classify(self, kind: str, detail, close: bool) -> StageFailure:
+        CLUSTER_STAGE_FAILURES.inc(worker=self.name, kind=kind)
+        if close:
+            self.close()
+        return StageFailure(kind, self.name, str(detail))
+
+    # -- gray-failure detection --------------------------------------------
+
+    def rtt_p95_ms(self) -> float | None:
+        """Rolling p95 over the most recent GRAY_WINDOW successful ops."""
+        rtts = [r for r, _ in list(self.rtts)[-GRAY_WINDOW:]]
+        if not rtts:
+            return None
+        arr = sorted(rtts)
+        return round(arr[min(int(len(arr) * 0.95), len(arr) - 1)] * 1e3, 2)
+
+    @property
+    def gray_degraded(self) -> bool:
+        """True while the hop is slow-but-alive: ops succeed, but the
+        rolling RTT p95 exceeds CAKE_HOP_DEGRADED_MS. Surfaces in /health
+        (and the cake_cluster_hop_degraded gauge) BEFORE a hard per-op
+        deadline turns the slowness into a request failure."""
+        if self.degraded_ms <= 0 or len(self.rtts) < GRAY_MIN_SAMPLES:
+            return False
+        p95 = self.rtt_p95_ms()
+        return p95 is not None and p95 > self.degraded_ms
 
     def _observe_hop(self, rtt: float, tm: dict):
         """Feed the per-hop histograms: whole RTT, each worker-echoed phase,
@@ -206,11 +351,19 @@ class RemoteStage:
         return out
 
     def goodbye(self):
+        """Best-effort clear of per-connection worker state. Teardown must
+        never raise: a timeout, protocol desync, or half-dead socket here
+        would otherwise propagate out of master_setup's cleanup (masking
+        the original error) or abort an unrelated reset. A channel that
+        fails its goodbye is closed — its stream state is unknown, and the
+        next forward's `conn` failure routes it into recovery."""
+        if self.sock is None:
+            return
         try:
             proto.write_frame_sync(self.sock, proto.goodbye())
             proto.read_frame_sync(self.sock)
-        except OSError:
-            pass
+        except Exception:
+            self.close()
 
     def close(self):
         if self.sock:

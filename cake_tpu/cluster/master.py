@@ -16,6 +16,10 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
+import random
+import threading
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -24,22 +28,35 @@ import numpy as np
 
 from ..models.common.cache import init_cache
 from ..models.common.config import ModelConfig
-from ..models.common.layers import (embed_tokens, forward_layers,
-                                    lm_head_logits)
 from ..models.common.text_model import (PREFILL_BUCKETS, PREFILL_CHUNK,
                                         LocalStage, Token,
                                         _observe_generation, bucket_for,
                                         check_prefill_bounds,
+                                        initial_kv_bucket,
                                         select_flash_mode)
-from ..obs import RECORDER, now
+from ..models.common.layers import (embed_tokens, forward_layers,
+                                    lm_head_logits)
+from ..obs import (CLUSTER_DEGRADED, CLUSTER_RECONNECTS, CLUSTER_REPLAYS,
+                   RECORDER, now)
 from ..ops.sampling import SamplingConfig, push_recent_token, sample
 from .auth import cluster_hash
-from .client import RemoteStage
+from .client import RemoteStage, StageFailure
 from .strategy import DefaultStrategy, WorkerCapacity, estimate_layer_bytes
 from .topology import Topology
 from . import proto, transfer
 
 log = logging.getLogger("cake_tpu.master")
+
+# cap on the recovery reconnect backoff — failures past the first few
+# retries are probed by the background restore loop instead
+RECOVERY_BACKOFF_CAP_S = 10.0
+
+
+class ClusterDegradedError(RuntimeError):
+    """A worker is down and the recovery retry budget is exhausted: the
+    request fails FAST (instead of hanging on reconnect loops), /health
+    answers 503, and the background restore loop keeps probing the dead
+    worker so a later request can succeed."""
 
 
 @dataclass
@@ -59,9 +76,36 @@ class DistributedTextModel:
     def __init__(self, cfg: ModelConfig, master_params: dict,
                  stages: list[Stage], tokenizer=None, dtype=jnp.bfloat16,
                  max_cache_len: int = 2048, seed: int = 42, mesh=None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 recovery_retries: int | None = None,
+                 recovery_backoff_s: float | None = None,
+                 restore_interval_s: float | None = None):
         self.cfg = cfg
         self.stages = stages
+        # mid-stream fault tolerance: how many quarantine->reconnect->
+        # replay cycles one generation may spend before failing fast
+        # (CAKE_RECOVERY_RETRIES), the base of the capped-exponential
+        # jittered reconnect backoff (CAKE_RECOVERY_BACKOFF_S), and the
+        # background restore loop's probe interval once degraded
+        # (CAKE_RESTORE_INTERVAL_S)
+        self.recovery_retries = recovery_retries if recovery_retries \
+            is not None else int(os.environ.get("CAKE_RECOVERY_RETRIES", "3"))
+        self.recovery_backoff_s = recovery_backoff_s if recovery_backoff_s \
+            is not None else float(os.environ.get("CAKE_RECOVERY_BACKOFF_S",
+                                                  "0.5"))
+        self.restore_interval_s = restore_interval_s if restore_interval_s \
+            is not None else float(os.environ.get("CAKE_RESTORE_INTERVAL_S",
+                                                  "5"))
+        # {worker, since, error} while a worker is quarantined with the
+        # retry budget exhausted; /health 503s on it and generate() fails
+        # fast until the restore loop revives the worker
+        self.degraded: dict | None = None
+        self._restore_thread: threading.Thread | None = None
+        self._revive_lock = threading.Lock()
+        self._recoveries = 0            # per-generation, surfaced in stats
+        self._replays = 0
+        self._gen_prompt: list[int] = []   # recorded token sequence the
+        self._gen_out: list[int] = []      # rebuild-by-replay replays
         self.tokenizer = tokenizer
         self.dtype = dtype
         # clamp like TextModel: positions past max_seq_len would silently
@@ -263,16 +307,24 @@ class DistributedTextModel:
     def generate(self, prompt_ids: list[int], max_new_tokens: int = 256,
                  sampling: SamplingConfig | None = None, on_token=None,
                  rng=None, **_):
+        # a degraded cluster fails FAST: the retry budget was already
+        # spent, and the background restore loop owns the dead worker —
+        # burning every request's latency on doomed reconnects would turn
+        # one dead node into a full outage
+        if self.degraded is not None:
+            d = self.degraded
+            raise ClusterDegradedError(
+                f"cluster degraded: worker {d['worker']} down for "
+                f"{now() - d['since']:.0f}s ({d['error']}); "
+                "restore loop is probing")
         scfg = sampling or SamplingConfig()
         rng = self._rng if rng is None else rng
         # initial bucket covers prompt + first sampled token + a short run
         # of decode (same sizing idea as TextModel's first_span): the first
         # growth — a realloc on master AND every worker — should not land
         # within the opening tokens of decode
-        from ..models.common.text_model import DECODE_HEADROOM
-        span = 1 + min(max_new_tokens, DECODE_HEADROOM)
-        self.reset(kv_len=bucket_for(len(prompt_ids) + span,
-                                     self.max_cache_len))
+        self.reset(kv_len=initial_kv_bucket(len(prompt_ids), max_new_tokens,
+                                            self.max_cache_len))
         # per-generation RTT windows: the stats this generate returns (and
         # /api/v1/stats re-serves as "last generation") must not blend in
         # samples from earlier generations
@@ -280,11 +332,20 @@ class DistributedTextModel:
             if s.kind == "remote":
                 s.runner.rtts.clear()
         out: list[int] = []
+        # recovery bookkeeping: the recorded token sequence is exactly
+        # what rebuild-by-replay prefills after a worker loss (`out` is
+        # aliased, so appends below keep the record current)
+        self._gen_prompt = list(prompt_ids)
+        self._gen_out = out
+        self._recoveries = self._replays = 0
         recent = jnp.full((max(scfg.repeat_last_n, 1),), -1, jnp.int32)
 
         t0 = now()
         with RECORDER.span("prefill", cat="gen", tokens=len(prompt_ids)):
-            logits = self.prefill_logits(prompt_ids)
+            try:
+                logits = self.prefill_logits(prompt_ids)
+            except StageFailure as e:
+                logits = self._recover(e, max_new_tokens)
         with RECORDER.span("sample", cat="phase"):
             rng, sk = jax.random.split(rng)
             tok = self._sample(logits[0], sk, recent, scfg)
@@ -304,7 +365,13 @@ class DistributedTextModel:
             if pos + 1 > self._kv_len:
                 self._grow_local(bucket_for(pos + 2, self.max_cache_len))
             with RECORDER.span("decode_token", cat="gen", pos=pos):
-                logits = self.decode_logits(tid, pos)
+                try:
+                    logits = self.decode_logits(tid, pos)
+                except StageFailure as e:
+                    # replay leaves every cache holding positions
+                    # 0..pos and returns exactly the logits this failed
+                    # decode owed — the loop continues none the wiser
+                    logits = self._recover(e, max_new_tokens - len(out))
                 with RECORDER.span("sample", cat="phase"):
                     rng, sk = jax.random.split(rng)
                     tok = self._sample(logits[0], sk, recent, scfg)
@@ -318,12 +385,122 @@ class DistributedTextModel:
         stats = {"ttft_s": ttft, "decode_tokens": len(out) - 1,
                  "decode_s": dt, "prefill": dict(self._last_prefill),
                  "tok_per_s": (len(out) - 1) / dt if dt > 0 else 0.0,
+                 "recoveries": self._recoveries, "replays": self._replays,
                  "stage_rtts": {
                      f"{s.runner.name}[{s.start}:{s.end}]":
                          s.runner.rtt_stats()
                      for s in self.stages if s.kind == "remote"}}
         _observe_generation(stats, len(out), path="cluster")
         return out, stats
+
+    # -- mid-stream fault recovery ------------------------------------------
+
+    def _remote_stage(self, worker: str) -> Stage | None:
+        return next((s for s in self.stages
+                     if s.kind == "remote" and s.runner.name == worker), None)
+
+    def _recover(self, failure: StageFailure, remaining_new: int):
+        """Quarantine the failed stage, reconnect with capped exponential
+        backoff + jitter (re-auth + re-assign; weight push skipped while
+        the worker acks its content-keyed cache), then rebuild ALL stage
+        caches with one replay prefill. Returns the logits the failed op
+        owed. Retry budget exhausted => mark the cluster degraded and
+        raise ClusterDegradedError."""
+        worker = failure.worker
+        last: Exception = failure
+        log.warning("stage failure (%s): %s — starting recovery",
+                    failure.kind, failure)
+        for attempt in range(self.recovery_retries):
+            if attempt:
+                wait = min(self.recovery_backoff_s * (2 ** (attempt - 1)),
+                           RECOVERY_BACKOFF_CAP_S)
+                # jitter so a fleet of masters doesn't reconnect-stampede
+                # a worker that just came back
+                time.sleep(wait * random.uniform(0.75, 1.25))
+            if isinstance(last, StageFailure):
+                worker = last.worker
+            try:
+                stage = self._remote_stage(worker)
+                if stage is not None:
+                    with RECORDER.span("recover", cat="gen", worker=worker,
+                                       attempt=attempt):
+                        with self._revive_lock:
+                            stage.runner.reestablish()
+                    CLUSTER_RECONNECTS.inc(worker=worker)
+                    log.info("worker %s reconnected (attempt %d)", worker,
+                             attempt + 1)
+                logits = self._replay(remaining_new)
+                self._recoveries += 1
+                return logits
+            except (StageFailure, OSError, RuntimeError,
+                    proto.ProtocolError) as e:
+                log.warning("recovery attempt %d/%d for %s failed: %s",
+                            attempt + 1, self.recovery_retries, worker, e)
+                last = e
+        self._mark_degraded(worker, last)
+        raise ClusterDegradedError(
+            f"worker {worker} unrecoverable after "
+            f"{self.recovery_retries} attempts: {last}") from last
+
+    def _replay(self, remaining_new: int):
+        """Rebuild-by-replay: worker KV is per-connection and died with
+        the socket, so every stage cache is reset and the recorded token
+        sequence (prompt + everything generated so far) is replayed
+        through ONE pipeline prefill. The final position's logits are
+        exactly what the failed op would have produced — greedy
+        continuation is bit-identical to an unfailed run, and recovery
+        costs one prefill no matter when the failure hit."""
+        seq = self._gen_prompt + self._gen_out
+        self.reset(kv_len=initial_kv_bucket(len(seq), remaining_new,
+                                            self.max_cache_len))
+        with RECORDER.span("replay_prefill", cat="gen", tokens=len(seq)):
+            logits = self.prefill_logits(seq)
+        self._replays += 1
+        CLUSTER_REPLAYS.inc()
+        return logits
+
+    def _mark_degraded(self, worker: str, error: Exception):
+        self.degraded = {"worker": worker, "since": now(),
+                         "error": str(error)}
+        CLUSTER_DEGRADED.set(1.0)
+        log.error("cluster degraded: worker %s unrecoverable (%s); "
+                  "restore loop probing every %.1fs", worker, error,
+                  self.restore_interval_s)
+        if self._restore_thread is None or not self._restore_thread.is_alive():
+            self._restore_thread = threading.Thread(
+                target=self._restore_loop, daemon=True, name="cake-restore")
+            self._restore_thread.start()
+
+    def _restore_loop(self):
+        """Background probe of the quarantined worker: on success the
+        degraded flag clears and the NEXT request proceeds normally (its
+        reset/prefill rebuilds all state — no replay needed between
+        requests)."""
+        while True:
+            info = self.degraded
+            if info is None:
+                return
+            time.sleep(self.restore_interval_s)
+            info = self.degraded
+            if info is None:
+                return
+            stage = self._remote_stage(info["worker"])
+            if stage is None:
+                self.degraded = None
+                CLUSTER_DEGRADED.set(0.0)
+                return
+            try:
+                with self._revive_lock:
+                    stage.runner.reestablish()
+                CLUSTER_RECONNECTS.inc(worker=info["worker"])
+                self.degraded = None
+                CLUSTER_DEGRADED.set(0.0)
+                log.info("worker %s restored; cluster healthy again",
+                         info["worker"])
+                return
+            except Exception as e:
+                log.debug("restore probe for %s failed: %s",
+                          info["worker"], e)
 
     def _mk_token(self, tid: int) -> Token:
         text = None
@@ -437,6 +614,13 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
             # shape during setup so serving never pays an in-band compile;
             # "decode": smallest-bucket decode only (fast setup); "none"
             assignment["warm"] = warm
+            # recovery memory: a mid-generation reconnect replays this
+            # exact assignment (the worker's content-keyed weight cache
+            # makes the push a no-op; the repush thunk covers a worker
+            # that lost the cache too, e.g. a rebuilt host)
+            client.assignment = assignment
+            client.repush = functools.partial(_repush_weights, model_dir,
+                                              names)
             resp = client.assign(assignment)
             if resp.get("t") == "worker_error":
                 raise RuntimeError(f"worker {name}: {resp['error']}")
@@ -518,6 +702,23 @@ def master_setup(model_dir: str, cluster_key: str, cfg: ModelConfig,
 
 def transfer_cached(ack_msg: dict) -> bool:
     return bool(ack_msg.get("cached", False))
+
+
+def _repush_weights(model_dir: str, names: list[str], client: RemoteStage,
+                    ack: dict) -> None:
+    """Recovery-path weight re-stream for a worker that lost its content-
+    keyed cache: reopen the checkpoint and synthesize the client's layer
+    subset again (master_setup's storage handle is long closed by the
+    time a mid-generation reconnect needs this)."""
+    from ..utils.safetensors_io import TensorStorage
+    storage = TensorStorage.from_model_dir(model_dir)
+    try:
+        start_off = (ack.get("resume") or {}).get("model.safetensors", 0)
+        total, chunks = transfer.synthesize_safetensors(storage, names)
+        client.push_weights(transfer.encode_chunks(
+            "model.safetensors", total, chunks, start_offset=start_off))
+    finally:
+        storage.close()
 
 
 def _contiguous(layers: list[int]) -> list[tuple[int, int]]:

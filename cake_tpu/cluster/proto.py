@@ -40,6 +40,12 @@ MAGIC = 0x54504B31          # "TPK1"
 MAX_FRAME = 512 * 1024 * 1024   # ref: proto/mod.rs 512 MB cap
 _HDR = struct.Struct("<II")
 
+# fault-injection seam (cluster/faults.py installs a FaultInjector here;
+# None in production — one attribute check per frame). Write hooks fire
+# before the frame hits the wire, read hooks see (and may corrupt) the
+# raw payload before decode.
+FAULT_HOOK = None
+
 
 class ProtocolError(Exception):
     pass
@@ -103,7 +109,12 @@ def encode_frame(msg: dict) -> bytes:
 
 
 def decode_payload(payload: bytes) -> dict:
-    return msgpack.unpackb(payload, raw=False)
+    try:
+        return msgpack.unpackb(payload, raw=False)
+    except Exception as e:
+        # garbage on the wire (bit flips, desynced stream) must surface as
+        # a classifiable protocol failure, not a raw msgpack internal
+        raise ProtocolError(f"undecodable frame: {e}") from e
 
 
 async def read_frame(reader: asyncio.StreamReader) -> dict:
@@ -123,11 +134,15 @@ async def read_frame_timed(reader: asyncio.StreamReader
     t0 = now()
     payload = await reader.readexactly(length)
     t1 = now()
+    if FAULT_HOOK is not None:
+        payload = FAULT_HOOK.on_read(reader, payload)
     msg = decode_payload(payload)
     return msg, t1 - t0, now() - t1
 
 
 async def write_frame(writer: asyncio.StreamWriter, msg: dict):
+    if FAULT_HOOK is not None:
+        FAULT_HOOK.on_write(writer, msg)
     writer.write(encode_frame(msg))
     await writer.drain()
 
@@ -148,10 +163,15 @@ def read_frame_sync(sock) -> dict:
             raise ConnectionError("socket closed mid-frame")
         chunks.append(chunk)
         got += len(chunk)
-    return decode_payload(b"".join(chunks))
+    payload = b"".join(chunks)
+    if FAULT_HOOK is not None:
+        payload = FAULT_HOOK.on_read(sock, payload)
+    return decode_payload(payload)
 
 
 def write_frame_sync(sock, msg: dict):
+    if FAULT_HOOK is not None:
+        FAULT_HOOK.on_write(sock, msg)
     sock.sendall(encode_frame(msg))
 
 

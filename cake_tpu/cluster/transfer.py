@@ -122,7 +122,11 @@ def encode_chunks(file_name: str, total: int, chunks: Iterable[bytes],
                   start_offset: int = 0) -> Iterator[dict]:
     """bytes chunks -> model_chunk protocol messages."""
     cctx = zstandard.ZstdCompressor(level=1) if zstandard else None
-    offset = start_offset
+    # the chunk stream always starts at file byte 0 — the running offset
+    # must too, or the resume skip below can never fire and the first
+    # chunk gets mislabeled with the resume offset (shifted, corrupted
+    # file on the worker)
+    offset = 0
     n_total = max(1, (total + CHUNK_SIZE - 1) // CHUNK_SIZE)
     i = 0
     for chunk in chunks:

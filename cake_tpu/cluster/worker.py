@@ -26,7 +26,7 @@ from ..models.common.text_model import LocalStage, select_flash_mode
 from ..obs import PhaseTimer, WORKER_FWD_SECONDS, WORKER_HEARTBEAT, now
 from ..utils.dtypes import parse_dtype
 from ..utils.hub import cake_cache_dir
-from . import proto
+from . import faults, proto
 from .auth import authenticate_as_worker, cluster_hash
 from .discovery import WorkerAdvertiser, detect_capabilities
 from .transfer import ModelReceiver, has_valid_model_cache
@@ -103,6 +103,9 @@ class WorkerServer:
                 **kw).start()
         self._hb_task = asyncio.get_running_loop().create_task(
             self._heartbeat_loop())
+        # chaos harness: lets a `@name:crash_after_ops=N` fault plan
+        # hard-kill this worker mid-stream (no goodbye, no FIN-wait)
+        faults.register_crash("@" + self.name, self._crash)
         log.info("worker %s listening on %s:%d", self.name, self.host, self.port)
         return self
 
@@ -124,7 +127,26 @@ class WorkerServer:
         async with self._server:
             await self._server.serve_forever()
 
+    def _crash(self):
+        """Injected hard death (cluster/faults.py crash_after_ops): stop
+        accepting and abort every live connection with an RST — the
+        ungraceful failure mode recovery must survive. Runs synchronously
+        on the event loop thread from inside the fault hook."""
+        log.warning("worker %s: injected crash", self.name)
+        if self._hb_task:
+            self._hb_task.cancel()
+        if self._advertiser:
+            self._advertiser.stop()
+        if self._server:
+            self._server.close()
+        for w in list(self._writers):
+            try:
+                w.transport.abort()
+            except Exception:
+                w.close()
+
     async def stop(self):
+        faults.unregister_crash("@" + self.name)
         if self._hb_task:
             self._hb_task.cancel()
         if self._advertiser:
@@ -163,6 +185,10 @@ class WorkerServer:
         # stop() runs must be closed too, or it survives shutdown and
         # serves forwards on a worker the operator believes is down
         self._writers.add(writer)
+        # label the streams so fault plans can target this worker's side
+        # of the hop ("@name"; the master's side is plain "name")
+        faults.tag(reader, "@" + self.name)
+        faults.tag(writer, "@" + self.name)
         try:
             await authenticate_as_worker(reader, writer, self.cluster_key)
         except Exception as e:

@@ -78,6 +78,17 @@ def bucket_for(n: int, max_len: int) -> int:
     return max_len
 
 
+def initial_kv_bucket(n_tokens: int, max_new: int, max_len: int) -> int:
+    """KV bucket covering n_tokens of context + the first sampled token +
+    a short run of decode, so the first growth realloc never lands within
+    the opening tokens. Shared by the distributed master's fresh-
+    generation sizing AND its mid-stream recovery replay: a replayed
+    request must land on exactly the bucketing progression the unfailed
+    run used."""
+    span = 1 + min(max_new, DECODE_HEADROOM)
+    return bucket_for(n_tokens + span, max_len)
+
+
 def select_flash_mode(pos0: int, width: int, capacity: int | None) -> str:
     """Host-static flash dispatch shared by the local, master and worker
     prefill paths: "fresh" at position 0, scatter-then-flash "append" while

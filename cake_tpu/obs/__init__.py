@@ -103,6 +103,39 @@ SERVE_PREFIX_BYTES = REGISTRY.gauge(
     "cake_serve_prefix_cache_bytes",
     "Device bytes held by cached prefix blocks")
 
+SERVE_QUEUE_TIMEOUTS = REGISTRY.counter(
+    "cake_serve_queue_timeouts_total",
+    "Requests expired in the admission queue past CAKE_QUEUE_DEADLINE_S "
+    "(answered 503 instead of occupying a slot for a client that gave up)")
+
+CLUSTER_STAGE_FAILURES = REGISTRY.counter(
+    "cake_cluster_stage_failures_total",
+    "Classified remote-hop failures observed by the master",
+    labelnames=("worker", "kind"))  # timeout | eof | conn | corrupt |
+                                    # worker_error
+
+CLUSTER_RECONNECTS = REGISTRY.counter(
+    "cake_cluster_reconnects_total",
+    "Successful master->worker channel re-establishments (reconnect + "
+    "re-auth + re-assign) after a stage failure",
+    labelnames=("worker",))
+
+CLUSTER_REPLAYS = REGISTRY.counter(
+    "cake_cluster_replays_total",
+    "Rebuild-by-replay prefills run to reconstruct lost worker KV state "
+    "mid-generation")
+
+CLUSTER_DEGRADED = REGISTRY.gauge(
+    "cake_cluster_degraded",
+    "1 while a worker is quarantined with its retry budget exhausted "
+    "(/health answers 503; the restore loop is probing)")
+
+CLUSTER_HOP_DEGRADED = REGISTRY.gauge(
+    "cake_cluster_hop_degraded",
+    "1 while the hop's rolling RTT p95 exceeds CAKE_HOP_DEGRADED_MS "
+    "(gray failure: slow-but-alive)",
+    labelnames=("worker",))
+
 WORKER_HEARTBEAT = REGISTRY.gauge(
     "cake_worker_heartbeat_age_seconds",
     "Seconds since the worker last handled any message, at the last "
@@ -120,4 +153,6 @@ __all__ = [
     "SERVE_QUEUE_DEPTH", "SERVE_SLOTS_BUSY", "SERVE_QUEUE_WAIT_SECONDS",
     "SERVE_BATCH_OCCUPANCY", "SERVE_PREFILL_CHUNKS", "SERVE_PREFIX_HITS",
     "SERVE_PREFIX_MISSES", "SERVE_PREFIX_EVICTIONS", "SERVE_PREFIX_BYTES",
+    "SERVE_QUEUE_TIMEOUTS", "CLUSTER_STAGE_FAILURES", "CLUSTER_RECONNECTS",
+    "CLUSTER_REPLAYS", "CLUSTER_DEGRADED", "CLUSTER_HOP_DEGRADED",
 ]

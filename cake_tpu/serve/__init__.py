@@ -2,9 +2,11 @@
 bounded admission, chunked prefill and shared-prefix KV reuse (see
 docs/serving.md)."""
 from .admission import AdmissionQueue, QueueFull
-from .engine import ServeEngine, ServeRequest, maybe_engine
+from .engine import (EngineDraining, QueueDeadlineExceeded, ServeEngine,
+                     ServeRequest, maybe_engine)
 from .prefix_cache import PrefixCache
 from .slots import SlotPool
 
-__all__ = ["AdmissionQueue", "QueueFull", "PrefixCache", "ServeEngine",
+__all__ = ["AdmissionQueue", "QueueFull", "EngineDraining",
+           "QueueDeadlineExceeded", "PrefixCache", "ServeEngine",
            "ServeRequest", "SlotPool", "maybe_engine"]

@@ -36,6 +36,7 @@ from __future__ import annotations
 import os
 import queue as queue_mod
 import threading
+import time
 import uuid
 
 import jax
@@ -43,14 +44,37 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
-                   SERVE_QUEUE_WAIT_SECONDS, SERVE_SLOTS_BUSY, now,
-                   set_request_id)
+                   SERVE_QUEUE_TIMEOUTS, SERVE_QUEUE_WAIT_SECONDS,
+                   SERVE_SLOTS_BUSY, now, set_request_id)
 from ..ops.sampling import SamplingConfig
 from .admission import AdmissionQueue, QueueFull
 from .prefix_cache import PrefixCache
 from .slots import SlotPool, slot_bucket
 
-__all__ = ["ServeEngine", "ServeRequest", "QueueFull", "maybe_engine"]
+__all__ = ["ServeEngine", "ServeRequest", "QueueFull", "EngineDraining",
+           "QueueDeadlineExceeded", "maybe_engine"]
+
+
+class EngineDraining(RuntimeError):
+    """Admission refused because the engine is draining for shutdown; the
+    API answers 503 + Retry-After so load balancers fail the client over
+    instead of letting it wait on a server that is leaving."""
+
+    def __init__(self, retry_after_s: int = 5):
+        super().__init__("serve engine draining for shutdown")
+        self.retry_after_s = retry_after_s
+
+
+class QueueDeadlineExceeded(RuntimeError):
+    """The request sat in the admission queue past CAKE_QUEUE_DEADLINE_S:
+    it is finished with 503 instead of eventually occupying a slot for a
+    client that already gave up."""
+
+    def __init__(self, waited_s: float, retry_after_s: int = 1):
+        super().__init__(
+            f"request expired in admission queue after {waited_s:.1f}s")
+        self.waited_s = waited_s
+        self.retry_after_s = retry_after_s
 
 # device-resident repeat-penalty window per slot — derived from the
 # SamplingConfig default so the engine's window can never silently diverge
@@ -122,6 +146,7 @@ class ServeRequest:
         self.sampling = sampling or SamplingConfig()
         self.out_q: queue_mod.Queue = queue_mod.Queue()
         self.cancelled = threading.Event()
+        self.admitted = threading.Event()   # set when a slot is assigned
         self.done = threading.Event()
         self.result: dict = {}          # tokens / stats / error, like the
                                         # legacy streamed-path result dict
@@ -205,7 +230,8 @@ class ServeEngine:
     def __init__(self, model, slots: int = 4, max_queue: int = 64,
                  ctx_len: int | None = None, seed: int = 0,
                  prefill_chunk: int | None = None,
-                 prefix_cache_mb: float | None = None):
+                 prefix_cache_mb: float | None = None,
+                 queue_deadline_s: float | None = None):
         if not hasattr(model, "decode_slots"):
             raise TypeError(
                 f"{type(model).__name__} has no batched slot decode; the "
@@ -225,6 +251,14 @@ class ServeEngine:
                                               prefix_cache_mb)
         self.pool = SlotPool(slots)
         self.queue = AdmissionQueue(max_queue)
+        # per-request queue deadline (CAKE_QUEUE_DEADLINE_S, 0 disables):
+        # a request whose client-side timeout has surely elapsed is 503ed
+        # by the sweep instead of admitted into a slot nobody will read
+        if queue_deadline_s is None:
+            queue_deadline_s = float(os.environ.get("CAKE_QUEUE_DEADLINE_S",
+                                                    "0") or 0)
+        self.queue_deadline_s = queue_deadline_s
+        self._draining = threading.Event()
 
         pool_cache = model.new_cache(slots, kv_len=self.ctx)
         self._layers = pool_cache["layers"]
@@ -273,6 +307,8 @@ class ServeEngine:
         ValueError for prompts the pool can never hold."""
         if self.dead is not None or not self._thread.is_alive():
             raise RuntimeError(f"serve engine is down: {self.dead}")
+        if self._draining.is_set():
+            raise EngineDraining()
         n = len(prompt_ids)
         if n < 1:
             raise ValueError("empty prompt")
@@ -341,12 +377,31 @@ class ServeEngine:
             "ctx_len": self.ctx,
             "prefill_chunk": self.chunk,
             "prefilling": len(self._prefills),
+            "draining": self._draining.is_set(),
             "steps": self.steps,
             "last_step_age_s": round(now() - self.last_step, 3),
         }
         if self.prefix_cache is not None:
             h["prefix_cache"] = self.prefix_cache.occupancy()
         return h
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful-shutdown phase 1: stop admission (new submits raise
+        EngineDraining -> 503 + Retry-After) and wait for in-flight work —
+        busy slots AND already-queued requests — to finish, up to timeout
+        seconds. Returns True when the engine went idle; False means the
+        timeout hit and close() will fail whatever is left. Safe to call
+        from any thread; blocks the caller, not the scheduler."""
+        self._draining.set()
+        self._wake.set()
+        deadline = None if timeout is None else now() + timeout
+        while self.pool.busy_count or self.queue.depth():
+            if self.dead is not None or not self._thread.is_alive():
+                return False
+            if deadline is not None and now() >= deadline:
+                return False
+            time.sleep(0.02)
+        return True
 
     def close(self, timeout: float = 5.0):
         self._stop.set()
@@ -416,6 +471,16 @@ class ServeEngine:
                 self._abort_prefill(pf, None)
             for req in self.queue.purge(lambda r: r.cancelled.is_set()):
                 self._fail(req, None)
+            # queue-deadline sweep: a request that has waited past
+            # CAKE_QUEUE_DEADLINE_S is 503ed here rather than admitted
+            # into a slot for a client that already gave up
+            if self.queue_deadline_s > 0:
+                cutoff = now() - self.queue_deadline_s
+                for req in self.queue.purge(
+                        lambda r: r.t_enqueue < cutoff):
+                    SERVE_QUEUE_TIMEOUTS.inc()
+                    self._fail(req, QueueDeadlineExceeded(
+                        now() - req.t_enqueue))
             # 2. every queued request takes a free slot NOW (cheap: at
             # most a prefix-cache splice — the prefill itself is chunked
             # below), so multiple admissions are in flight concurrently
@@ -474,6 +539,7 @@ class ServeEngine:
         # _reqs and releases its waiter instead of hanging the client
         self._reqs[slot] = req
         req.slot = slot
+        req.admitted.set()
         req.stats = {"queue_wait_s": now() - req.t_enqueue}
         pf = _Prefill(req, slot)
         set_request_id(req.id)

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Chaos smoke: a master + 2 real workers on localhost (tiny CPU model,
+weights streamed over TCP) must survive one worker being killed
+mid-stream. The fault plan severs the master->w0 connection after 5
+forward ops; the generation must still complete with greedy tokens
+bit-identical to a fully-local run, with exactly one replay prefill, and
+the recovery counters (cake_cluster_reconnects_total,
+cake_cluster_replays_total) must be non-zero in /metrics. /health must be
+back to 200 afterwards. Exits non-zero on any missing signal. Run via
+`make chaos-smoke`.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                     # noqa: E402
+
+from cake_tpu.cluster import faults                         # noqa: E402
+from cake_tpu.cluster.master import (DistributedTextModel,  # noqa: E402
+                                     master_setup)
+from cake_tpu.cluster.worker import WorkerServer            # noqa: E402
+from cake_tpu.models import (SamplingConfig, TextModel,     # noqa: E402
+                             init_params, tiny_config)
+from cake_tpu.utils.export import params_to_hf_tensors      # noqa: E402
+from cake_tpu.utils.safetensors_io import save_safetensors  # noqa: E402
+
+GREEDY = SamplingConfig(temperature=0.0)
+PROMPT = [1, 2, 3, 4, 5, 6, 7]
+MAX_NEW = 10
+
+
+def _write_model(tmp: str):
+    cfg = tiny_config("qwen3")
+    params = init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    mdir = os.path.join(tmp, "model")
+    os.makedirs(mdir)
+    save_safetensors(os.path.join(mdir, "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    with open(os.path.join(mdir, "config.json"), "w") as f:
+        json.dump(dict(architectures=["Qwen3ForCausalLM"], vocab_size=256,
+                       hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=4, num_attention_heads=4,
+                       num_key_value_heads=2, rms_norm_eps=1e-5,
+                       rope_theta=10000.0, max_position_embeddings=128,
+                       eos_token_id=2), f)
+    return cfg, params, mdir
+
+
+def _start_worker(name: str, cache_root: str):
+    ready = threading.Event()
+    holder = {}
+
+    def run():
+        async def main():
+            server = WorkerServer(name, "chaos", port=0,
+                                  cache_root=cache_root, advertise=False)
+            await server.start()
+            holder["port"] = server.port
+            holder["server"] = server
+            ready.set()
+            try:
+                await server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+        loop = asyncio.new_event_loop()
+        holder["loop"] = loop
+        try:
+            loop.run_until_complete(main())
+        finally:
+            loop.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(30), f"worker {name} never came up"
+    holder["thread"] = t
+    return holder
+
+
+def _stop_worker(holder):
+    loop, srv = holder.get("loop"), holder.get("server")
+    if loop and srv and loop.is_running():
+        try:
+            asyncio.run_coroutine_threadsafe(srv.stop(), loop).result(
+                timeout=5)
+        except Exception:
+            pass
+    holder["thread"].join(timeout=10)
+
+
+async def _scrape(dist) -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api import ApiState, create_app
+
+    client = TestClient(TestServer(create_app(
+        ApiState(model=dist, model_id="chaos-smoke"))))
+    await client.start_server()
+    try:
+        r = await client.get("/metrics")
+        metrics = await r.text()
+        h = await client.get("/health")
+        return {"metrics": metrics, "health_status": h.status,
+                "health": await h.json()}
+    finally:
+        await client.close()
+
+
+def _metric_total(text: str, name: str) -> float:
+    # sum across label sets: `name{...} v` and bare `name v`
+    vals = re.findall(rf"^{name}(?:\{{[^}}]*\}})? (\S+)$", text, re.M)
+    return sum(float(v) for v in vals)
+
+
+def main() -> int:
+    out = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg, params, mdir = _write_model(tmp)
+        local = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+        want, _ = local.generate(PROMPT, max_new_tokens=MAX_NEW,
+                                 sampling=GREEDY)
+
+        w0 = _start_worker("w0", os.path.join(tmp, "wc0"))
+        w1 = _start_worker("w1", os.path.join(tmp, "wc1"))
+        try:
+            setup = master_setup(
+                mdir, "chaos", cfg,
+                workers=[
+                    {"name": "w0", "host": "127.0.0.1", "port": w0["port"],
+                     "caps": {"backend": "cpu", "device": "cpu",
+                              "memory_bytes": 8 << 30, "tflops": 1.0}},
+                    {"name": "w1", "host": "127.0.0.1", "port": w1["port"],
+                     "caps": {"backend": "cpu", "device": "cpu",
+                              "memory_bytes": 8 << 30, "tflops": 1.0}},
+                ],
+                assignments={"w0": (1, 2), "w1": (2, 4)},
+                dtype_str="f32", max_cache_len=64)
+            dist = DistributedTextModel(
+                cfg, setup.master_params, setup.stages, dtype=jnp.float32,
+                max_cache_len=64, recovery_retries=4,
+                recovery_backoff_s=0.1, restore_interval_s=0.5)
+
+            # kill w0's connection after 5 forward ops — mid-decode
+            faults.install("w0:drop_after_ops=5")
+            got, stats = dist.generate(PROMPT, max_new_tokens=MAX_NEW,
+                                       sampling=GREEDY)
+            assert got == want, (
+                f"recovered generation diverged: {got} != {want}")
+            assert stats["replays"] == 1, stats
+            assert stats["recoveries"] == 1, stats
+            faults.clear()
+
+            scraped = asyncio.new_event_loop().run_until_complete(
+                _scrape(dist))
+            reconnects = _metric_total(scraped["metrics"],
+                                       "cake_cluster_reconnects_total")
+            replays = _metric_total(scraped["metrics"],
+                                    "cake_cluster_replays_total")
+            assert reconnects > 0, "no reconnects recorded in /metrics"
+            assert replays > 0, "no replays recorded in /metrics"
+            assert scraped["health_status"] == 200, scraped["health"]
+            assert scraped["health"]["status"] == "ok"
+
+            # and the recovered cluster serves the next request cleanly
+            got2, stats2 = dist.generate(PROMPT, max_new_tokens=MAX_NEW,
+                                         sampling=GREEDY)
+            assert got2 == want and stats2["recoveries"] == 0
+
+            out = {"chaos_smoke": "ok", "tokens": got,
+                   "replays": stats["replays"],
+                   "recoveries": stats["recoveries"],
+                   "reconnects_total": reconnects,
+                   "replays_total": replays,
+                   "health": scraped["health"]["status"]}
+            for c in setup.clients:
+                c.close()
+        finally:
+            faults.clear()
+            _stop_worker(w0)
+            _stop_worker(w1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -272,15 +272,17 @@ def cluster_model_dir(tmp_path):
     return cfg, params, str(mdir), str(tmp_path / "wcache")
 
 
-def _start_worker_thread(name, key, cache_root, ready, tp=None):
-    """Run a WorkerServer on its own event loop thread; returns (thread,
-    port holder, stop fn)."""
+def _start_worker_thread(name, key, cache_root, ready, tp=None, port=0):
+    """Run a WorkerServer on its own event loop thread; returns (holder,
+    thread). Shared with test_cluster_faults (same import idiom as
+    test_obs_api's reuse of test_api helpers)."""
     from cake_tpu.cluster.worker import WorkerServer
     holder = {}
 
     def run():
         async def main():
-            server = WorkerServer(name, key, port=0, cache_root=cache_root,
+            server = WorkerServer(name, key, port=port,
+                                  cache_root=cache_root,
                                   advertise=False, tp=tp)
             await server.start()
             holder["port"] = server.port
@@ -301,6 +303,17 @@ def _start_worker_thread(name, key, cache_root, ready, tp=None):
     t = threading.Thread(target=run, daemon=True)
     t.start()
     return holder, t
+
+
+def _stop_worker(holder, t):
+    loop, srv = holder.get("loop"), holder.get("server")
+    if loop and srv and loop.is_running():
+        try:
+            asyncio.run_coroutine_threadsafe(srv.stop(), loop).result(
+                timeout=5)
+        except Exception:
+            pass
+    t.join(timeout=10)
 
 
 def test_distributed_generation_matches_local(cluster_model_dir):

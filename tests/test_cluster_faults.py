@@ -1,0 +1,484 @@
+"""Fault-tolerant distributed generation: deterministic chaos via
+cluster/faults.py against a REAL master<->worker pair on localhost TCP.
+
+Pins the recovery contract: a worker killed mid-decode costs exactly one
+replay prefill and the greedy continuation is bit-identical to the
+unfailed run; retry-budget exhaustion fails fast with a typed
+ClusterDegradedError and 503s /health until the background restore loop
+revives the worker; a gray (slow-but-alive) hop is flagged without
+aborting anything. Plus the auth/teardown hardening the recovery path
+leans on: truncated handshakes are AuthErrors, goodbye never raises.
+"""
+import asyncio
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu import obs
+from cake_tpu.cluster import faults, proto
+from cake_tpu.cluster.auth import (AuthError, authenticate_as_master,
+                                   authenticate_as_worker)
+from cake_tpu.cluster.client import RemoteStage, StageFailure
+from cake_tpu.cluster.master import (ClusterDegradedError,
+                                     DistributedTextModel, master_setup)
+from cake_tpu.models import SamplingConfig, TextModel, init_params, tiny_config
+from cake_tpu.utils.export import params_to_hf_tensors
+from cake_tpu.utils.safetensors_io import save_safetensors
+
+GREEDY = SamplingConfig(temperature=0.0)
+
+# fast-recovery knobs for tests: real defaults back off for seconds
+FAST = dict(recovery_retries=4, recovery_backoff_s=0.05,
+            restore_interval_s=0.15)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_faults():
+    """Every test starts and ends without an installed fault plan."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ------------------------------------------------------------- plan parsing
+
+def test_fault_plan_parsing():
+    inj = faults.parse_plan(
+        "w0:drop_after_ops=5;delay_ms=12.5, @w1:crash_after_ops=2,"
+        "corrupt_after_ops=1")
+    assert len(inj.plans) == 3
+    p0, p1, p2 = inj.plans
+    assert (p0.target, p0.drop_after_ops, p0.delay_ms) == ("w0", 5, 12.5)
+    assert (p1.target, p1.crash_after_ops) == ("@w1", 2)
+    assert (p2.target, p2.corrupt_after_ops) == ("*", 1)  # no target = all
+    assert p0.matches("w0") and not p0.matches("@w0")
+    with pytest.raises(ValueError, match="unknown fault key"):
+        faults.parse_plan("w0:explode=1")
+    with pytest.raises(ValueError, match="key=value"):
+        faults.parse_plan("w0:drop_after_ops")
+    with pytest.raises(ValueError, match="empty"):
+        faults.parse_plan(" , ")
+
+
+def test_install_and_clear_toggle_proto_hook():
+    assert proto.FAULT_HOOK is None
+    inj = faults.install("*:delay_ms=1")
+    assert proto.FAULT_HOOK is inj and faults.active() is inj
+    faults.clear()
+    assert proto.FAULT_HOOK is None
+
+
+# -------------------------------------------------------- teardown hardening
+
+def test_goodbye_never_raises():
+    """goodbye() is teardown: no channel, a dead peer, and a protocol
+    desync must all be swallowed (a raise here masks the error that
+    actually killed the setup/generation)."""
+    rs = RemoteStage("127.0.0.1", 1, "k", "w")
+    assert rs.sock is None
+    rs.goodbye()                                 # no channel: no-op
+
+    import socket as socket_mod
+    a, b = socket_mod.socketpair()
+    rs.sock = a
+    b.close()                                    # peer gone mid-teardown
+    rs.goodbye()                                 # EOF/RST swallowed
+    assert rs.sock is None                       # unknown-state channel dropped
+
+    a2, b2 = socket_mod.socketpair()
+    rs.sock = a2
+    b2.sendall(b"\x00\x00\x00\x00\x10\x00\x00\x00")   # bad magic reply
+    rs.goodbye()                                 # ProtocolError swallowed
+    assert rs.sock is None
+    b2.close()
+
+
+def test_forward_without_channel_is_classified():
+    rs = RemoteStage("127.0.0.1", 1, "k", "w")
+    with pytest.raises(StageFailure) as ei:
+        rs.forward_hidden(np.zeros((1, 1, 4), np.float32), None, 0, None)
+    assert ei.value.kind == "conn" and ei.value.worker == "w"
+
+
+# ------------------------------------------------------------- auth hardening
+
+def _auth_scenario(server_side, client_side):
+    """Run worker-side (server) and master-side (client) auth coroutines
+    against each other; each side may be a saboteur. Returns both results
+    (True or the exception)."""
+    async def go():
+        done = asyncio.get_running_loop().create_future()
+
+        async def on_conn(r, w):
+            try:
+                await server_side(r, w)
+                done.set_result(True)
+            except Exception as e:
+                done.set_result(e)
+            finally:
+                w.close()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            await client_side(r, w)
+            cres = True
+        except Exception as e:
+            cres = e
+        sres = await asyncio.wait_for(done, 5)
+        w.close()
+        server.close()
+        await asyncio.wait_for(server.wait_closed(), 5)
+        return cres, sres
+    return asyncio.run(go())
+
+
+def test_auth_wrong_psk_both_sides_fail_typed():
+    """Wrong PSK: BOTH ends must surface AuthError (worker detects the bad
+    MAC; the master sees the worker bail), never a bare socket error."""
+    c, s = _auth_scenario(
+        lambda r, w: authenticate_as_worker(r, w, "right-key"),
+        lambda r, w: authenticate_as_master(r, w, "wrong-key"))
+    assert isinstance(s, AuthError)
+    assert isinstance(c, AuthError)
+
+
+def test_auth_truncated_by_master():
+    """Master closes mid-handshake (after reading the challenge): the
+    worker side must classify the truncation as an AuthError."""
+    async def bad_master(r, w):
+        await r.readexactly(32)                  # take the challenge...
+        w.close()                                # ...and vanish
+        raise AuthError("saboteur done")
+
+    c, s = _auth_scenario(
+        lambda r, w: authenticate_as_worker(r, w, "k"), bad_master)
+    assert isinstance(s, AuthError)
+    assert "closed" in str(s) or "timeout" in str(s)
+
+
+def test_auth_truncated_by_worker():
+    """Worker sends a short challenge then closes: the master side must
+    classify the truncation as an AuthError."""
+    async def bad_worker(r, w):
+        w.write(b"\x01" * 7)                     # truncated challenge
+        await w.drain()
+        w.close()
+        raise AuthError("saboteur done")
+
+    c, s = _auth_scenario(
+        bad_worker, lambda r, w: authenticate_as_master(r, w, "k"))
+    assert isinstance(c, AuthError)
+    assert "closed" in str(c) or "timeout" in str(c)
+
+
+def test_sync_master_auth_truncation_is_auth_error(monkeypatch):
+    """RemoteStage's sync handshake: a peer that closes mid-auth surfaces
+    through connect() as ConnectionError (wrapping AuthError), promptly."""
+    import socket as socket_mod
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def peer():
+        conn, _ = srv.accept()
+        conn.sendall(b"\x02" * 32)               # full challenge...
+        conn.recv(64)
+        conn.close()                             # ...but never answer back
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    rs = RemoteStage("127.0.0.1", port, "k", "w", timeout=2.0)
+    with pytest.raises(ConnectionError, match="auth"):
+        rs.connect(attempts=1)
+    t.join(timeout=5)
+    srv.close()
+
+
+def test_encode_chunks_resume_starts_at_file_byte_zero():
+    """Resume semantics of the (re)push path: the chunk stream always
+    begins at file byte 0, so with start_offset=X the encoder must SKIP
+    the first X bytes and label the first emitted chunk with off=X — a
+    running offset initialized to X instead of 0 shifted the whole file
+    by X on the worker (corrupted safetensors after a resumed push)."""
+    from cake_tpu.cluster import transfer
+
+    msgs = list(transfer.encode_chunks("f", 8, iter([b"aaaa", b"bbbb"]),
+                                       start_offset=6))
+    assert [(m["off"], m["z"] or m["d"]) for m in msgs] == [(6, b"bb")]
+    msgs = list(transfer.encode_chunks("f", 8, iter([b"aaaa", b"bbbb"])))
+    assert [m["off"] for m in msgs] == [0, 4]
+    # whole-chunk skip: resume exactly at a chunk boundary
+    msgs = list(transfer.encode_chunks("f", 8, iter([b"aaaa", b"bbbb"]),
+                                       start_offset=4))
+    assert [(m["off"], m["z"] or m["d"]) for m in msgs] == [(4, b"bbbb")]
+
+
+# --------------------------------------------------- live-cluster fixtures
+# Everything below shares ONE tiny model checkpoint, ONE local reference
+# model (greedy refs memoized), and — for the connection-fault tests —
+# ONE worker + master chain: those tests sever connections, never the
+# worker, and every test starts from a cleared fault plan and a healthy
+# (possibly freshly revived) channel. Only the retry-exhaustion test
+# boots its own worker, because it kills it. This keeps the tier-1 cost
+# of the file low: the suite runs under a hard wall-clock cap, and every
+# master_setup + jit warm repeated per-test is paid out of that budget.
+
+PROMPT = [1, 2, 3, 4, 5]
+
+
+@pytest.fixture(scope="module")
+def cluster_model_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("faults")
+    cfg = tiny_config("qwen3")
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    mdir = tmp / "model"
+    mdir.mkdir()
+    save_safetensors(str(mdir / "model.safetensors"),
+                     params_to_hf_tensors(cfg, params))
+    d = dict(architectures=["Qwen3ForCausalLM"], vocab_size=256,
+             hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+             num_attention_heads=4, num_key_value_heads=2, rms_norm_eps=1e-5,
+             rope_theta=10000.0, max_position_embeddings=128, eos_token_id=2)
+    (mdir / "config.json").write_text(json.dumps(d))
+    return cfg, params, str(mdir), str(tmp / "wcache")
+
+
+@pytest.fixture(scope="module")
+def local_ref(cluster_model_dir):
+    """Memoized greedy references from the fully-local model — the ground
+    truth every recovered run must match bit-for-bit."""
+    cfg, params, _, _ = cluster_model_dir
+    local = TextModel(cfg, params, dtype=jnp.float32, max_cache_len=64)
+    cache: dict = {}
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in cache:
+            cache[key], _ = local.generate(list(prompt), max_new_tokens=n,
+                                           sampling=GREEDY)
+        return cache[key]
+    return ref
+
+
+# worker-on-event-loop-thread helpers shared with test_cluster (same
+# cross-module reuse idiom as test_obs_api importing test_api helpers)
+from tests.test_cluster import _start_worker_thread, _stop_worker  # noqa: E402
+
+
+def _setup(cfg, mdir, port, **model_kw):
+    # warm="decode": skip the full compile sweep — these tests pay
+    # master_setup (and a recovery re-assign) on a budgeted clock, and
+    # the tiny CPU model's in-band compiles are cheap
+    setup = master_setup(
+        mdir, "faultkey", cfg,
+        workers=[{"name": "w0", "host": "127.0.0.1", "port": port,
+                  "caps": {"backend": "cpu", "device": "cpu",
+                           "memory_bytes": 8 << 30, "tflops": 1.0}}],
+        assignments={"w0": (1, 3)},
+        dtype_str="f32", max_cache_len=64, warm="decode")
+    dist = DistributedTextModel(cfg, setup.master_params, setup.stages,
+                                dtype=jnp.float32, max_cache_len=64,
+                                **{**FAST, **model_kw})
+    return setup, dist
+
+
+@pytest.fixture(scope="module")
+def live(cluster_model_dir):
+    """Shared worker + master chain for the connection-fault tests."""
+    cfg, params, mdir, wcache = cluster_model_dir
+    ready = threading.Event()
+    holder, t = _start_worker_thread("w0", "faultkey", wcache, ready)
+    assert ready.wait(10)
+    setup, dist = _setup(cfg, mdir, holder["port"])
+    yield dist
+    for c in setup.clients:
+        c.close()
+    _stop_worker(holder, t)
+
+
+def _remote(dist):
+    return next(s for s in dist.stages if s.kind == "remote").runner
+
+
+# ----------------------------------------------- mid-stream worker recovery
+
+def test_drop_mid_decode_recovers_bit_identical(live, local_ref):
+    """Connection to the worker severed after 4 forward ops (mid-decode):
+    the master must quarantine, reconnect (cached weights => no re-push),
+    rebuild via EXACTLY ONE replay prefill, and finish with greedy output
+    bit-identical to a run with no fault at all."""
+    want = local_ref(PROMPT, 8)
+    reconnects0 = obs.CLUSTER_RECONNECTS.value(worker="w0")
+    replays0 = obs.CLUSTER_REPLAYS.value()
+
+    faults.install("w0:drop_after_ops=4")
+    got, stats = live.generate(PROMPT, max_new_tokens=8, sampling=GREEDY)
+    assert got == want, "recovered continuation diverged from unfailed run"
+    assert stats["replays"] == 1, "recovery must cost exactly one prefill"
+    assert stats["recoveries"] == 1
+    assert obs.CLUSTER_RECONNECTS.value(worker="w0") == reconnects0 + 1
+    assert obs.CLUSTER_REPLAYS.value() == replays0 + 1
+    assert obs.CLUSTER_STAGE_FAILURES.value(worker="w0", kind="eof") >= 1
+
+    # the revived channel serves the NEXT generation with no recovery
+    got2, stats2 = live.generate(PROMPT, max_new_tokens=8, sampling=GREEDY)
+    assert got2 == want
+    assert stats2["replays"] == 0 and stats2["recoveries"] == 0
+
+
+@pytest.mark.slow
+def test_drop_during_prefill_recovers(live, local_ref):
+    """Fault on the very FIRST forward (the prefill op): recovery replays
+    the prompt and the whole generation still matches the unfailed run."""
+    faults.install("w0:drop_after_ops=0")        # first forward dies
+    got, stats = live.generate([9, 8, 7, 6], max_new_tokens=6,
+                               sampling=GREEDY)
+    assert got == local_ref([9, 8, 7, 6], 6)
+    assert stats["replays"] == 1
+
+
+@pytest.mark.slow
+def test_corrupt_frame_classified_and_recovered(live, local_ref):
+    """A corrupted response frame surfaces as a classified `corrupt`
+    failure (undecodable payload => ProtocolError), and recovery rides the
+    same reconnect+replay path to a bit-identical finish."""
+    faults.install("w0:corrupt_after_ops=2")
+    got, stats = live.generate(PROMPT, max_new_tokens=8, sampling=GREEDY)
+    assert got == local_ref(PROMPT, 8)
+    assert stats["replays"] == 1
+    assert obs.CLUSTER_STAGE_FAILURES.value(worker="w0",
+                                            kind="corrupt") >= 1
+
+
+@pytest.mark.slow
+def test_stall_trips_per_op_deadline_and_recovers(live, local_ref):
+    """A worker stalled past the per-op deadline is a classified `timeout`
+    — detection does not wait for TCP to notice (it wouldn't) — and the
+    generation still completes bit-identically via recovery."""
+    runner = _remote(live)
+    old_timeout = runner.timeout
+    runner.timeout = 0.6                 # what CAKE_HOP_TIMEOUT_S would set
+    if runner.sock is not None:
+        runner.sock.settimeout(0.6)      # live socket predates the override
+    try:
+        faults.install("@w0:stall_once_ms=1500;stall_after_ops=3")
+        got, stats = live.generate(PROMPT, max_new_tokens=6, sampling=GREEDY)
+        assert got == local_ref(PROMPT, 6)
+        assert stats["recoveries"] >= 1
+        assert obs.CLUSTER_STAGE_FAILURES.value(worker="w0",
+                                                kind="timeout") >= 1
+    finally:
+        runner.timeout = old_timeout
+        if runner.sock is not None:
+            runner.sock.settimeout(old_timeout)
+
+
+def test_gray_failure_flagged_without_abort(live, local_ref):
+    """delay_ms on every hop op pushes the rolling RTT p95 over the
+    degraded threshold (CAKE_HOP_DEGRADED_MS): the stage is flagged gray
+    in worker_health (and the gauge) while the generation runs to
+    completion with ZERO recoveries — slow is not dead."""
+    runner = _remote(live)
+    runner.degraded_ms = 10              # what CAKE_HOP_DEGRADED_MS would set
+    try:
+        faults.install("w0:delay_ms=40")
+        got, stats = live.generate(PROMPT, max_new_tokens=8, sampling=GREEDY)
+        assert got == local_ref(PROMPT, 8)
+        assert stats["recoveries"] == 0 and stats["replays"] == 0
+
+        assert runner.gray_degraded is True
+        assert runner.rtt_p95_ms() > 10
+
+        from cake_tpu.api.obs_routes import worker_health
+        entry = worker_health(live)[0]
+        assert entry["degraded"] is True and entry["failing"] is False
+        assert obs.CLUSTER_HOP_DEGRADED.value(worker="w0") == 1.0
+    finally:
+        runner.degraded_ms = 0.0
+
+
+def test_retry_exhaustion_degrades_health_then_restores(cluster_model_dir,
+                                                        local_ref):
+    """Worker hard-crashes (listener gone): the retry budget drains, the
+    request fails FAST with ClusterDegradedError, /health answers 503 with
+    the quarantined worker named — and once the worker comes back, the
+    background restore loop revives it so the next request succeeds."""
+    cfg, params, mdir, wcache = cluster_model_dir
+    want = local_ref(PROMPT, 6)
+
+    ready = threading.Event()
+    holder, t = _start_worker_thread("w0", "faultkey", wcache, ready)
+    assert ready.wait(10)
+    port = holder["port"]
+    setup, dist = _setup(cfg, mdir, port, recovery_retries=2,
+                         recovery_backoff_s=0.02, restore_interval_s=0.15)
+    holder2 = t2 = None
+    try:
+        faults.install("@w0:crash_after_ops=3")
+        with pytest.raises(ClusterDegradedError):
+            dist.generate(PROMPT, max_new_tokens=6, sampling=GREEDY)
+        assert dist.degraded is not None and dist.degraded["worker"] == "w0"
+        assert obs.CLUSTER_DEGRADED.value() == 1.0
+
+        # degraded cluster fails FAST — no reconnect-loop latency tax
+        t0 = time.monotonic()
+        with pytest.raises(ClusterDegradedError):
+            dist.generate(PROMPT, max_new_tokens=6, sampling=GREEDY)
+        assert time.monotonic() - t0 < 0.5
+
+        # /health: 503 + the quarantined worker named
+        from aiohttp.test_utils import TestClient, TestServer
+        from cake_tpu.api import ApiState, create_app
+
+        async def check_health():
+            client = TestClient(TestServer(create_app(
+                ApiState(model=dist, model_id="faults"))))
+            await client.start_server()
+            try:
+                r = await client.get("/health")
+                body = await r.json()
+                assert r.status == 503, body
+                assert body["status"] == "degraded"
+                assert body["cluster"]["worker"] == "w0"
+                # chat requests — streaming included — shed with the same
+                # 503 BEFORE any SSE stream commits to a 200
+                rc = await client.post("/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "stream": True})
+                assert rc.status == 503
+                assert int(rc.headers.get("Retry-After", "0")) >= 1
+            finally:
+                await client.close()
+        asyncio.run(check_health())
+
+        # worker returns on the SAME port; the restore loop must notice
+        # (the crash fault is one-shot — it does not re-fire) and clear
+        # the quarantine so the next request succeeds
+        faults.clear()
+        _stop_worker(holder, t)
+        ready2 = threading.Event()
+        holder2, t2 = _start_worker_thread("w0", "faultkey", wcache, ready2,
+                                           port=port)
+        assert ready2.wait(10)
+        deadline = time.monotonic() + 30
+        while dist.degraded is not None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert dist.degraded is None, "restore loop never revived the worker"
+        assert obs.CLUSTER_DEGRADED.value() == 0.0
+
+        got, stats = dist.generate(PROMPT, max_new_tokens=6, sampling=GREEDY)
+        assert got == want
+        for c in setup.clients:
+            c.close()
+    finally:
+        _stop_worker(holder, t)
+        if holder2 is not None:
+            _stop_worker(holder2, t2)

@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke native clean docker
+.PHONY: install test bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke spec-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -45,6 +45,19 @@ chaos-smoke:
 # admitted chunk-by-chunk). Writes BENCH_SERVE_<tag>.json.
 serve-bench:
 	JAX_PLATFORMS=cpu python scripts/serve_bench.py
+
+# speculative-decoding gate: serve engine + n-gram drafter on the tiny
+# CPU model — greedy output bit-identical to a spec-off engine, >= 1
+# multi-token accept, non-zero cake_serve_spec_{proposed,accepted}_total
+spec-smoke:
+	JAX_PLATFORMS=cpu python scripts/spec_smoke.py
+
+# speculation bench: tokens/s + acceptance (accepted tokens per verify
+# step), spec on vs off, repetitive vs non-repetitive prompt. Writes
+# BENCH_SPEC_<tag>.json; fails if spec breaks greedy parity or the
+# repetitive case does not beat 1.0 accepted/step.
+spec-bench:
+	JAX_PLATFORMS=cpu python scripts/spec_bench.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -69,6 +69,77 @@ def _gen_kwargs(body: dict) -> dict:
     }
 
 
+MAX_STOPS = 4           # OpenAI caps `stop` at 4 sequences
+
+
+def _stops_from_request(body: dict) -> list[str]:
+    """Validated OpenAI `stop` field: a string or a list of up to 4
+    non-empty strings (empty/None = no stop sequences)."""
+    stop = body.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        return [stop] if stop else []
+    if isinstance(stop, list):
+        if len(stop) > MAX_STOPS:
+            raise ValueError(f"stop accepts at most {MAX_STOPS} sequences")
+        for s in stop:
+            if not isinstance(s, str) or not s:
+                raise ValueError("stop sequences must be non-empty strings")
+        return list(stop)
+    raise ValueError("stop must be a string or a list of strings")
+
+
+def apply_stop(text: str, stops: list[str]) -> tuple[str, bool]:
+    """Trim `text` at the EARLIEST occurrence of any stop sequence
+    (matched text excluded, OpenAI semantics). Returns (text, matched)."""
+    best = -1
+    for s in stops:
+        i = text.find(s)
+        if i >= 0 and (best < 0 or i < best):
+            best = i
+    return (text[:best], True) if best >= 0 else (text, False)
+
+
+class StopMatcher:
+    """Incremental stop-sequence scanner for token streams.
+
+    feed() returns the text that is SAFE to emit: everything up to (and
+    excluding) a completed stop match, holding back the longest suffix
+    that could still be the prefix of a match split across token
+    boundaries (max stop length - 1 chars). flush() releases the held
+    tail when the stream ends without a match — so a client never sees
+    any part of a stop sequence, and never loses text to the holdback.
+    """
+
+    def __init__(self, stops: list[str]):
+        self.stops = [s for s in stops if s]
+        self.hold = max((len(s) for s in self.stops), default=1) - 1
+        self.buf = ""
+        self.stopped = False
+
+    def feed(self, piece: str) -> str:
+        if self.stopped or not piece:
+            return ""
+        self.buf += piece
+        trimmed, matched = apply_stop(self.buf, self.stops)
+        if matched:
+            self.stopped = True
+            self.buf = ""
+            return trimmed
+        if self.hold and len(self.buf) > self.hold:
+            safe, self.buf = self.buf[:-self.hold], self.buf[-self.hold:]
+            return safe
+        if not self.hold:
+            safe, self.buf = self.buf, ""
+            return safe
+        return ""
+
+    def flush(self) -> str:
+        tail, self.buf = self.buf, ""
+        return "" if self.stopped else tail
+
+
 def _completion_id() -> str:
     return "chatcmpl-" + uuid.uuid4().hex[:24]
 
@@ -112,15 +183,18 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         # validate/quantize sampling params BEFORE any streaming response
         # is prepared: a malformed float must be a 400, not a hung SSE
         gen_kwargs = _gen_kwargs(body)
+        stops = _stops_from_request(body)
     except (TypeError, ValueError) as e:
         return web.json_response({"error": f"invalid sampling params: {e}"},
                                  status=400)
     if state.engine is not None:
         return await _chat_engine(request, state, messages, gen_kwargs,
-                                  stream=bool(body.get("stream")))
+                                  stream=bool(body.get("stream")),
+                                  stops=stops)
     if body.get("stream"):
-        return await _chat_stream(request, state, messages, gen_kwargs)
-    return await _chat_blocking(request, state, messages, gen_kwargs)
+        return await _chat_stream(request, state, messages, gen_kwargs,
+                                  stops)
+    return await _chat_blocking(request, state, messages, gen_kwargs, stops)
 
 
 def _prompt_token_count(state: ApiState, messages) -> int:
@@ -171,15 +245,23 @@ def _stats_snapshot(stats: dict) -> dict:
 
 
 def _completion_json(state: ApiState, cid: str, toks: list[int],
-                     stats: dict, n_in: int) -> web.Response:
+                     stats: dict, n_in: int,
+                     stops: list[str] | None = None) -> web.Response:
     """Assemble the blocking chat.completion body — shared by the engine
-    and locked paths so usage accounting/finish_reason cannot diverge."""
+    and locked paths so usage accounting/finish_reason cannot diverge.
+    `stops`: OpenAI stop sequences — the content is trimmed at the
+    earliest match and finish_reason becomes "stop" (the engine path also
+    cancels generation at the match; the locked path trims here)."""
     n_out = len(toks)
     ended = bool(toks) and state.model.cfg.is_eos(toks[-1])
     finish = "stop" if ended else "length"
     content_ids = toks[:-1] if ended else toks
     tokenizer = state.tokenizer or getattr(state.model, "tokenizer", None)
     text = _decode_text(tokenizer, content_ids)
+    if stops:
+        text, matched = apply_stop(text, stops)
+        if matched:
+            finish = "stop"
     return web.json_response({
         "id": cid,
         "object": "chat.completion",
@@ -199,7 +281,8 @@ def _completion_json(state: ApiState, cid: str, toks: list[int],
     })
 
 
-async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
+async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
+                         stops: list[str] | None = None):
     cid = _completion_id()
     # the completion id doubles as the request id: spans recorded during
     # this request's generation (model phases, cluster hops) carry it, so
@@ -225,14 +308,14 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs):
                                      status=500)
     GENERATIONS.inc(kind="text", status="ok")
     return _completion_json(state, cid, toks, stats,
-                            _prompt_token_count(state, messages))
+                            _prompt_token_count(state, messages), stops)
 
 
 # -- continuous-batching path (state.engine) ---------------------------------
 
 
 async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
-                       stream: bool):
+                       stream: bool, stops: list[str] | None = None):
     """Submit to the serve engine: concurrent decode, bounded queue."""
     from ..models.common.text_model import chat_prompt_ids
     cid = _completion_id()
@@ -283,13 +366,29 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
                     headers={"Retry-After": str(err.retry_after_s)})
         aiter, result = state.engine.stream(req)
         return await _sse_drain(request, state, cid, aiter, result,
-                                req.cancel)
+                                req.cancel, stops)
     # await completion via a done callback -> future: no executor thread
     # is parked per in-flight request (the default executor also serves
     # tokenization and every other endpoint — parking one thread per
     # generation would starve the server at exactly this concurrency)
     loop = asyncio.get_running_loop()
     fut: asyncio.Future = loop.create_future()
+    if stops:
+        # early termination: watch the token stream from the scheduler
+        # thread and cancel at the first completed stop match, so a
+        # matched request frees its slot instead of decoding to budget
+        # (the response text is trimmed in _completion_json either way)
+        from ..serve import ServeRequest
+        matcher = StopMatcher(stops)
+
+        def _watch(item):
+            if item is ServeRequest.DONE or matcher.stopped:
+                return
+            matcher.feed(getattr(item, "text", None) or "")
+            if matcher.stopped:
+                req.cancel()
+        for backlog_item in req.subscribe(_watch):
+            _watch(backlog_item)
 
     def _on_done():
         try:
@@ -318,15 +417,20 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
     stats = req.result.get("stats", {})
     state.last_stats = _stats_snapshot(stats)
     return _completion_json(state, cid, req.result.get("tokens", []), stats,
-                            len(prompt_ids))
+                            len(prompt_ids), stops)
 
 
 async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
-                     cancel) -> web.StreamResponse:
+                     cancel, stops: list[str] | None = None
+                     ) -> web.StreamResponse:
     """Drain a token stream into SSE chunks — shared by the engine and
     locked paths. `cancel` is a thunk that aborts the producer; it fires
     when the client disconnects mid-stream so the generation (and, on the
-    engine path, its KV slot) is reclaimed instead of decoding on."""
+    engine path, its KV slot) is reclaimed instead of decoding on.
+    `stops`: OpenAI stop sequences — matched text is never emitted (a
+    StopMatcher holds back potential partial matches across token
+    boundaries), the stream finishes with finish_reason="stop", and the
+    producer is cancelled at the match."""
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -334,7 +438,7 @@ async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
     })
     try:
         return await _sse_drain_inner(request, state, cid, aiter, result,
-                                      cancel, resp)
+                                      cancel, resp, stops)
     except BaseException:
         # disconnect/cancellation BEFORE the token loop starts would skip
         # the iterator's finalizer (an async generator that was never
@@ -345,8 +449,9 @@ async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
 
 
 async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
-                           result: dict, cancel,
-                           resp: web.StreamResponse) -> web.StreamResponse:
+                           result: dict, cancel, resp: web.StreamResponse,
+                           stops: list[str] | None = None
+                           ) -> web.StreamResponse:
     await resp.prepare(request)
     created = int(time.time())
 
@@ -361,6 +466,7 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
     await resp.write(chunk({"role": "assistant"}))
     finish = "length"
     client_gone = False
+    matcher = StopMatcher(stops) if stops else None
 
     async def write_safe(data: bytes) -> None:
         # a disconnected client must not abort the drain below — note it,
@@ -384,7 +490,23 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
                 finish = "stop"
                 continue
             if finish == "length" and tok.text:
-                await write_safe(chunk({"content": tok.text}))
+                if matcher is None:
+                    await write_safe(chunk({"content": tok.text}))
+                    continue
+                safe = matcher.feed(tok.text)
+                if safe:
+                    await write_safe(chunk({"content": safe}))
+                if matcher.stopped:
+                    # stop sequence completed: nothing past it is ever
+                    # emitted; cancel the producer (frees the engine
+                    # slot / generation thread) and keep consuming to
+                    # the DONE sentinel for a clean wind-down
+                    finish = "stop"
+                    cancel()
+        if matcher is not None and not matcher.stopped:
+            tail = matcher.flush()      # held-back partial-match suffix
+            if tail:
+                await write_safe(chunk({"content": tail}))
     except Exception as e:
         # mid-stream generation failure: still close the SSE stream
         # with a final chunk + [DONE] so clients don't hang
@@ -401,14 +523,15 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
     return resp
 
 
-async def _chat_stream(request, state: ApiState, messages, gen_kwargs):
+async def _chat_stream(request, state: ApiState, messages, gen_kwargs,
+                       stops: list[str] | None = None):
     cid = _completion_id()
     set_request_id(cid)         # spans from this generation carry the cid
     async with state.lock:      # locked fallback: one inference at a time
         aiter, result, cancel = run_generation_streamed(state.model, messages,
                                                         gen_kwargs)
         return await _sse_drain(request, state, cid, aiter, result,
-                                cancel.set)
+                                cancel.set, stops)
 
 
 async def list_models(request: web.Request) -> web.Response:

@@ -277,6 +277,75 @@ def slot_splice_block_layers(cfg: ModelConfig, pool_layers: list[dict],
     return out
 
 
+def truncate_layers(cfg: ModelConfig, layers: list[dict], new_end,
+                    layer_range: tuple[int, int] | None = None) -> list[dict]:
+    """Mark every KV entry at absolute position >= new_end empty (pos -1)
+    across the whole batch — the speculative-decoding rejected-suffix
+    rollback, traceable (new_end may be a traced scalar) so the verify
+    program can truncate in the same compiled step that discovered the
+    rejection. K/V bytes are left in place: position-based masking makes
+    a pos==-1 slot invisible, and the next write re-scatters over it.
+
+    Linear-attention layers pass through UNCHANGED: a recurrent state
+    cannot be truncated after the fact. Callers with linear layers must
+    instead rebuild the state with a valid_len-masked commit forward
+    (TextModel's verify program does exactly that — the same machinery
+    that keeps bucketed-prefill padding out of the state).
+    """
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    out = []
+    for i, lc in zip(range(lo, hi), layers):
+        if cfg.layer_spec(i).kind == "linear":
+            out.append(lc)
+            continue
+        pos = lc["pos"]
+        out.append({"k": lc["k"], "v": lc["v"],
+                    "pos": jnp.where(pos >= new_end, -1, pos)})
+    return out
+
+
+def slot_truncate_layers(cfg: ModelConfig, pool_layers: list[dict], slot,
+                         new_end,
+                         layer_range: tuple[int, int] | None = None
+                         ) -> list[dict]:
+    """truncate_layers for ONE row of a batched cache pool: entries of row
+    `slot` at positions >= new_end become empty, other rows untouched —
+    the serve engine's per-slot speculative rollback. `slot`/`new_end`
+    may be traced scalars. Linear layers pass through (see
+    truncate_layers for the contract)."""
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    out = []
+    for i, pl in zip(range(lo, hi), pool_layers):
+        if cfg.layer_spec(i).kind == "linear":
+            out.append(pl)
+            continue
+        row = pl["pos"][slot]
+        out.append({"k": pl["k"], "v": pl["v"],
+                    "pos": pl["pos"].at[slot].set(
+                        jnp.where(row >= new_end, -1, row))})
+    return out
+
+
+def truncate_cache(cfg: ModelConfig, cache: dict, new_end: int,
+                   layer_range: tuple[int, int] | None = None) -> dict:
+    """Host-level cache rollback to positions < new_end (pos scalar
+    clamped too) — the draft-model drafter discards its own speculative
+    suffix with this between proposals. Raises for linear-attention
+    layers: their state cannot roll back, and a silent pass-through here
+    would hand the caller a cache that CLAIMS new_end tokens but carries
+    state from more (slot_truncate_layers documents pass-through instead
+    because its in-trace caller handles linear commit itself)."""
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    for i in range(lo, hi):
+        if cfg.layer_spec(i).kind == "linear":
+            raise ValueError(
+                "truncate_cache cannot roll back linear-attention state; "
+                "use a valid_len-masked re-forward instead")
+    return {"layers": truncate_layers(cfg, cache["layers"], new_end,
+                                      (lo, hi)),
+            "pos": jnp.minimum(cache["pos"], new_end)}
+
+
 def cache_reset(cache: dict) -> dict:
     """Clear all state (ref: cache clear on Goodbye, worker.rs:364-384)."""
     def zero_layer(lc):

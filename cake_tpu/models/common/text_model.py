@@ -38,10 +38,11 @@ import numpy as np
 from ...obs import (DECODE_TOKEN_SECONDS, GENERATED_TOKENS, RECORDER,
                     TTFT_SECONDS, now)
 from ...ops.sampling import (SamplingConfig, push_recent_token, sample,
-                             sample_traced)
+                             sample_traced, spec_accept)
 from .cache import (grow_cache, init_cache, kv_capacity, slot_assign_layers,
                     slot_extract_block_layers, slot_reset_layers,
-                    slot_splice_block_layers)
+                    slot_splice_block_layers, slot_truncate_layers,
+                    truncate_layers)
 from .config import ModelConfig
 from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
 
@@ -408,6 +409,86 @@ class TextModel:
                 rcache["layers"])
             return logits, layers
 
+        # -- speculative verify: k drafted tokens in ONE bucketed step ------
+        # A verify step is a prefill-chunk-shaped forward over
+        # [last_token, d_0 .. d_{k-1}] at pos0 with logits kept at ALL
+        # positions, followed by the traced accept/reject rule
+        # (ops.sampling.spec_accept) and the rejected-suffix rollback —
+        # everything inside one compiled program, so a verify costs one
+        # device call exactly like a decode step.
+        has_linear = any(s.kind == "linear" for s in cfg.layer_specs())
+
+        def _verify_core(params, tokens, cache, pos0, n_input, draft, rng,
+                         recent, temp, top_k, top_p, penalty):
+            """tokens: [1, S] (S = K+1, entries >= n_input are padding);
+            draft: [K]; n_input = n_draft + 1 (traced). Returns
+            (n_acc, next_token, committed_cache, recent').
+
+            Pass 1 forwards all n_input tokens (valid_len keeps padding out
+            of the KV scatter and the GDN state scan) and keeps logits at
+            every position. The rollback of the rejected suffix splits on
+            the model's layer mix, statically:
+              * attention-only: pass 1's cache already holds all n_input
+                entries; truncate_layers marks positions past the accepted
+                prefix empty — zero extra compute;
+              * any linear layer: the recurrent state cannot be truncated,
+                so the commit re-runs the forward with valid_len =
+                n_acc + 1 from the ORIGINAL cache — the same masking that
+                keeps bucketed-prefill padding out of the state now keeps
+                the rejected suffix out, bit-exactly. XLA dead-code-
+                eliminates pass 1's unused cache outputs.
+            """
+            x = embed_tokens(cfg, params, tokens)
+            x1, c1 = forward_layers(cfg, params, x, cache, pos0,
+                                    valid_len=n_input, mesh=mesh)
+            logits = lm_head_logits(cfg, params, x1)[0]        # [S, V]
+            n_acc, nxt, recent = spec_accept(logits, draft, n_input - 1,
+                                             rng, temp, top_k, top_p,
+                                             penalty, recent)
+            commit = n_acc + 1
+            if has_linear:
+                _, committed = forward_layers(cfg, params, x, cache, pos0,
+                                              valid_len=commit, mesh=mesh)
+            else:
+                committed = {"layers": truncate_layers(
+                    cfg, c1["layers"], pos0 + commit), "pos": pos0 + commit}
+            return n_acc, nxt, committed, recent
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def _spec_verify(params, tokens, cache, pos0, n_input, draft, rng,
+                         recent, temp, top_k, top_p, penalty):
+            """Batch-1 verify (the generate() speculative loop)."""
+            n_acc, nxt, cache, recent = _verify_core(
+                params, tokens, cache, pos0, n_input, draft, rng, recent,
+                temp, top_k, top_p, penalty)
+            return jnp.stack([n_acc, nxt]), cache, recent
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        def _spec_slot(params, layers, toks, pos, rngs, recents, slot,
+                       draft, n_draft, temp, top_k, top_p, penalty):
+            """Row-targeted verify for the serve engine: gather pool row
+            `slot` to a batch-1 view (the prefill_chunk idiom), verify the
+            drafts against it, scatter the committed row back, and advance
+            the slot's device-resident carries (token/pos/rng/recent) by
+            the accepted length. Returns (packed [3] = [input_token,
+            n_acc, next_token], layers, toks, pos, rngs, recents) — the
+            input token rides along so a just-activated slot's unemitted
+            first token still reaches the host in the same fetch."""
+            row = {"layers": jax.tree_util.tree_map(
+                lambda a: a[slot][None], layers), "pos": pos[slot]}
+            tok_in = toks[slot]
+            tokens = jnp.concatenate([tok_in[None], draft])[None, :]
+            rng, sub = jax.random.split(rngs[slot])
+            n_acc, nxt, committed, recent = _verify_core(
+                params, tokens, row, pos[slot], n_draft + 1, draft, sub,
+                recents[slot], temp, top_k, top_p, penalty)
+            layers = jax.tree_util.tree_map(
+                lambda full, r: full.at[slot].set(r[0]), layers,
+                committed["layers"])
+            return (jnp.stack([tok_in, n_acc, nxt]), layers,
+                    toks.at[slot].set(nxt), pos.at[slot].add(n_acc + 1),
+                    rngs.at[slot].set(rng), recents.at[slot].set(recent))
+
         @functools.partial(jax.jit, static_argnames=("width",))
         def _slot_extract(layers, slot, start, width):
             return slot_extract_block_layers(cfg, layers, slot, start, width)
@@ -418,6 +499,8 @@ class TextModel:
                                             final)
 
         self._prefill = _prefill
+        self._spec_verify = _spec_verify
+        self._spec_slot = _spec_slot
         self._decode_slots = _decode_slots
         self._slot_assign = _slot_assign
         self._slot_reset = _slot_reset
@@ -525,6 +608,61 @@ class TextModel:
         return self._sample_traced(logits, rng, temp, top_k, top_p, penalty,
                                    recent)
 
+    # -- speculative decoding ------------------------------------------------
+
+    @staticmethod
+    def _scfg_traced(scfg: SamplingConfig, vocab: int) -> tuple:
+        """SamplingConfig -> the traced scalars the verify programs take
+        (same disabled-value conventions as sample_traced)."""
+        return (jnp.float32(scfg.temperature),
+                jnp.int32(scfg.top_k or vocab),
+                jnp.float32(scfg.top_p if scfg.top_p is not None else 1.0),
+                jnp.float32(scfg.repeat_penalty))
+
+    def verify_tokens(self, cache, last_token: int, draft_ids, k: int,
+                      pos0: int, rng, recent, scfg: SamplingConfig):
+        """One speculative verify step on a batch-1 cache: forward
+        [last_token, draft...] (padded to a fixed k+1 width — ONE
+        executable per k) at pos0, run the traced accept/reject rule, and
+        commit exactly the accepted prefix (rejected-suffix KV rolled
+        back in the same program). Returns (packed [2] = [n_acc,
+        next_token], cache, recent') — one small fetch gives the host
+        everything it needs to emit n_acc + 1 tokens."""
+        draft = np.zeros((k,), np.int32)
+        n_draft = min(len(draft_ids), k)
+        draft[:n_draft] = np.asarray(list(draft_ids[:n_draft]), np.int32)
+        cap = kv_capacity(self.cfg, cache)
+        check_prefill_bounds(n_draft + 1, pos0, cap, self.max_cache_len)
+        tokens = np.zeros((1, k + 1), np.int32)
+        tokens[0, 0] = last_token
+        tokens[0, 1:1 + n_draft] = draft[:n_draft]
+        temp, top_k, top_p, pen = self._scfg_traced(scfg,
+                                                    self.cfg.vocab_size)
+        return self._spec_verify(self.params, jnp.asarray(tokens), cache,
+                                 jnp.asarray(pos0, jnp.int32),
+                                 jnp.asarray(n_draft + 1, jnp.int32),
+                                 jnp.asarray(draft), rng, recent,
+                                 temp, top_k, top_p, pen)
+
+    def spec_slot(self, layers, toks, pos, rngs, recents, slot: int,
+                  draft_ids, k: int, scfg: SamplingConfig):
+        """Speculative verify step against pool row `slot` (the serve
+        engine's shallow-batch speculation unit): drafts are checked
+        against the row's KV in one program that also advances the slot's
+        device-resident token/pos/rng/recent carries by the accepted
+        length. Returns (packed [3] = [input_token, n_acc, next_token],
+        layers, toks, pos, rngs, recents)."""
+        draft = np.zeros((k,), np.int32)
+        n_draft = min(len(draft_ids), k)
+        draft[:n_draft] = np.asarray(list(draft_ids[:n_draft]), np.int32)
+        temp, top_k, top_p, pen = self._scfg_traced(scfg,
+                                                    self.cfg.vocab_size)
+        return self._spec_slot(self.params, layers, toks, pos, rngs,
+                               recents, jnp.asarray(slot, jnp.int32),
+                               jnp.asarray(draft),
+                               jnp.asarray(n_draft, jnp.int32),
+                               temp, top_k, top_p, pen)
+
     # -- inference ----------------------------------------------------------
 
     def _sp_size(self) -> int:
@@ -571,7 +709,8 @@ class TextModel:
     def generate(self, prompt_ids: list[int], max_new_tokens: int = 256,
                  sampling: SamplingConfig | None = None,
                  on_token: Callable[[Token], None] | None = None,
-                 chunk: int = 16, rng=None) -> tuple[list[int], dict]:
+                 chunk: int = 16, rng=None, spec=None,
+                 spec_k: int | None = None) -> tuple[list[int], dict]:
         """Streamed generation. Returns (token_ids, stats).
 
         Without an `on_token` callback the whole decode runs as ONE device
@@ -582,11 +721,23 @@ class TextModel:
         STREAM_DEPTH-deep in flight (the next chunk chains off the device
         carry, no host round trip), so tokens stream with bounded latency
         while fetch syncs overlap compute; EOS is checked between chunks.
+
+        `spec` switches decode to SPECULATIVE mode (cake_tpu/spec/): a
+        drafter proposes up to `spec_k` tokens per step (env CAKE_SPEC_K)
+        and one bucketed verify step accepts a prefix of them — greedy
+        output stays bit-identical, sampled output keeps the target
+        distribution (see docs/speculative.md). Accepts a Drafter
+        instance, "ngram", a draft TextModel, None (env CAKE_SPEC, off
+        when unset) or False (force off, ignoring the env).
         """
         cfg = self.cfg
         scfg = sampling or SamplingConfig()
         rng = self._rng if rng is None else rng
         streaming = on_token is not None
+        drafter = k_spec = None
+        if spec is not False:
+            from ...spec import resolve_drafter
+            drafter, k_spec = resolve_drafter(spec, spec_k)
         # smallest bucket covering everything the first device call will
         # write — grown bucket-by-bucket below so decode never attends over
         # unused slots (the non-streaming path grows between segments)
@@ -614,7 +765,13 @@ class TextModel:
 
         t1 = now()
         pos = len(prompt_ids)            # next write position (first token)
-        if not streaming:
+        spec_stats = None
+        if drafter is not None:
+            from ...spec.verify import spec_decode_loop
+            out, spec_stats = spec_decode_loop(
+                self, drafter, k_spec, prompt_ids, out, cache, kv_len,
+                rng, recent, scfg, max_new_tokens, on_token, done)
+        elif not streaming:
             # while_loop decode in cache-bucket-sized segments: each segment
             # is ONE device call filling the current KV bucket, then the
             # bucket grows — EOS waste stays bounded by the current bucket
@@ -708,6 +865,8 @@ class TextModel:
             "decode_s": dt,
             "tok_per_s": (len(out) - 1) / dt if dt > 0 and len(out) > 1 else 0.0,
         }
+        if spec_stats is not None:
+            stats.update(spec_stats)
         _observe_generation(stats, len(out), path="local")
         return out, stats
 

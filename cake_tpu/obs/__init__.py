@@ -103,6 +103,21 @@ SERVE_PREFIX_BYTES = REGISTRY.gauge(
     "cake_serve_prefix_cache_bytes",
     "Device bytes held by cached prefix blocks")
 
+SPEC_PROPOSED = REGISTRY.counter(
+    "cake_serve_spec_proposed_total",
+    "Draft tokens proposed to speculative verify steps (local generate "
+    "and serve-engine paths)")
+
+SPEC_ACCEPTED = REGISTRY.counter(
+    "cake_serve_spec_accepted_total",
+    "Draft tokens accepted by speculative verify steps")
+
+SPEC_ACCEPTED_LEN = REGISTRY.histogram(
+    "cake_serve_spec_accepted_length",
+    "Accepted draft tokens per speculative verify step (0 = every draft "
+    "rejected; the step still emits its correction token)",
+    buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+
 SERVE_QUEUE_TIMEOUTS = REGISTRY.counter(
     "cake_serve_queue_timeouts_total",
     "Requests expired in the admission queue past CAKE_QUEUE_DEADLINE_S "
@@ -155,4 +170,5 @@ __all__ = [
     "SERVE_PREFIX_MISSES", "SERVE_PREFIX_EVICTIONS", "SERVE_PREFIX_BYTES",
     "SERVE_QUEUE_TIMEOUTS", "CLUSTER_STAGE_FAILURES", "CLUSTER_RECONNECTS",
     "CLUSTER_REPLAYS", "CLUSTER_DEGRADED", "CLUSTER_HOP_DEGRADED",
+    "SPEC_PROPOSED", "SPEC_ACCEPTED", "SPEC_ACCEPTED_LEN",
 ]

@@ -163,3 +163,101 @@ def push_recent_token(recent_tokens, token):
     """Shift a new token into the device-resident recent-token ring
     (drives the repeat penalty without host round-trips)."""
     return jnp.concatenate([recent_tokens[1:], token.reshape(1)])
+
+
+# -- speculative decoding: traced target distribution + acceptance rule ------
+
+
+def filtered_probs(logits, temperature, top_k, top_p, repeat_penalty,
+                   recent_tokens):
+    """The target distribution p the sampled decode path draws from, as an
+    explicit [V] probability vector in VOCAB order — the quantity the
+    speculative accept/reject rule needs (sample_traced only ever needs the
+    argmax of the gumbel-perturbed logits, so it never materializes p).
+
+    Same traced pipeline as sample_traced: sign-aware repeat penalty,
+    temperature, one descending sort serving the top-k rank mask and the
+    top-p cumulative-mass mask measured on the top-k-renormalized
+    distribution. temperature <= 0 degenerates to (almost) a point mass at
+    the penalized argmax — ties split evenly, and downstream greedy
+    consumers take jnp.argmax(p), which breaks ties to the lowest id
+    exactly like sample_argmax.
+    """
+    v = logits.shape[-1]
+    lf = logits.astype(jnp.float32)
+    idx = jnp.where(recent_tokens < 0, v, recent_tokens)
+    flagged = jnp.zeros((v,), jnp.bool_).at[idx].set(True, mode="drop")
+    penalized = jnp.where(lf >= 0, lf / repeat_penalty, lf * repeat_penalty)
+    lf = jnp.where(flagged, penalized, lf)
+    scaled = lf / jnp.maximum(temperature, 1e-6)
+    order = jnp.argsort(-scaled)                       # stable: ties -> low id
+    sorted_logits = scaled[order]
+    rank = jnp.arange(v, dtype=jnp.int32)
+    probs = jax.nn.softmax(jnp.where(rank < top_k, sorted_logits, -jnp.inf))
+    prev_mass = jnp.cumsum(probs) - probs
+    keep = (rank < top_k) & (prev_mass < top_p)
+    keep = keep.at[0].set(True)                        # never mask every token
+    kept = jnp.where(keep, probs, 0.0)
+    kept = kept / jnp.maximum(jnp.sum(kept), 1e-30)
+    return jnp.zeros((v,), jnp.float32).at[order].set(kept)
+
+
+def spec_accept(logits, draft, n_draft, rng, temperature, top_k, top_p,
+                repeat_penalty, recent_tokens):
+    """Traced speculative accept/reject loop (Leviathan et al. 2023; Chen
+    et al. 2023) for a DETERMINISTIC drafter (point-mass q — the n-gram
+    drafter and the greedy draft-model drafter both are).
+
+    logits: [S, V] verify-forward logits, row i = target distribution for
+    the token following input i (S >= n_draft + 1); draft: [K] int32
+    proposals, entries >= n_draft are padding; rng: consumed key.
+
+    Greedy target (temperature <= 0): accept draft[i] iff it equals the
+    penalized argmax — exact prefix match, so the emitted sequence is
+    BIT-IDENTICAL to non-speculative greedy decoding. Sampled target: with
+    q = delta at draft[i], the rejection rule accepts with probability
+    min(1, p(x)/q(x)) = p(x) and on rejection resamples from the residual
+    norm(max(0, p - q)) = p with x's mass removed — the marginal
+    distribution of each emitted token is exactly p (p(x)*1 +
+    (1-p(x)) * p(t)/(1-p(x)) = p(t)), so speculation never changes the
+    output distribution, only the number of device steps.
+
+    Returns (n_acc in [0, n_draft], next_token, recent') where next_token
+    is the correction (rejection at position n_acc) or the bonus token
+    (all n_draft accepted), and recent' has the accepted tokens AND
+    next_token pushed — positions later in the same verify step see
+    earlier accepted tokens in their repeat-penalty window, matching the
+    one-token-at-a-time path.
+    """
+    k = draft.shape[0]
+    greedy = temperature <= 0.0
+
+    def body(i, carry):
+        n_acc, alive, recent = carry
+        p = filtered_probs(logits[i], temperature, top_k, top_p,
+                           repeat_penalty, recent)
+        d = draft[i]
+        u = jax.random.uniform(jax.random.fold_in(rng, i))
+        ok = jnp.where(greedy, d == jnp.argmax(p), u < p[d])
+        accept = alive & ok & (i < n_draft)
+        n_acc = n_acc + accept.astype(jnp.int32)
+        recent = jnp.where(accept, push_recent_token(recent, d), recent)
+        return n_acc, accept, recent
+
+    n_acc, _, recent = jax.lax.fori_loop(
+        0, k, body,
+        (jnp.asarray(0, jnp.int32), jnp.asarray(True), recent_tokens))
+    p = filtered_probs(logits[n_acc], temperature, top_k, top_p,
+                       repeat_penalty, recent)
+    # rejected at n_acc: resample from the residual (p minus the rejected
+    # point mass, renormalized); all accepted: plain sample from p
+    rejected = n_acc < n_draft
+    d_rej = draft[jnp.clip(n_acc, 0, k - 1)]
+    resid = p.at[d_rej].set(jnp.where(rejected, 0.0, p[d_rej]))
+    resid = resid / jnp.maximum(jnp.sum(resid), 1e-30)
+    nxt = jnp.where(
+        greedy, jnp.argmax(p),
+        jax.random.categorical(jax.random.fold_in(rng, k),
+                               jnp.log(jnp.maximum(resid, 1e-38)))
+    ).astype(jnp.int32)
+    return n_acc, nxt, push_recent_token(recent, nxt)

@@ -58,15 +58,18 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
 
 
 def ring_attention_sharded(q, k, v, axis_name: str, scale: float | None = None,
-                           vary_axes: tuple[str, ...] = ()):
+                           vary_axes: tuple[str, ...] = (),
+                           axis_size: int | None = None):
     """Body run per-device under shard_map: q/k/v are the local sequence
     shards [B, S_local, H(.kv), D]; global sequence = concat over the axis.
     vary_axes: additional manual mesh axes the inputs vary over (e.g. the
     tp head axis) — the accumulators must be cast varying over them too or
-    the fori_loop carry type mismatches."""
+    the fori_loop carry type mismatches. axis_size: static ring size from
+    the mesh — older jax has no jax.lax.axis_size accessor, and the
+    ppermute schedule below needs the concrete value either way."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size if axis_size is not None else jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, s_local = q.shape[0], q.shape[1]
 
@@ -75,11 +78,16 @@ def ring_attention_sharded(q, k, v, axis_name: str, scale: float | None = None,
 
     # pvary: accumulators start device-varying over the ring axis (and any
     # extra manual axes) so the fori_loop carry type matches (shard_map
-    # manual-axes typing rule)
+    # manual-axes typing rule). Older jax has no varying-type system (and
+    # no pcast) — there the shard_map is built with check_rep=False and
+    # the plain accumulators are already well-typed.
+    pcast = getattr(jax.lax, "pcast", None)
     vary = (axis_name, *vary_axes)
-    acc = jax.lax.pcast(jnp.zeros(q.shape, jnp.float32), vary, to='varying')
-    m = jax.lax.pcast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), vary, to='varying')
-    l = jax.lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), vary, to='varying')
+    cast = ((lambda a: pcast(a, vary, to='varying')) if pcast is not None
+            else (lambda a: a))
+    acc = cast(jnp.zeros(q.shape, jnp.float32))
+    m = cast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32))
+    l = cast(jnp.zeros(q.shape[:3], jnp.float32))
 
     def step(i, carry):
         acc, m, l, k_blk, v_blk = carry
@@ -112,8 +120,19 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     h = (head_axis if head_axis in mesh.axis_names
          and mesh.shape[head_axis] > 1 else None)
     fn = functools.partial(ring_attention_sharded, axis_name=axis, scale=scale,
-                           vary_axes=(h,) if h else ())
+                           vary_axes=(h,) if h else (),
+                           axis_size=mesh.shape[axis])
     spec = P(None, axis, h, None)
-    mapped = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec)
+    # jax.shard_map is the promoted name (jax >= 0.5); older releases only
+    # ship jax.experimental.shard_map.shard_map, whose replication checker
+    # predates the varying-type annotations the body would need — disable
+    # it there (the out_specs still pin the result layout)
+    smap = getattr(jax, "shard_map", None)
+    if smap is not None:
+        mapped = smap(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+        mapped = _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_rep=False)
     return mapped(q, k, v)

@@ -34,14 +34,22 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, params,
     """Returns (train_step, opt_state). train_step(params, opt_state, tokens)
     -> (params, opt_state, loss), jitted with sharded in/out."""
     tx = optax.adamw(learning_rate)
-    opt_state = tx.init(params)
 
-    p_shard = params_shardings(params, mesh)
+    # params arrive already committed to params_shardings layouts
+    # (shard_params) — leave their in_shardings UNSPECIFIED so the step
+    # follows the committed layout instead of re-declaring it: with an
+    # explicit respec, GSPMD may hand back a propagated layout for a
+    # donated buffer (e.g. a tied embed row-sharded by the lm_head
+    # matmul) and the second step either raises an in_shardings/arg
+    # mismatch or breaks donation aliasing on older jax. Committing the
+    # params here keeps the first/steady-state layouts identical.
+    params = jax.device_put(params, params_shardings(params, mesh))
+    opt_state = tx.init(params)
     tok_shard = NamedSharding(mesh, P("dp" if "dp" in mesh.axis_names else None,
                                       None))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1),
-                       in_shardings=(p_shard, None, tok_shard))
+                       in_shardings=(None, None, tok_shard))
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(cfg, p, tokens))(params)

@@ -47,6 +47,8 @@ from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
                    SERVE_QUEUE_TIMEOUTS, SERVE_QUEUE_WAIT_SECONDS,
                    SERVE_SLOTS_BUSY, now, set_request_id)
 from ..ops.sampling import SamplingConfig
+from ..spec import resolve_drafter
+from ..spec.verify import record_step
 from .admission import AdmissionQueue, QueueFull
 from .prefix_cache import PrefixCache
 from .slots import SlotPool, slot_bucket
@@ -231,7 +233,9 @@ class ServeEngine:
                  ctx_len: int | None = None, seed: int = 0,
                  prefill_chunk: int | None = None,
                  prefix_cache_mb: float | None = None,
-                 queue_deadline_s: float | None = None):
+                 queue_deadline_s: float | None = None,
+                 spec=None, spec_k: int | None = None,
+                 spec_max_busy: int | None = None):
         if not hasattr(model, "decode_slots"):
             raise TypeError(
                 f"{type(model).__name__} has no batched slot decode; the "
@@ -258,6 +262,27 @@ class ServeEngine:
             queue_deadline_s = float(os.environ.get("CAKE_QUEUE_DEADLINE_S",
                                                     "0") or 0)
         self.queue_deadline_s = queue_deadline_s
+        # -- speculative decoding: shallow-batch greedy slots only --------
+        # CAKE_SPEC names the drafter ("ngram"; unset = off), CAKE_SPEC_K
+        # the draft width, CAKE_SPEC_MAX_BUSY the occupancy ceiling
+        # (default slots // 2): a shallow batch leaves the MXUs idle, so a
+        # verify step converts that idle compute into accepted tokens —
+        # but a SATURATED pool is already compute-efficient, and per-slot
+        # verify calls would serialize what one batched decode step does
+        # in parallel, so speculation must stand down as occupancy rises.
+        drafter, k = resolve_drafter(spec, spec_k)
+        if drafter is not None and not drafter.shareable:
+            raise ValueError(
+                f"drafter {drafter.name!r} keeps per-sequence state and "
+                "cannot be shared across engine slots — use 'ngram' "
+                "(DraftModelDrafter belongs on the generate() path)")
+        self.spec_drafter = drafter
+        self.spec_k = k
+        if spec_max_busy is None:
+            spec_max_busy = int(os.environ.get("CAKE_SPEC_MAX_BUSY", "0")
+                                or 0) or max(1, slots // 2)
+        self.spec_max_busy = spec_max_busy
+        self.spec_steps = self.spec_proposed = self.spec_accepted = 0
         self._draining = threading.Event()
 
         pool_cache = model.new_cache(slots, kv_len=self.ctx)
@@ -383,6 +408,15 @@ class ServeEngine:
         }
         if self.prefix_cache is not None:
             h["prefix_cache"] = self.prefix_cache.occupancy()
+        if self.spec_drafter is not None:
+            h["spec"] = {
+                "drafter": self.spec_drafter.name,
+                "k": self.spec_k,
+                "max_busy": self.spec_max_busy,
+                "steps": self.spec_steps,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+            }
         return h
 
     def drain(self, timeout: float | None = None) -> bool:
@@ -488,12 +522,19 @@ class ServeEngine:
                 pass
             # 3. dispatch ONE batched decode step over the slots whose
             # prefill has completed (mid-prefill rows ride along frozen
-            # under the active mask)...
+            # under the active mask)... unless the batch is SHALLOW and
+            # all-greedy, in which case each slot takes a speculative
+            # verify step instead (draft k, verify once, emit 1..k+1) —
+            # occupancy above spec_max_busy falls back to plain batched
+            # decode so speculation never slows a saturated pool
             prefilling = {p.slot for p in self._prefills}   # post-admission
             active = [i for i in self.pool.busy()
                       if self._reqs[i] is not None and i not in prefilling]
             packed = None
-            if active:
+            if self._spec_eligible(active):
+                for i in active:
+                    self._spec_step(i)
+            elif active:
                 nb = slot_bucket(active[-1] + 1, self.slots)
                 SERVE_BATCH_OCCUPANCY.observe(len(active))
                 (packed, self._layers, self._toks, self._pos, self._rngs,
@@ -648,6 +689,60 @@ class ServeEngine:
         self._fail(pf.req, error)
         self._layers = self.model.slot_release(self._layers, pf.slot)
 
+    # -- speculative decode (shallow batch) ---------------------------------
+
+    def _spec_eligible(self, active: list[int]) -> bool:
+        """Speculate THIS iteration? All-or-nothing per iteration: every
+        active slot must be greedy (the engine verifies with the slot's
+        own sampling params, but mixed spec/decode iterations would need
+        a partial active mask — not worth it at the shallow occupancies
+        where speculation pays), past its first-token fetch (the verify
+        input token must be known to the drafter's host-side sequence, up
+        to the one unfetched input the packed result carries), and the
+        occupancy must not exceed spec_max_busy."""
+        if self.spec_drafter is None or not active:
+            return False
+        if len(active) > self.spec_max_busy:
+            return False
+        for i in active:
+            req = self._reqs[i]
+            if req.sampling.temperature > 0 or req._first_pending:
+                return False
+        return True
+
+    def _spec_step(self, slot: int):
+        """One speculative verify step for `slot`: host drafter proposes
+        from the request's committed sequence, the row-targeted verify
+        program checks all proposals in one device call, and the fetched
+        (input, n_acc, next) triple fans 1..k+1 tokens into the stream."""
+        req = self._reqs[slot]
+        pos = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
+        k = min(self.spec_k, self.ctx - pos - 1, max(req.budget, 0))
+        draft = list(self.spec_drafter.propose(
+            req.prompt_ids + req.tokens, k))[:k] if k > 0 else []
+        set_request_id(req.id)
+        try:
+            with RECORDER.span("spec.verify", cat="serve", slot=slot,
+                               drafts=len(draft), pos=pos):
+                (packed, self._layers, self._toks, self._pos, self._rngs,
+                 self._recents) = self.model.spec_slot(
+                    self._layers, self._toks, self._pos, self._rngs,
+                    self._recents, slot, draft, self.spec_k, req.sampling)
+                arr = np.asarray(packed)
+        finally:
+            set_request_id(None)
+        n_acc, nxt = int(arr[1]), int(arr[2])
+        self.spec_steps += 1
+        self.spec_proposed += len(draft)
+        self.spec_accepted += n_acc
+        record_step(len(draft), n_acc)
+        for t in draft[:n_acc] + [nxt]:
+            req.budget -= 1
+            self._emit(req, t)
+            if self.model.cfg.is_eos(t) or req.budget <= 0:
+                self._finish(slot, req)
+                return
+
     # -- batched decode -----------------------------------------------------
 
     def _fanout(self, active: list[int], arr: np.ndarray):
@@ -724,10 +819,12 @@ def maybe_engine(model, slots: int | None = None,
     """Engine for serve-capable models, tuned by env: CAKE_SERVE_SLOTS
     (default 4, 0 disables), CAKE_MAX_QUEUE (default 64), CAKE_SERVE_CTX
     (default 4096, capped by the model's max_cache_len), CAKE_PREFILL_CHUNK
-    (default 256 — per-iteration chunked-admission token budget) and
-    CAKE_PREFIX_CACHE_MB (default 256, 0 disables shared-prefix KV reuse;
-    both read inside ServeEngine). Distributed / offloaded models return
-    None — the API keeps its locked fallback."""
+    (default 256 — per-iteration chunked-admission token budget),
+    CAKE_PREFIX_CACHE_MB (default 256, 0 disables shared-prefix KV reuse)
+    and the speculative-decoding knobs CAKE_SPEC / CAKE_SPEC_K /
+    CAKE_SPEC_MAX_BUSY (all read inside ServeEngine; see
+    docs/speculative.md). Distributed / offloaded models return None —
+    the API keeps its locked fallback."""
     from ..models.common.text_model import TextModel
     if not isinstance(model, TextModel):
         return None

@@ -1,0 +1,34 @@
+"""Speculative decoding subsystem: draft, verify, accept — without
+leaving the device.
+
+Decode is memory-bound: every step reads all the weights to emit ONE
+token, leaving the MXUs idle. Speculation converts that idle compute
+into extra tokens: a cheap DRAFTER proposes up to CAKE_SPEC_K
+continuation tokens, one bucketed VERIFY step forwards them all (the
+weight read is amortized over k+1 positions), and a traced
+accept/reject rule keeps exactly the prefix the target model agrees
+with — greedy output is bit-identical to plain decoding, sampled output
+keeps the target distribution (Leviathan et al. 2023; Chen et al. 2023).
+
+Layout:
+  drafter.py — Drafter protocol, NGramDrafter (zero-weight prompt
+               lookup), DraftModelDrafter (two-model speculation)
+  verify.py  — the host loop + shared spec metrics; the traced pieces
+               are ops/sampling.spec_accept and TextModel's verify
+               programs (models/common/text_model.py), with the
+               rejected-suffix rollback in cache.{truncate_layers,
+               slot_truncate_layers}
+
+Entry points: TextModel.generate(spec=..., spec_k=...) and the serve
+engine's slot-occupancy-aware speculation (serve/engine.py); env knobs
+CAKE_SPEC / CAKE_SPEC_K / CAKE_SPEC_MAX_BUSY. See docs/speculative.md.
+"""
+from .drafter import (DEFAULT_SPEC_K, Drafter, DraftModelDrafter,
+                      MAX_SPEC_K, NGramDrafter, resolve_drafter)
+from .verify import record_step, spec_decode_loop, spec_stats_dict
+
+__all__ = [
+    "Drafter", "DraftModelDrafter", "NGramDrafter", "resolve_drafter",
+    "spec_decode_loop", "record_step", "spec_stats_dict",
+    "DEFAULT_SPEC_K", "MAX_SPEC_K",
+]

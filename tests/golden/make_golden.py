@@ -29,7 +29,34 @@ SEED = 7
 PROMPT = [11, 23, 5, 190, 77, 3, 149, 66, 20]
 
 
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def fixture_prng():
+    """Pin the PRNG implementation the committed fixtures were generated
+    under. The fixtures come from SEEDED RANDOM WEIGHTS, and jax's
+    threefry stream for a given key differs between partitionable (the
+    default on newer jax) and non-partitionable (the default on the jax
+    this container ships) — without the pin every golden comparison fails
+    with ~0.5-magnitude diffs that look like a numerics regression but
+    are simply different weights. The flag only affects random-bit
+    generation, never matmul/attention numerics, so pinning it keeps the
+    regression test honest."""
+    old = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_threefry_partitionable", old)
+
+
 def build(fam: str) -> dict[str, np.ndarray]:
+    with fixture_prng():
+        return _build(fam)
+
+
+def _build(fam: str) -> dict[str, np.ndarray]:
     cfg = tiny_config(fam, eos_token_id=255)
     model = TextModel(cfg, dtype=jnp.float32, seed=SEED, max_cache_len=64)
     out: dict[str, np.ndarray] = {}

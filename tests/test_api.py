@@ -139,6 +139,87 @@ def test_chat_stream_sse():
     with_client(make_state(), scenario)
 
 
+def test_chat_stop_string():
+    # stop=" world": content trimmed at the match, finish_reason "stop"
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "stop": " world"})
+        assert r.status == 200
+        data = await r.json()
+        assert data["choices"][0]["message"]["content"] == "Hello"
+        assert data["choices"][0]["finish_reason"] == "stop"
+    with_client(make_state(), scenario)
+
+
+def test_chat_stop_list_earliest_wins():
+    # " !" appears later than " world": the EARLIEST match trims
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "stop": [" !", " world"]})
+        data = await r.json()
+        assert data["choices"][0]["message"]["content"] == "Hello"
+        assert data["choices"][0]["finish_reason"] == "stop"
+    with_client(make_state(), scenario)
+
+
+def test_chat_stop_stream_sse():
+    # stop "o w" spans the token boundary "Hello"|" world": the matcher's
+    # holdback must keep every character of the match off the wire
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "stream": True, "stop": "o w"})
+        body = (await r.read()).decode()
+        chunks = [json.loads(line[6:]) for line in body.split("\n\n")
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == "Hell"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert body.strip().endswith("data: [DONE]")
+    with_client(make_state(), scenario)
+
+
+def test_chat_stop_stream_no_match_flushes_holdback():
+    # a stop that never completes must not eat the held-back tail
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "stream": True, "stop": " !ZZZ"})
+        body = (await r.read()).decode()
+        chunks = [json.loads(line[6:]) for line in body.split("\n\n")
+                  if line.startswith("data: ") and line != "data: [DONE]"]
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == "Hello world !"
+    with_client(make_state(), scenario)
+
+
+def test_chat_stop_validation():
+    async def scenario(client):
+        for bad in (5, ["a", ""], ["a", 3], ["1", "2", "3", "4", "5"]):
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "stop": bad})
+            assert r.status == 400, bad
+    with_client(make_state(), scenario)
+
+
+def test_stop_matcher_unit():
+    from cake_tpu.api.text import StopMatcher
+    m = StopMatcher(["ab"])
+    # split match: 'a' held back, then 'b' completes it — nothing emitted
+    assert m.feed("xa") == "x"
+    assert m.feed("by") == ""
+    assert m.stopped and m.flush() == ""
+    # no match: flush releases the held tail verbatim
+    m = StopMatcher(["zz"])
+    assert m.feed("abc") == "ab"
+    assert m.flush() == "c"
+
+
 def test_chat_validation():
     async def scenario(client):
         r = await client.post("/v1/chat/completions", json={})

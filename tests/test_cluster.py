@@ -414,6 +414,7 @@ def test_worker_cache_hit_skips_push(cluster_model_dir):
         t.join(timeout=5)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_two_worker_auto_assignment_cluster(cluster_model_dir):
     """Two workers with unequal TFLOPS: plan_assignments splits 3:1, both
     ranges stream + serve, generation matches fully-local (the mixed-cluster
@@ -491,6 +492,7 @@ def fp8_cluster_model_dir(tmp_path):
     return cfg, str(mdir), str(tmp_path / "wcache")
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_fp8_native_through_cluster_streaming(fp8_cluster_model_dir):
     """--fp8-native in distributed mode: f8e4m3 tensors stream verbatim to
     the worker (1 byte/param on the wire AND in worker HBM — the params
@@ -579,6 +581,7 @@ def test_warm_covers_every_serving_bucket_combo():
                 f"but kv bucket {kv} — warm sweep would miss this combo")
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_pipelined_prefill_matches_local(cluster_model_dir):
     """Long-prompt greedy parity through the pipelined chunked prefill:
     a 70-token prompt with prefill_chunk=32 flows through the stage chain
@@ -633,6 +636,7 @@ def test_pipelined_prefill_matches_local(cluster_model_dir):
         t.join(timeout=5)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_worker_error_keeps_connection_alive(cluster_model_dir):
     """A failed forward must produce a worker_error reply (raised master-
     side) WITHOUT killing the worker loop — the next valid request on the
@@ -740,6 +744,7 @@ def test_master_setup_partial_failure_closes_connections(cluster_model_dir):
         t.join(timeout=5)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_distributed_moe_matches_local(tmp_path):
     """MoE over the wire: workers load expert banks for their layer subset;
     greedy distributed == local (pins the subset-synthesized safetensors

@@ -405,6 +405,7 @@ def test_gray_failure_flagged_without_abort(live, local_ref):
         runner.degraded_ms = 0.0
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_retry_exhaustion_degrades_health_then_restores(cluster_model_dir,
                                                         local_ref):
     """Worker hard-crashes (listener gone): the retry budget drains, the

@@ -1,5 +1,6 @@
 """Expert provider tests: disk-offloaded MoE must match the resident
 dense-combine computation exactly (mirrors ref disk_expert_provider tests)."""
+import pytest
 import json
 
 import jax
@@ -131,6 +132,7 @@ def test_read_many_batched_preadv(tmp_path):
         np.testing.assert_array_equal(arr, st.read(n))
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_offloaded_model_end_to_end(tmp_path):
     """The PRODUCT --expert-offload path: load_model_params(expert_offload)
     leaves expert banks on disk (provider leaves, no stacked tensors) and

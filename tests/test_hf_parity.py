@@ -120,6 +120,7 @@ _TINY_HF = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
                 tie_word_embeddings=False)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_llama():
     from transformers import LlamaConfig, LlamaForCausalLM
     check_family(tiny_config("llama"), LlamaForCausalLM,

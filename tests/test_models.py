@@ -108,6 +108,7 @@ def test_generate_streams_and_stops():
     assert toks == toks2
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_decode_until_matches_chunked():
     """The single-device-call while_loop decode (non-streaming path) must
     emit exactly the chunked streaming path's tokens, greedy and sampled,

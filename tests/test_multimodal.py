@@ -132,6 +132,7 @@ def test_flux_generate_image():
     assert not np.array_equal(np.asarray(img), np.asarray(img3))
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_vibevoice_generate_speech():
     tts = VibeVoiceTTS(tiny_tts_config(), dtype=jnp.float32, max_frames=6)
     frames = []
@@ -148,6 +149,7 @@ def test_vibevoice_generate_speech():
     assert len(audio.pcm_bytes()) == 2 * len(audio.samples)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_vibevoice_voice_prompt_changes_output():
     tts = VibeVoiceTTS(tiny_tts_config(), dtype=jnp.float32, max_frames=4)
     a = tts.generate_speech("hi", max_frames=3)
@@ -211,6 +213,7 @@ def test_sd_generate_and_img2img():
     assert not np.array_equal(np.asarray(img), np.asarray(img_i))
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_sd_intermediate_images_and_trace(tmp_path):
     """intermediate_every decodes in-progress images through on_image
     (ref: sd.rs:526-529 intermediary_images) and trace_dir writes a JAX
@@ -288,6 +291,7 @@ def test_resample_antialias_removes_above_band():
     assert band < 0.05 * len(res) / 2, band
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_pipelines_run_in_bf16():
     """serve default dtype: the whole image path must not promote to f32
     (regression: np-scalar coefficients promoted bf16 latents)."""

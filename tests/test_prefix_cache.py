@@ -204,6 +204,7 @@ def test_engine_prefix_cache_disabled(model):
         eng.close()
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_engine_prefix_hit_matches_miss_gdn():
     """Same hit==miss pin through a qwen3_5-style model with LINEAR
     (GDN) layers: the per-block conv/recurrent-state snapshot — captured

@@ -277,6 +277,7 @@ def synth_sd2_dir(tmp_path):
     _word_level_tokenizer_json(tmp_path / "tokenizer" / "tokenizer.json", 96)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_sd2_load_and_generate(tmp_path):
     synth_sd2_dir(tmp_path)
     model = load_sd_image_model(str(tmp_path), dtype=jnp.float32)
@@ -391,6 +392,7 @@ def synth_sdxl_dir(tmp_path):
     _word_level_tokenizer_json(tmp_path / "tokenizer_2" / "tokenizer.json", 96)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_sdxl_load_and_generate(tmp_path):
     synth_sdxl_dir(tmp_path)
     model = load_sd_image_model(str(tmp_path), dtype=jnp.float32)

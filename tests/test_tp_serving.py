@@ -90,6 +90,7 @@ def test_build_text_model_tp_matches_single(tp_model_dir):
     assert got_s == want
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_tp_cache_growth_under_mesh(tp_model_dir):
     """KV bucket growth (the _grow_to path) keeps shardings and numerics."""
     cfg, params, mdir = tp_model_dir
@@ -104,6 +105,7 @@ def test_tp_cache_growth_under_mesh(tp_model_dir):
     assert got == want
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_worker_tp_through_product_path(tp_model_dir):
     """A worker started with tp=4 (the `cake-tpu worker --tp 4` path) serves
     its layer range sharded; distributed greedy matches fully-local."""
@@ -147,6 +149,7 @@ def test_worker_tp_through_product_path(tp_model_dir):
         t.join(timeout=5)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_master_local_stages_tp(tp_model_dir):
     """master_setup(mesh=...) shards the master's own local stages — the
     runtime path `cake-tpu run --cluster-key K --tp 4` takes when the master

@@ -4,6 +4,7 @@ model.prediction_head / model.acoustic_tokenizer.decoder / ... — the
 prefixes the reference wires in vibevoice.rs) and load through the public
 path, including a precomputed voice-prompt file (voice_prompt.rs format).
 """
+import pytest
 import json
 
 import jax
@@ -244,6 +245,7 @@ def test_vae_encoder_frame_count(tmp_path):
                                rtol=0.15, atol=0.02)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_raw_wav_voice_cloning(tmp_path):
     """generate_speech(voice_wav=...) must condition on the encoded
     reference: output differs from the no-voice path, and the encoder

@@ -1,10 +1,22 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke spec-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke spec-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
+
+# static-analysis gate (docs/static_analysis.md): AST checkers for the
+# serving hot path — host-sync, recompile-hazard, use-after-donate,
+# knob-registry, lock-discipline, hot-timing. Exits non-zero on any
+# violation that lacks an in-line `# lint: disable=<rule> — <reason>`.
+lint:
+	python -m cake_tpu.analysis
+
+# regenerate docs/knobs.md from the central registry (cake_tpu/knobs.py);
+# tests/test_analysis.py pins the file to the registry
+knobs-doc:
+	python -m cake_tpu.knobs > docs/knobs.md
 
 native:
 	$(MAKE) -C csrc
@@ -18,18 +30,18 @@ bench:
 bench-micro:
 	python benches/bench_micro.py
 
-# observability gate: hot-path timing lint (no ad-hoc time.monotonic
-# deltas outside cake_tpu/obs) + a tiny traced CPU generation asserting
-# /metrics histograms and the Chrome-trace export are live
-obs-smoke:
-	python scripts/check_hot_timing.py
+# observability gate: the static-analysis pass (hot-timing absorbed
+# check_hot_timing.py; the other five rules ride along) + a tiny traced
+# CPU generation asserting /metrics histograms and the Chrome-trace
+# export are live
+obs-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 # continuous-batching gate: concurrent chats 200 through the engine, a 429
 # + Retry-After under queue saturation, non-zero serve-queue gauges in
 # /metrics while saturated, and non-zero prefix-cache hits on repeated
 # prompts (tiny CPU model, in-process aiohttp)
-serve-smoke:
+serve-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
 # fault-tolerance gate: master + 2 real workers on localhost, one worker

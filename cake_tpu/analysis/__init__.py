@@ -1,0 +1,38 @@
+"""Static analysis for the serving hot path: an AST checker framework
+plus runtime sanitizers.
+
+Five invariants keep the continuous-batching engine fast, and all of
+them are invisible to the test suite until they regress in production:
+
+  * `host-sync` — hot loops perform exactly the planned device->host
+    fetches and no accidental ones;
+  * `recompile-hazard` — steady-state decode never compiles a new
+    executable (stable jit cache keys, no shape-branching surprises);
+  * `use-after-donate` — buffers passed at donate_argnums positions are
+    dead; the name must be rebound before the next read;
+  * `knob-registry` — every CAKE_* env read goes through cake_tpu.knobs
+    (typed default, generated docs);
+  * `lock-discipline` — `# guarded-by:` annotated fields are only
+    touched under their lock;
+
+plus `hot-timing` (absorbed from PR 1's check_hot_timing.py): wall-clock
+calls on hot paths belong to cake_tpu.obs.
+
+Run `python -m cake_tpu.analysis` (or `make lint`); suppress a deliberate
+violation in-line with `# lint: disable=<rule> — <reason>` (the reason is
+mandatory). The runtime complements live in `analysis.sanitizers`:
+`assert_no_recompiles` and `no_implicit_transfers` wrap steady-state
+decode in tests. See docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+from .core import (RULES, Checker, SourceFile, Violation, check_file,
+                   iter_py_files, register, run_paths)
+from .hot_paths import HOT_PATHS, is_hot
+
+# importing the check_* modules registers the rules
+from . import (check_donation, check_host_sync, check_hot_timing,  # noqa: F401,E402
+               check_knobs, check_locks, check_recompile)
+
+__all__ = ["RULES", "Checker", "SourceFile", "Violation", "check_file",
+           "iter_py_files", "register", "run_paths", "HOT_PATHS", "is_hot"]

@@ -1,0 +1,57 @@
+"""`python -m cake_tpu.analysis` — run the checkers, exit non-zero on any
+unsuppressed violation. `make lint` is this.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import RULES, run_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cake_tpu.analysis",
+        description="AST lint for the serving hot path (see "
+                    "docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to check (default: cake_tpu/ and "
+                         "scripts/)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--list", action="store_true", dest="list_rules",
+                    help="list registered rules and exit")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print suppressed violations with their "
+                         "reasons")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for name, checker in sorted(RULES.items()):
+            print(f"{name:<{width}}  {checker.doc}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+    try:
+        violations = run_paths(args.paths or None, rules)
+    except KeyError as e:
+        print(f"unknown rule {e.args[0]!r} (see --list)", file=sys.stderr)
+        return 2
+
+    fatal = [v for v in violations if not v.suppressed]
+    suppressed = [v for v in violations if v.suppressed]
+    if args.verbose:
+        for v in suppressed:
+            print(v.render())
+    for v in fatal:
+        print(v.render(), file=sys.stderr)
+    n_rules = len(rules) if rules else len(RULES)
+    print(f"[cake_tpu.analysis] {n_rules} rules, "
+          f"{len(fatal)} violations, {len(suppressed)} suppressed")
+    return 1 if fatal else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

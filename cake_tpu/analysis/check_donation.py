@@ -1,0 +1,173 @@
+"""Rule `use-after-donate`: reads of a buffer after jit donated it.
+
+`donate_argnums` lets XLA alias an input buffer as an output — the KV
+pool, the per-slot carries and every decode cache ride on it. But the
+Python name still points at the now-invalid buffer: reading it after the
+call returns garbage (TPU) or a RuntimeError (CPU, sometimes), and the
+failure is timing-dependent — exactly the bug class static analysis
+beats testing at.
+
+Per function, statements are scanned in evaluation order. A call that
+resolves to a donating target — a local jitted def (donate_argnums mapped
+through its signature), a `self.X` binding to one, or a known donating
+TextModel method (jitinfo.KNOWN_DONATING_METHODS: decode_slots,
+prefill_chunk, ...) — marks the argument names at donated positions dead.
+A later Load of a dead name fires; a Store (typically the same statement
+unpacking the call's results back into the name) revives it. Tracked
+names are bare locals and `self.*` attribute chains; anything fancier is
+out of scope for a lint.
+
+Limitations (by design, keep the rule quiet): no cross-iteration loop
+analysis, no aliasing (`y = x` then donate x, read y), no cross-function
+attribute tracking.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, SourceFile, Violation, register
+from .jitinfo import (KNOWN_DONATING_METHODS, collect_attr_bindings,
+                      collect_jit_fns, dotted_name, resolve_jit_callee)
+
+
+def _trackable(node) -> str | None:
+    """A donated-arg expression we can follow: bare name or self.* chain."""
+    name = dotted_name(node)
+    if name is None:
+        return None
+    if "." in name and not name.startswith("self."):
+        return None
+    return name
+
+
+class _FnAnalysis:
+    def __init__(self, sf, jits, bindings, rule):
+        self.sf = sf
+        self.jits = jits
+        self.bindings = bindings
+        self.rule = rule
+        self.dead: dict[str, int] = {}      # name -> donation line
+        self.out: list[Violation] = []
+
+    # -- evaluation-order walk --------------------------------------------
+
+    def run(self, fn: ast.FunctionDef):
+        self.stmts(fn.body)
+        return self.out
+
+    def stmts(self, body):
+        for stmt in body:
+            self.stmt(stmt)
+
+    def stmt(self, node):
+        if isinstance(node, ast.Assign):
+            self.expr(node.value)
+            for tgt in node.targets:
+                self.store(tgt)
+        elif isinstance(node, ast.AugAssign):
+            self.expr(node.value)
+            self.expr(node.target, loading=True)
+            self.store(node.target)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self.expr(node.value)
+            self.store(node.target)
+        elif isinstance(node, (ast.Expr, ast.Return)):
+            if getattr(node, "value", None) is not None:
+                self.expr(node.value)
+        elif isinstance(node, (ast.If, ast.While)):
+            self.expr(node.test)
+            self.stmts(node.body)
+            self.stmts(node.orelse)
+        elif isinstance(node, ast.For):
+            self.expr(node.iter)
+            self.store(node.target)
+            self.stmts(node.body)
+            self.stmts(node.orelse)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                self.expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.store(item.optional_vars)
+            self.stmts(node.body)
+        elif isinstance(node, ast.Try):
+            self.stmts(node.body)
+            for h in node.handlers:
+                self.stmts(h.body)
+            self.stmts(node.orelse)
+            self.stmts(node.finalbody)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass                        # nested scope: analyzed separately
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+
+    # -- expressions -------------------------------------------------------
+
+    def expr(self, node, loading=False):
+        """Flag loads of dead names, then apply any donation this
+        expression performs (sub-calls first — args evaluate before the
+        call donates)."""
+        for sub in ast.walk(node):
+            name = None
+            if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(sub, "ctx", None), ast.Load):
+                name = dotted_name(sub)
+            if name and name in self.dead:
+                # report the OUTERMOST chain only once per site
+                self.out.append(Violation(
+                    self.rule, self.sf.rel, sub.lineno,
+                    f"{name!r} read after being donated at line "
+                    f"{self.dead[name]} — donated buffers are dead; "
+                    "rebind the name from the call's results first"))
+                del self.dead[name]     # one report per donation
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self.donate(sub)
+
+    def donate(self, call: ast.Call):
+        idx = None
+        jf = resolve_jit_callee(call, self.jits, self.bindings)
+        if jf is not None:
+            idx = jf.donate_idx
+        else:
+            fname = dotted_name(call.func)
+            if fname is not None and "." in fname:
+                attr = fname.rsplit(".", 1)[1]
+                if attr in KNOWN_DONATING_METHODS:
+                    idx = set(KNOWN_DONATING_METHODS[attr])
+        if not idx:
+            return
+        if any(isinstance(a, ast.Starred) for a in call.args):
+            return                      # can't map positions
+        for i in idx:
+            if i < len(call.args):
+                name = _trackable(call.args[i])
+                if name is not None:
+                    self.dead[name] = call.lineno
+
+    def store(self, target):
+        for sub in ast.walk(target):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                name = dotted_name(sub)
+                if name:
+                    self.dead.pop(name, None)
+
+
+class DonationChecker(Checker):
+    name = "use-after-donate"
+    doc = ("reads of a variable after it was passed at a donate_argnums "
+           "position (donated buffers are dead after dispatch)")
+
+    def check(self, sf: SourceFile):
+        jits = collect_jit_fns(sf.tree)
+        bindings = collect_attr_bindings(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from _FnAnalysis(sf, jits, bindings,
+                                       self.name).run(node)
+
+
+register(DonationChecker)

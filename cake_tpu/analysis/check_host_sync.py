@@ -1,0 +1,209 @@
+"""Rule `host-sync`: device synchronization in serving hot paths.
+
+A continuous-batching iteration is budgeted for exactly ONE device->host
+transfer (the packed sampled-ids fetch); any extra `.item()`,
+`np.asarray(device_array)`, `jax.device_get`, `int()/float()/bool()` of a
+device value, or Python truthiness on a tracer stalls the dispatch
+pipeline for a full link round trip per call — the regression class that
+turned the reference's decode loop into a per-token sync storm.
+
+Heuristics (AST only, no type inference):
+
+  * `.item()` and `jax.device_get(...)` always fire;
+  * `np.asarray(x)` / `np.array(x)` fire when `x`'s root name is
+    DEVICE-TAINTED: assigned (in the same function) from a jitted callable
+    (local `_build()` closures via self-attr bindings), a known device
+    method (decode_slots, prefill, ...), a sampling op, or a `jnp.*` /
+    `jax.*` call. Host-data conversions (lists, np results) stay silent;
+  * `int()/float()/bool()` fire only on device-tainted roots — `int()` of
+    an already-fetched numpy array is host work;
+  * inside jit-traced functions, `if`/`while`/`assert` on a non-static
+    parameter is tracer truthiness (a ConcretizationError at best, a
+    silent per-trace sync at worst).
+
+Deliberate syncs — the one fetch per engine iteration, the TTFT-honest
+first-token sync — carry `# lint: disable=host-sync — <why>`.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, SourceFile, Violation, register
+from .hot_paths import is_hot
+from .jitinfo import (KNOWN_DONATING_METHODS, collect_attr_bindings,
+                      collect_jit_fns, dotted_name, resolve_jit_callee)
+
+# calls that produce device arrays regardless of module knowledge
+_DEVICE_FN_NAMES = {"sample", "sample_traced", "push_recent_token",
+                    "spec_accept", "embed_tokens", "forward_layers",
+                    "lm_head_logits"}
+# method attrs that return device arrays on any receiver (model, stage)
+_DEVICE_METHOD_ATTRS = set(KNOWN_DONATING_METHODS) | {
+    "sample_one", "new_cache", "fwd", "apply"}
+_HOST_ROOTS = {"np", "numpy", "os", "math", "sorted", "list", "tuple",
+               "len", "min", "max", "sum", "range", "str", "int", "float"}
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _root_name(node) -> str | None:
+    """x / x[i] / x.attr / x[i].attr ... -> "x"."""
+    while isinstance(node, (ast.Subscript, ast.Attribute, ast.Starred)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def own_nodes(scope):
+    """Every AST node belonging to this function/module scope, NOT
+    descending into nested function/class bodies (they get their own
+    scope and taint table)."""
+    stack = list(getattr(scope, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _SCOPES + (ast.ClassDef,)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _Taint:
+    """Which local names (very likely) hold device arrays."""
+
+    def __init__(self, jits, bindings):
+        self.jits = jits
+        self.bindings = bindings
+        self.tainted: set[str] = set()
+
+    def is_device_call(self, call: ast.Call) -> bool:
+        if resolve_jit_callee(call, self.jits, self.bindings) is not None:
+            return True
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return fn.id in _DEVICE_FN_NAMES
+        if isinstance(fn, ast.Attribute):
+            root = _root_name(fn.value)
+            if root in ("jnp", "jax"):
+                return fn.attr != "device_get"    # device_get fires itself
+            if root in _HOST_ROOTS:
+                return False
+            return (fn.attr in _DEVICE_METHOD_ATTRS
+                    or fn.attr in _DEVICE_FN_NAMES)
+        return False
+
+    def feed(self, node):
+        if not isinstance(node, ast.Assign):
+            return
+        if isinstance(node.value, ast.Call):
+            device = self.is_device_call(node.value)
+        elif isinstance(node.value, (ast.Name, ast.Subscript,
+                                     ast.Attribute)):
+            device = _root_name(node.value) in self.tainted
+        else:
+            return
+        for tgt in node.targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    if device:
+                        self.tainted.add(sub.id)
+                    else:
+                        self.tainted.discard(sub.id)
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    doc = ("device syncs (.item, np.asarray of device arrays, "
+           "int()/float()/bool() of device values, jax.device_get, tracer "
+           "truthiness) in the serving hot-path module set")
+
+    def applies(self, sf: SourceFile) -> bool:
+        return is_hot(sf.rel)
+
+    def check(self, sf: SourceFile):
+        jits = collect_jit_fns(sf.tree)
+        bindings = collect_attr_bindings(sf.tree)
+        jit_nodes = {id(j.node): j for j in jits.values()}
+
+        scopes = [sf.tree] + [n for n in ast.walk(sf.tree)
+                              if isinstance(n, _SCOPES[:2])]
+        for scope in scopes:
+            taint = _Taint(jits, bindings)
+            nodes = list(own_nodes(scope))
+            for node in nodes:          # taint pass first: assignments
+                taint.feed(node)        # anywhere in the scope count
+            for node in nodes:
+                if isinstance(node, ast.Call):
+                    for v in self._check_call(node, taint):
+                        v.rel = sf.rel
+                        yield v
+            jf = jit_nodes.get(id(scope))
+            if jf is not None:
+                for v in self._check_truthiness(scope, jf):
+                    v.rel = sf.rel
+                    yield v
+
+    def _check_call(self, call: ast.Call, taint: _Taint):
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" \
+                and not call.args:
+            yield self._v(call, ".item() syncs the device per call — fetch "
+                          "once with np.asarray and index on the host")
+            return
+        name = dotted_name(fn) or ""
+        if name == "jax.device_get":
+            yield self._v(call, "jax.device_get on a hot path — batch the "
+                          "fetch or route it through the packed-ids fetch")
+            return
+        if name in ("np.asarray", "numpy.asarray", "np.array",
+                    "numpy.array"):
+            if call.args:
+                root = _root_name(call.args[0])
+                if root is not None and root in taint.tainted:
+                    yield self._v(call, f"np.{fn.attr}({root}) fetches a "
+                                  "device array (blocking sync)")
+            return
+        if isinstance(fn, ast.Name) and fn.id in ("int", "float", "bool") \
+                and len(call.args) == 1:
+            root = _root_name(call.args[0])
+            if root is not None and root in taint.tainted:
+                yield self._v(call, f"{fn.id}({root}) forces a device sync "
+                              "— keep the value on device or batch the "
+                              "fetch")
+
+    def _check_truthiness(self, fn: ast.FunctionDef, jf):
+        traced = set(jf.params) - jf.static_names
+        for node in own_nodes(fn):
+            if isinstance(node, (ast.If, ast.While, ast.Assert)):
+                for name in self._bare_refs(node.test):
+                    if name in traced:
+                        yield self._v(node, "Python truthiness/branch on "
+                                      f"traced parameter {name!r} inside a "
+                                      "jitted function — use lax.cond/"
+                                      "where or make it static")
+
+    @staticmethod
+    def _bare_refs(test) -> set[str]:
+        """Names referenced by a branch test, minus host-static forms:
+        `.shape/.ndim/.dtype/.size` accesses and `is (not) None` checks."""
+        out: set[str] = set()
+
+        def walk(node):
+            if isinstance(node, ast.Attribute) and node.attr in (
+                    "shape", "ndim", "dtype", "size"):
+                return                          # static under tracing
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return                          # identity checks are host
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Load):
+                out.add(node.id)
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(test)
+        return out
+
+    def _v(self, node, msg) -> Violation:
+        return Violation(self.name, "", getattr(node, "lineno", 0), msg)
+
+
+register(HostSyncChecker)

@@ -1,0 +1,60 @@
+"""Rule `hot-timing`: ad-hoc wall-clock calls on serving hot paths.
+
+PR 1's standalone `scripts/check_hot_timing.py`, absorbed into the
+framework (same banned-call list, same hot-path scoping, now AST-based so
+comments and strings cannot false-positive). cake_tpu/obs is the single
+owner of wall-clock deltas on hot paths: stats use `obs.now()`, phase
+accounting uses `obs.PhaseTimer` / `RECORDER.span`. Before it existed,
+three ad-hoc timing idioms drifted apart; this rule keeps new ones from
+creeping back in.
+
+`time.sleep` stays legal — it is a scheduling primitive, not a
+measurement.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, SourceFile, Violation, register
+from .hot_paths import is_hot
+
+_BANNED_ATTRS = {"monotonic", "time", "perf_counter", "monotonic_ns",
+                 "perf_counter_ns", "time_ns"}
+
+
+class HotTimingChecker(Checker):
+    name = "hot-timing"
+    doc = ("ad-hoc time.monotonic()/time.time()/time.perf_counter() on "
+           "hot paths — route through cake_tpu.obs (now() / PhaseTimer / "
+           "RECORDER.span)")
+
+    def applies(self, sf: SourceFile) -> bool:
+        return is_hot(sf.rel) and not sf.rel.startswith("cake_tpu/obs/")
+
+    def check(self, sf: SourceFile):
+        # names imported straight off the time module also count
+        from_time: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                from_time.update(a.asname or a.name for a in node.names
+                                 if a.name in _BANNED_ATTRS)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            hit = None
+            if isinstance(fn, ast.Attribute) and fn.attr in _BANNED_ATTRS \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "time":
+                hit = f"time.{fn.attr}"
+            elif isinstance(fn, ast.Name) and fn.id in from_time:
+                hit = fn.id
+            if hit:
+                yield Violation(
+                    self.name, sf.rel, node.lineno,
+                    f"{hit}() on a hot path — use cake_tpu.obs.now() / "
+                    "PhaseTimer / RECORDER.span so timings land in the "
+                    "metrics rail")
+
+
+register(HotTimingChecker)

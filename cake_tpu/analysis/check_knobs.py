@@ -1,0 +1,69 @@
+"""Rule `knob-registry`: every `CAKE_*` env var is read through
+cake_tpu.knobs, never raw `os.environ`.
+
+Before the registry, 27 scattered reads in 18 files each carried their
+own default and parsing quirks, and the doc tables drifted from the code.
+A raw read bypasses the typed default, the generated docs/knobs.md AND
+the empty-string fallback — so it fires here. Writes (monkeypatching in
+tests, `setdefault` in launch scripts) are fine: the registry governs how
+knobs are READ, not how environments are built.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, SourceFile, Violation, register
+
+_EXEMPT = ("cake_tpu/knobs.py",)
+
+
+def _is_environ(node) -> bool:
+    """`os.environ` / bare `environ`."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _cake_const(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("CAKE_"):
+        return node.value
+    return None
+
+
+class KnobRegistryChecker(Checker):
+    name = "knob-registry"
+    doc = ("raw os.environ/os.getenv reads of CAKE_* names — go through "
+           "cake_tpu.knobs.get (typed default + generated docs)")
+
+    def applies(self, sf: SourceFile) -> bool:
+        return sf.rel not in _EXEMPT
+
+    def check(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            knob = None
+            if isinstance(node, ast.Call):
+                fn = node.func
+                # os.environ.get("CAKE_X") / environ.get
+                if isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                        and _is_environ(fn.value) and node.args:
+                    knob = _cake_const(node.args[0])
+                # os.getenv("CAKE_X") / getenv
+                elif ((isinstance(fn, ast.Attribute)
+                       and fn.attr == "getenv")
+                      or (isinstance(fn, ast.Name)
+                          and fn.id == "getenv")) and node.args:
+                    knob = _cake_const(node.args[0])
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _is_environ(node.value):
+                knob = _cake_const(node.slice)
+            if knob:
+                yield Violation(
+                    self.name, sf.rel, node.lineno,
+                    f"raw env read of {knob} — use "
+                    f'cake_tpu.knobs.get("{knob}") (and register the knob '
+                    "if it is new)")
+
+
+register(KnobRegistryChecker)

@@ -1,0 +1,136 @@
+"""Rule `lock-discipline`: `# guarded-by:` annotated fields only touched
+under their lock.
+
+The serve engine and the cluster master share mutable state between the
+scheduler/request thread and background threads (SSE subscriber bridges,
+the degraded-worker restore loop). The convention: the `__init__`
+assignment that creates a cross-thread field carries
+
+    self._token_cb = None        # guarded-by: self._sub_lock
+
+and every OTHER method access of that field must sit lexically inside
+`with self._sub_lock:`. The checker is what makes the comment load-
+bearing — an unguarded access is a build failure, not a data race found
+in production.
+
+Scope notes: annotations bind per class; `__init__` itself is exempt
+(nothing is shared before construction completes); the guard must be the
+annotated lock (a different lock does not count).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Checker, SourceFile, Violation, register
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+
+
+def _attr_self(node) -> str | None:
+    """`self.X` -> "X" (single level only)."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+    doc = ("fields annotated `# guarded-by: <lock>` accessed outside "
+           "`with <lock>:` in methods of their class")
+
+    def check(self, sf: SourceFile):
+        for cls in ast.walk(sf.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(sf, cls)
+
+    def _guarded_fields(self, sf, cls) -> dict[str, str]:
+        """field -> lock expr string, from annotated assignments anywhere
+        in the class body (same line or the standalone comment above)."""
+        out: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            fields = [f for f in map(_attr_self, targets) if f]
+            if not fields:
+                continue
+            for line in (node.lineno, node.lineno - 1):
+                if 1 <= line <= len(sf.lines):
+                    m = _GUARD_RE.search(sf.lines[line - 1])
+                    if m and (line == node.lineno
+                              or sf.lines[line - 1].strip().startswith("#")):
+                        for f in fields:
+                            out[f] = m.group(1)
+                        break
+        return out
+
+    def _check_class(self, sf, cls: ast.ClassDef):
+        guarded = self._guarded_fields(sf, cls)
+        if not guarded:
+            return
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name in ("__init__", "__del__"):
+                continue
+            yield from self._walk(sf, meth.body, guarded, frozenset())
+
+    def _walk(self, sf, body, guarded, held):
+        for node in body:
+            held_here = held
+            if isinstance(node, ast.With):
+                locks = {_unparse(item.context_expr)
+                         for item in node.items}
+                held_here = held | frozenset(locks)
+                yield from self._scan_exprs(
+                    sf, [i.context_expr for i in node.items], guarded, held)
+                yield from self._walk(sf, node.body, guarded, held_here)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested callback defs: the lock is NOT held when they run
+                yield from self._walk(sf, node.body, guarded, frozenset())
+                continue
+            children = []
+            for name in ("body", "orelse", "finalbody"):
+                children.extend(getattr(node, name, []))
+            for h in getattr(node, "handlers", []):
+                children.extend(h.body)
+            if children:
+                tests = [getattr(node, a) for a in ("test", "iter")
+                         if getattr(node, a, None) is not None]
+                yield from self._scan_exprs(sf, tests, guarded, held)
+                yield from self._walk(sf, children, guarded, held_here)
+            else:
+                yield from self._scan_exprs(sf, [node], guarded, held)
+
+    def _scan_exprs(self, sf, nodes, guarded, held):
+        for top in nodes:
+            for node in ast.walk(top):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                field = _attr_self(node)
+                if field is None or field not in guarded:
+                    continue
+                lock = guarded[field]
+                if lock not in held:
+                    yield Violation(
+                        self.name, sf.rel, node.lineno,
+                        f"self.{field} accessed without holding {lock} "
+                        f"(declared `# guarded-by: {lock}`)")
+
+
+register(LockDisciplineChecker)

@@ -1,0 +1,116 @@
+"""Rule `recompile-hazard`: unstable jit cache keys.
+
+Steady-state decode must run existing executables — a recompile is a
+multi-second stall that shows up as a wedged `/health` and a latency
+cliff for every active slot. Two AST-detectable hazard classes:
+
+  1. Unstable static arguments at call sites of jitted functions: an
+     f-string, dict/list/set literal, float literal/expression, or a
+     wall-clock/random call passed at a `static_argnames`/`static_argnums`
+     position keys the executable cache on a value that varies per call
+     (or is unhashable). Static args must be drawn from a small closed
+     set — ints, enums, quantized buckets.
+
+  2. Shape-dependent Python branching inside a traced function: an
+     `if`/`while` on `.shape`/`.ndim` of a NON-static parameter re-traces
+     per shape class. Where that is deliberate bucketing (the branch is
+     resolved by a static bucket count), say so with
+     `# lint: disable=recompile-hazard — <why>`.
+
+The runtime recompile sanitizer (analysis.sanitizers.assert_no_recompiles)
+is the dynamic complement: it pins "N steady-state iterations, zero new
+executables" in tests.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, SourceFile, Violation, register
+from .jitinfo import (collect_attr_bindings, collect_jit_fns, dotted_name,
+                      resolve_jit_callee)
+
+_UNSTABLE_CALLS = {"now", "time.time", "time.monotonic",
+                   "time.perf_counter", "uuid.uuid4", "id", "hash",
+                   "random.random", "random.randint"}
+
+
+def _unstable_reason(expr) -> str | None:
+    """Why this expression is a bad static-arg cache key, or None."""
+    if isinstance(expr, ast.JoinedStr):
+        return "f-string (new str per call)"
+    if isinstance(expr, (ast.Dict, ast.Set, ast.DictComp, ast.SetComp,
+                         ast.ListComp, ast.GeneratorExp)):
+        return "dict/set/comprehension literal (unhashable or per-call)"
+    if isinstance(expr, ast.List):
+        return "list literal (unhashable)"
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, float):
+        return "float literal (cache keyed per exact float)"
+    if isinstance(expr, ast.Call):
+        name = dotted_name(expr.func) or ""
+        if name in _UNSTABLE_CALLS:
+            return f"{name}() varies per call"
+    if isinstance(expr, ast.BinOp):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value,
+                                                            float):
+                return "float arithmetic (cache keyed per exact float)"
+            if isinstance(sub, ast.Div):
+                return "float division (cache keyed per exact float)"
+    return None
+
+
+class RecompileChecker(Checker):
+    name = "recompile-hazard"
+    doc = ("unstable jit cache keys: per-call-varying static args and "
+           "shape-dependent Python branching inside traced functions")
+
+    def check(self, sf: SourceFile):
+        jits = collect_jit_fns(sf.tree)
+        bindings = collect_attr_bindings(sf.tree)
+        jit_nodes = {id(j.node): j for j in jits.values()}
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                jf = resolve_jit_callee(node, jits, bindings)
+                if jf is not None and jf.static_names:
+                    yield from self._check_static_args(sf, node, jf)
+            elif isinstance(node, ast.FunctionDef) \
+                    and id(node) in jit_nodes:
+                yield from self._check_shape_branches(sf, node,
+                                                      jit_nodes[id(node)])
+
+    def _check_static_args(self, sf, call: ast.Call, jf):
+        for i, arg in enumerate(call.args):
+            if i < len(jf.params) and jf.params[i] in jf.static_names:
+                why = _unstable_reason(arg)
+                if why:
+                    yield Violation(self.name, sf.rel, arg.lineno,
+                                    f"static arg {jf.params[i]!r} of jitted "
+                                    f"{jf.name!r} is {why}")
+        for kw in call.keywords:
+            if kw.arg in jf.static_names:
+                why = _unstable_reason(kw.value)
+                if why:
+                    yield Violation(self.name, sf.rel, kw.value.lineno,
+                                    f"static arg {kw.arg!r} of jitted "
+                                    f"{jf.name!r} is {why}")
+
+    def _check_shape_branches(self, sf, fn: ast.FunctionDef, jf):
+        from .check_host_sync import own_nodes
+        traced = set(jf.params) - jf.static_names
+        for node in own_nodes(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr in ("shape", "ndim"):
+                    base = sub.value
+                    if isinstance(base, ast.Name) and base.id in traced:
+                        yield Violation(
+                            self.name, sf.rel, node.lineno,
+                            f"Python branch on {base.id}.{sub.attr} inside "
+                            f"jitted {jf.name!r} re-traces per shape — "
+                            "bucket the shape statically or mask instead")
+
+
+register(RecompileChecker)

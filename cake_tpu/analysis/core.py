@@ -1,0 +1,189 @@
+"""Checker framework: rule registry, per-line suppressions, file walking.
+
+PR 1's `check_hot_timing.py` proved that a 50-line grep can keep an
+invariant alive across refactors; this module generalizes it into an
+AST-based pass so the serving plane's four load-bearing invariants
+(sync-free hot loops, recompile-free steady state, no use-after-donate,
+lock-guarded shared state) are enforced by tooling rather than review.
+
+Rules are classes registered with :func:`register`; each sees a parsed
+:class:`SourceFile` and yields :class:`Violation`s. A violation is fatal
+unless the offending line carries a suppression WITH a written reason:
+
+    x = np.asarray(packed)  # lint: disable=host-sync — the one per-iter fetch
+
+    # lint: disable=host-sync — standalone comments suppress the next line
+    x = np.asarray(packed)
+
+A suppression without a reason is itself a violation (`suppression-format`)
+— the reason string is the code-review record of why the rule does not
+apply, and an unexplained disable is exactly the drift this pass exists to
+stop. Run `python -m cake_tpu.analysis` (or `make lint`).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+# rule name -> checker instance; populated by the check_* modules at
+# package import (see __init__.py)
+RULES: dict[str, "Checker"] = {}
+
+
+def register(cls):
+    inst = cls()
+    if inst.name in RULES:
+        raise ValueError(f"duplicate rule {inst.name!r}")
+    RULES[inst.name] = inst
+    return cls
+
+
+@dataclass
+class Violation:
+    rule: str
+    rel: str                    # repo-relative posix path
+    line: int
+    msg: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def render(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.rel}:{self.line}: {self.rule}: {self.msg}{tag}"
+
+
+class Checker:
+    """One rule. Subclasses set `name`/`doc` and implement `check`."""
+
+    name = ""
+    doc = ""
+
+    def applies(self, sf: "SourceFile") -> bool:
+        return True
+
+    def check(self, sf: "SourceFile"):
+        raise NotImplementedError
+
+
+# `—`, `--` or `:` separates the rule list from the mandatory reason
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable\s*=\s*([A-Za-z0-9_,\s-]+?)\s*(?:—|--|:)\s*(\S.*)$")
+# require at least one valid rule character after `=` so prose ABOUT the
+# syntax (`# lint: disable=<rule> — <reason>` in docstrings) stays inert
+_SUPPRESS_ANY_RE = re.compile(r"#\s*lint:\s*disable\s*=\s*[A-Za-z0-9_-]")
+
+
+class SourceFile:
+    """A parsed file plus its suppression table. `rel` is the repo-relative
+    posix path — rules scope themselves by it (tests hand in virtual
+    paths to place fixture snippets on the hot-path set)."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)
+        # line -> {rule: reason}; rule "all" blankets every rule
+        self.suppressions: dict[int, dict[str, str]] = {}
+        self.format_errors: list[Violation] = []
+        self._parse_suppressions()
+
+    def _parse_suppressions(self):
+        for i, line in enumerate(self.lines, 1):
+            if "lint:" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                if _SUPPRESS_ANY_RE.search(line):
+                    self.format_errors.append(Violation(
+                        "suppression-format", self.rel, i,
+                        "suppression needs a reason: "
+                        "`# lint: disable=<rule> — <why this is ok>`"))
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            # a standalone comment line suppresses the next line of code;
+            # a trailing comment suppresses its own line
+            target = i
+            if line.strip().startswith("#"):
+                target = i + 1
+                while target <= len(self.lines) and (
+                        not self.lines[target - 1].strip()
+                        or self.lines[target - 1].strip().startswith("#")):
+                    target += 1
+            tab = self.suppressions.setdefault(target, {})
+            for r in rules:
+                tab[r] = reason
+
+    def suppression_for(self, rule: str, line: int) -> str | None:
+        tab = self.suppressions.get(line)
+        if not tab:
+            return None
+        if rule in tab:
+            return tab[rule]
+        return tab.get("all")
+
+
+def check_file(sf: SourceFile, rules: list[str] | None = None
+               ) -> list[Violation]:
+    """All violations in one file, suppressed ones flagged (never
+    dropped — the runner prints them in verbose mode and tests assert
+    the roundtrip)."""
+    out = list(sf.format_errors)
+    selected = RULES if rules is None else {
+        r: RULES[r] for r in rules}     # KeyError on unknown rule is right
+    for checker in selected.values():
+        if not checker.applies(sf):
+            continue
+        for v in checker.check(sf):
+            reason = sf.suppression_for(v.rule, v.line)
+            if reason is not None:
+                v.suppressed = True
+                v.reason = reason
+            out.append(v)
+    out.sort(key=lambda v: (v.line, v.rule))
+    return out
+
+
+def repo_root() -> str:
+    """The directory holding the cake_tpu package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_py_files(paths: list[str] | None = None):
+    """Yield (rel, abspath) for every .py under the given paths (default:
+    the cake_tpu package + scripts/), rel computed against the repo root."""
+    root = repo_root()
+    if not paths:
+        paths = [os.path.join(root, "cake_tpu"),
+                 os.path.join(root, "scripts")]
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            yield os.path.relpath(p, root).replace(os.sep, "/"), p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    ap = os.path.join(dirpath, fn)
+                    yield (os.path.relpath(ap, root).replace(os.sep, "/"),
+                           ap)
+
+
+def run_paths(paths: list[str] | None = None,
+              rules: list[str] | None = None) -> list[Violation]:
+    out = []
+    for rel, ap in iter_py_files(paths):
+        with open(ap, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            sf = SourceFile(rel, text)
+        except SyntaxError as e:
+            out.append(Violation("parse-error", rel, e.lineno or 0, str(e)))
+            continue
+        out.extend(check_file(sf, rules))
+    return out

@@ -1,0 +1,34 @@
+"""The serving hot-path module set: every file whose code runs per token,
+per scheduler iteration, or per wire frame. The host-sync and hot-timing
+rules scope themselves to this set — cli/tui/image pipelines and
+discovery are allowed plain host syncs and wall clocks (they are not hot).
+
+Grown from PR 1's check_hot_timing list by the serve/spec subsystems that
+landed since.
+"""
+from __future__ import annotations
+
+HOT_PATHS = frozenset({
+    # per-token model programs + their wrappers
+    "cake_tpu/models/common/text_model.py",
+    "cake_tpu/models/common/offload_model.py",
+    # continuous-batching scheduler: one iteration per pool-wide token
+    "cake_tpu/serve/engine.py",
+    "cake_tpu/serve/admission.py",
+    "cake_tpu/serve/slots.py",
+    "cake_tpu/serve/prefix_cache.py",
+    # speculative decode: per verify step
+    "cake_tpu/spec/drafter.py",
+    "cake_tpu/spec/verify.py",
+    # cluster data plane: per hop
+    "cake_tpu/cluster/master.py",
+    "cake_tpu/cluster/worker.py",
+    "cake_tpu/cluster/client.py",
+    "cake_tpu/cluster/proto.py",
+    # request routing
+    "cake_tpu/api/state.py",
+})
+
+
+def is_hot(rel: str) -> bool:
+    return rel in HOT_PATHS

@@ -1,0 +1,104 @@
+"""Runtime sanitizers: the dynamic half of the static pass.
+
+The AST rules prove the CODE cannot recompile or sync by accident; these
+context managers prove the RUNTIME didn't. Wired into tests (and usable
+around any suspect region in a bench or smoke script):
+
+  * :func:`assert_no_recompiles` — snapshots the executable-cache size of
+    every given jitted callable on entry and asserts nothing new was
+    compiled on exit. Steady-state `decode_slots` must pass N iterations
+    under it: one new executable means an unstable cache key slipped past
+    the `recompile-hazard` rule.
+
+  * :func:`no_implicit_transfers` — `jax.transfer_guard`-based: any
+    implicit device<->host transfer inside the region raises. The batched
+    decode step runs under it in tests: its contract is that ALL per-slot
+    carries stay device-resident and an iteration ships nothing — the one
+    planned fetch (`np.asarray(packed)`) happens OUTSIDE the guarded
+    region, which is exactly the discipline the guard verifies.
+
+Both are no-overhead outside tests: nothing here is installed globally.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["cache_size", "assert_no_recompiles", "no_implicit_transfers",
+           "decode_fns", "RecompileError"]
+
+
+class RecompileError(AssertionError):
+    """A guarded region compiled a new executable."""
+
+
+def cache_size(fn) -> int:
+    """Number of compiled executables a jitted callable holds (jax 0.4.x
+    PjitFunction._cache_size; a jax upgrade that drops it should fail
+    HERE, loudly, not silently stop guarding)."""
+    if hasattr(fn, "_cache_size"):
+        return fn._cache_size()
+    raise RuntimeError(
+        f"{fn!r} exposes no executable-cache size — wrap the jitted "
+        "callable itself, or teach sanitizers.cache_size the new jax API")
+
+
+def decode_fns(model) -> dict[str, object]:
+    """The jitted callables that must stay compile-stable across
+    steady-state serve iterations for `model` (a TextModel or anything
+    publishing the same _build() attributes)."""
+    out = {}
+    for name in ("_decode_slots", "_decode_step", "_decode_chunk",
+                 "_decode_until", "_prefill_slot", "_spec_slot",
+                 "_sample_traced"):
+        fn = getattr(model, name, None)
+        if fn is not None and hasattr(fn, "_cache_size"):
+            out[name] = fn
+    return out
+
+
+@contextmanager
+def assert_no_recompiles(*fns, label: str = ""):
+    """Assert that none of the given jitted callables compile a new
+    executable inside the with-block.
+
+    Accepts jitted callables and/or model objects (expanded through
+    :func:`decode_fns`). Raises :class:`RecompileError` naming the
+    callable(s) that grew their cache and by how much.
+    """
+    tracked: dict[str, object] = {}
+    for fn in fns:
+        if hasattr(fn, "_cache_size"):
+            tracked[getattr(fn, "__name__", repr(fn))] = fn
+        else:
+            sub = decode_fns(fn)
+            if not sub:
+                raise RuntimeError(
+                    f"{fn!r} is neither a jitted callable nor a model "
+                    "with jitted decode programs")
+            tracked.update(sub)
+    before = {name: cache_size(fn) for name, fn in tracked.items()}
+    yield
+    grew = {name: cache_size(tracked[name]) - n0
+            for name, n0 in before.items()
+            if cache_size(tracked[name]) != n0}
+    if grew:
+        what = ", ".join(f"{k} (+{v})" for k, v in sorted(grew.items()))
+        raise RecompileError(
+            f"steady-state region{f' {label!r}' if label else ''} "
+            f"compiled new executables: {what} — an unstable jit cache "
+            "key (see docs/static_analysis.md, rule recompile-hazard)")
+
+
+@contextmanager
+def no_implicit_transfers(level: str = "disallow"):
+    """Fail on implicit device<->host transfers inside the region.
+
+    `level` is any jax.transfer_guard level; "disallow" (the default)
+    permits explicit transfers (jax.device_put / jax.device_get) but
+    raises on implicit ones — a numpy array silently shipped
+    host->device per call, or a device array concretized host-side.
+    The planned fetch of a decode iteration belongs OUTSIDE the region.
+    """
+    import jax
+    with jax.transfer_guard(level):
+        yield

@@ -142,7 +142,12 @@ async def health(request: web.Request) -> web.Response:
     # retry budget exhausted — requests fail fast (ClusterDegradedError),
     # so the balancer should route elsewhere until the restore loop
     # revives the worker. This one IS a 503.
-    dead = getattr(state.model, "degraded", None)
+    # locked accessor where the model provides one (DistributedTextModel:
+    # the flag is guarded-by _degraded_lock and the lint only polices the
+    # declaring class, so out-of-class readers must use the accessor)
+    getter = getattr(state.model, "degraded_info", None)
+    dead = getter() if getter is not None \
+        else getattr(state.model, "degraded", None)
     if dead:
         degraded = True
         body["cluster"] = {

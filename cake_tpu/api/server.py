@@ -20,11 +20,11 @@ from __future__ import annotations
 import asyncio
 import base64
 import logging
-import os
 import time
 
 from aiohttp import web
 
+from .. import knobs
 from ..obs import API_REQUESTS, API_REQUEST_SECONDS, now
 from . import audio as audio_routes
 from . import images as image_routes
@@ -115,7 +115,7 @@ async def graceful_drain(app: web.Application):
     engine = getattr(state, "engine", None)
     if engine is None:
         return
-    timeout = float(os.environ.get("CAKE_DRAIN_TIMEOUT_S", "30"))
+    timeout = knobs.get("CAKE_DRAIN_TIMEOUT_S")
     log.info("draining serve engine (up to %.0fs): %d busy, %d queued",
              timeout, engine.pool.busy_count, engine.queue.depth())
     # drain() busy-waits — keep the event loop free to stream the final

@@ -18,6 +18,8 @@ import os
 import sys
 import time
 
+from . import knobs
+
 
 def _add_common_model_args(p: argparse.ArgumentParser):
     p.add_argument("model", help="model dir or HF repo id")
@@ -26,7 +28,7 @@ def _add_common_model_args(p: argparse.ArgumentParser):
                    help="force architecture (e.g. qwen3, llama3)")
     p.add_argument("--max-cache-len", type=int, default=2048)
     p.add_argument("--seed", type=int, default=42)
-    p.add_argument("--cluster-key", default=os.environ.get("CAKE_CLUSTER_KEY"),
+    p.add_argument("--cluster-key", default=knobs.get("CAKE_CLUSTER_KEY"),
                    help="enable distributed mode (env: CAKE_CLUSTER_KEY)")
     p.add_argument("--topology", default=None, help="topology YAML path")
     p.add_argument("--no-download", action="store_true")
@@ -377,7 +379,7 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("worker", help="run as a cluster worker")
     p.add_argument("--name", default=os.uname().nodename)
-    p.add_argument("--cluster-key", default=os.environ.get("CAKE_CLUSTER_KEY"))
+    p.add_argument("--cluster-key", default=knobs.get("CAKE_CLUSTER_KEY"))
     p.add_argument("--port", type=int, default=10128)
     p.add_argument("--model-dir", default=None,
                    help="pre-provisioned weights (from `cake-tpu split`)")

@@ -10,12 +10,12 @@ pipeline is a chain), so async buys nothing on this path.
 from __future__ import annotations
 
 import logging
-import os
 import socket
 import time
 
 import numpy as np
 
+from .. import knobs
 from ..obs import CLUSTER_HOP_DEGRADED, CLUSTER_STAGE_FAILURES, HOP_SECONDS, now
 from . import faults, proto
 from .auth import AuthError, _mac, CHALLENGE_LEN, MAC_LEN
@@ -64,20 +64,18 @@ class RemoteStage:
         # within this, or the op is classified `timeout` and recovery
         # takes over (CAKE_HOP_TIMEOUT_S; generous default — LAN/TPU
         # tunnels sit at 66-90ms RTT, so even seconds is "stalled")
-        self.timeout = timeout if timeout is not None else float(
-            os.environ.get("CAKE_HOP_TIMEOUT_S", "120"))
+        self.timeout = timeout if timeout is not None \
+            else knobs.get("CAKE_HOP_TIMEOUT_S")
         # gray-failure threshold: rolling RTT p95 above this flags the hop
         # degraded in /health WITHOUT failing anything (0 = disabled)
-        self.degraded_ms = float(os.environ.get("CAKE_HOP_DEGRADED_MS",
-                                                "0") or 0)
+        self.degraded_ms = knobs.get("CAKE_HOP_DEGRADED_MS")
         # the FIRST forward after a reestablish() may include an in-band
         # XLA compile on the freshly re-assigned worker (warm="decode"/
         # "none", or a shape outside the warm sweep) — it gets this grace
         # deadline instead of the per-op one, or a tight CAKE_HOP_TIMEOUT_S
         # would kill every replay and burn the retry budget on a healthy
         # worker
-        self.revive_grace_s = float(os.environ.get("CAKE_REVIVE_GRACE_S",
-                                                   "60"))
+        self.revive_grace_s = knobs.get("CAKE_REVIVE_GRACE_S")
         self._revive_grace = False
         self.sock: socket.socket | None = None
         self.info: dict = {}

@@ -40,11 +40,11 @@ from __future__ import annotations
 
 import fnmatch
 import logging
-import os
 import time
 import weakref
 from dataclasses import dataclass, field
 
+from .. import knobs
 from . import proto
 
 log = logging.getLogger("cake_tpu.faults")
@@ -233,6 +233,6 @@ def clear() -> None:
 # env-driven activation: `CAKE_FAULT_PLAN="w0:drop_after_ops=5"` takes
 # effect the moment the cluster plane loads (client.py and worker.py both
 # import this module to tag their channels)
-_env_plan = os.environ.get("CAKE_FAULT_PLAN")
+_env_plan = knobs.get_str("CAKE_FAULT_PLAN")
 if _env_plan:
     install(_env_plan)

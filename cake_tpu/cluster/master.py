@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..models.common.cache import init_cache
 from ..models.common.config import ModelConfig
 from ..models.common.text_model import (PREFILL_BUCKETS, PREFILL_CHUNK,
@@ -89,19 +90,29 @@ class DistributedTextModel:
         # background restore loop's probe interval once degraded
         # (CAKE_RESTORE_INTERVAL_S)
         self.recovery_retries = recovery_retries if recovery_retries \
-            is not None else int(os.environ.get("CAKE_RECOVERY_RETRIES", "3"))
+            is not None else knobs.get("CAKE_RECOVERY_RETRIES")
         self.recovery_backoff_s = recovery_backoff_s if recovery_backoff_s \
-            is not None else float(os.environ.get("CAKE_RECOVERY_BACKOFF_S",
-                                                  "0.5"))
+            is not None else knobs.get("CAKE_RECOVERY_BACKOFF_S")
         self.restore_interval_s = restore_interval_s if restore_interval_s \
-            is not None else float(os.environ.get("CAKE_RESTORE_INTERVAL_S",
-                                                  "5"))
+            is not None else knobs.get("CAKE_RESTORE_INTERVAL_S")
+        # serializes channel revival: _recover and the restore loop must
+        # not reestablish() the same worker concurrently. NEVER guards
+        # the flags below — reestablish() spans reconnect + weight
+        # re-push + wait_ready (minutes), and a flag read blocking on it
+        # would break generate()'s fail-fast contract
+        self._revive_lock = threading.Lock()
+        # guards the degraded flag + restore-thread handle (request
+        # threads flip the flag, the restore loop clears it; the
+        # lock-discipline lint enforces the guarded-by annotations).
+        # Held only for flag reads/writes — always cheap, never across
+        # network or device work
+        self._degraded_lock = threading.Lock()
         # {worker, since, error} while a worker is quarantined with the
         # retry budget exhausted; /health 503s on it and generate() fails
-        # fast until the restore loop revives the worker
-        self.degraded: dict | None = None
-        self._restore_thread: threading.Thread | None = None
-        self._revive_lock = threading.Lock()
+        # fast until the restore loop revives the worker. Out-of-class
+        # readers go through degraded_info()
+        self.degraded: dict | None = None           # guarded-by: self._degraded_lock
+        self._restore_thread: threading.Thread | None = None  # guarded-by: self._degraded_lock
         self._recoveries = 0            # per-generation, surfaced in stats
         self._replays = 0
         self._gen_prompt: list[int] = []   # recorded token sequence the
@@ -198,6 +209,8 @@ class DistributedTextModel:
         # kv hint keeps the worker's per-connection cache bucket aligned
         # with the master's, so growth reallocs land on the same
         # (pre-warmed) bucket boundaries on every node
+        # lint: disable=host-sync — remote hop: the hidden state must become
+        # host bytes to cross the wire (this IS the pipeline's transfer point)
         x, _ = s.runner.forward_hidden(np.asarray(x), None, pos0, valid_len,
                                        kv_hint=self._kv_len)
         return x
@@ -311,8 +324,8 @@ class DistributedTextModel:
         # spent, and the background restore loop owns the dead worker —
         # burning every request's latency on doomed reconnects would turn
         # one dead node into a full outage
-        if self.degraded is not None:
-            d = self.degraded
+        d = self.degraded_info()
+        if d is not None:
             raise ClusterDegradedError(
                 f"cluster degraded: worker {d['worker']} down for "
                 f"{now() - d['since']:.0f}s ({d['error']}); "
@@ -353,6 +366,8 @@ class DistributedTextModel:
         ttft = now() - t0
 
         pos = len(prompt_ids)
+        # lint: disable=host-sync — first-token fetch keeps TTFT honest (same
+        # contract as TextModel.generate)
         tid = int(tok)
         out.append(tid)
         if on_token:
@@ -376,6 +391,9 @@ class DistributedTextModel:
                     rng, sk = jax.random.split(rng)
                     tok = self._sample(logits[0], sk, recent, scfg)
                     recent = push_recent_token(recent, tok)
+                    # lint: disable=host-sync — the distributed loop is host-driven by
+                    # design: the sampled id must reach the host to feed the next hop's
+                    # wire frame (one small fetch per token, measured in BENCH_CLUSTER)
                     tid = int(tok)
             pos += 1
             out.append(tid)
@@ -459,17 +477,29 @@ class DistributedTextModel:
         CLUSTER_REPLAYS.inc()
         return logits
 
+    def degraded_info(self) -> dict | None:
+        """Locked read of the degraded flag for out-of-class readers
+        (/health, generate()'s fail-fast check) — the lock is only ever
+        held for flag flips, so this never blocks on recovery work."""
+        with self._degraded_lock:
+            return self.degraded
+
     def _mark_degraded(self, worker: str, error: Exception):
-        self.degraded = {"worker": worker, "since": now(),
-                         "error": str(error)}
+        with self._degraded_lock:
+            self.degraded = {"worker": worker, "since": now(),
+                             "error": str(error)}
+            if self._restore_thread is None \
+                    or not self._restore_thread.is_alive():
+                # started under the lock: the loop's first read blocks
+                # until this block publishes the flag, never deadlocks
+                self._restore_thread = threading.Thread(
+                    target=self._restore_loop, daemon=True,
+                    name="cake-restore")
+                self._restore_thread.start()
         CLUSTER_DEGRADED.set(1.0)
         log.error("cluster degraded: worker %s unrecoverable (%s); "
                   "restore loop probing every %.1fs", worker, error,
                   self.restore_interval_s)
-        if self._restore_thread is None or not self._restore_thread.is_alive():
-            self._restore_thread = threading.Thread(
-                target=self._restore_loop, daemon=True, name="cake-restore")
-            self._restore_thread.start()
 
     def _restore_loop(self):
         """Background probe of the quarantined worker: on success the
@@ -477,23 +507,27 @@ class DistributedTextModel:
         reset/prefill rebuilds all state — no replay needed between
         requests)."""
         while True:
-            info = self.degraded
+            with self._degraded_lock:
+                info = self.degraded
             if info is None:
                 return
             time.sleep(self.restore_interval_s)
-            info = self.degraded
+            with self._degraded_lock:
+                info = self.degraded
             if info is None:
                 return
             stage = self._remote_stage(info["worker"])
             if stage is None:
-                self.degraded = None
+                with self._degraded_lock:
+                    self.degraded = None
                 CLUSTER_DEGRADED.set(0.0)
                 return
             try:
                 with self._revive_lock:
                     stage.runner.reestablish()
                 CLUSTER_RECONNECTS.inc(worker=info["worker"])
-                self.degraded = None
+                with self._degraded_lock:
+                    self.degraded = None
                 CLUSTER_DEGRADED.set(0.0)
                 log.info("worker %s restored; cluster healthy again",
                          info["worker"])

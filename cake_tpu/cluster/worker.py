@@ -456,6 +456,8 @@ class WorkerServer:
                 t_fwd = now()
                 yy, cc = st.stage.forward_hidden(x, cache, pos0, vl,
                                                  flash_mode=flash_mode)
+                # lint: disable=host-sync — the stage result is serialized to the wire
+                # next; fetching here also keeps fwd_ms honest (dispatch is async)
                 yy = np.asarray(yy)
                 return yy, cc, t_fwd, (now() - t_fwd) * 1e3
 

@@ -1,0 +1,194 @@
+"""Central registry of every `CAKE_*` environment knob.
+
+Before this module existed, 27 raw `os.environ` reads in 18 files each
+carried their own default and their own parsing quirks, and the knob
+tables in docs/ drifted from the code (the serving docs said one default,
+the engine shipped another). Now:
+
+  * every knob is declared ONCE here with a type, a default and a
+    one-line doc;
+  * call sites read through :func:`get` (env is still consulted on every
+    call, so tests that monkeypatch `os.environ` keep working — nothing
+    is snapshotted at import);
+  * `docs/knobs.md` is GENERATED from this registry (`make knobs-doc`,
+    `python -m cake_tpu.knobs`), and tests/test_analysis.py pins the file
+    to the registry so it cannot drift again;
+  * the `knob-registry` lint rule (cake_tpu/analysis) fails the build on
+    any raw `os.environ`/`os.getenv` read of a `CAKE_*` name outside this
+    module.
+
+Empty-string env values fall back to the default everywhere (the historic
+call sites were split between `get(k, d)` and `get(k, d) or d`; the `or`
+form is the one that survives `CAKE_X=` in a wrapper script).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["Knob", "REGISTRY", "get", "get_str", "generate_doc"]
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    cast: type              # int | float | str | bool
+    default: object
+    area: str               # docs/knobs.md section
+    doc: str                # one line, imperative — what turning it does
+
+
+REGISTRY: dict[str, Knob] = {}
+
+
+def _knob(name: str, cast: type, default, area: str, doc: str) -> None:
+    if name in REGISTRY:
+        raise ValueError(f"duplicate knob {name}")
+    REGISTRY[name] = Knob(name, cast, default, area, doc)
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
+def get(name: str):
+    """Typed value of knob `name`: the parsed env var when set and
+    non-empty, else the registered default. Unregistered names are a
+    programming error (KeyError), not a silent empty read."""
+    kb = REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return kb.default
+    if kb.cast is bool:
+        return _parse_bool(raw)
+    return kb.cast(raw)
+
+
+def get_str(name: str) -> str:
+    """`get` for str knobs where callers want "" (not None) when unset."""
+    v = get(name)
+    return "" if v is None else str(v)
+
+
+# -- serve ----------------------------------------------------------------
+_knob("CAKE_SERVE_SLOTS", int, 4, "serve",
+      "KV slots = max concurrent batched decodes; 0 disables the engine "
+      "(API falls back to the locked sequential path)")
+_knob("CAKE_MAX_QUEUE", int, 64, "serve",
+      "bounded admission queue: requests waiting beyond free slots; "
+      "overflow answers HTTP 429 + Retry-After")
+_knob("CAKE_SERVE_CTX", int, 4096, "serve",
+      "per-slot context (prompt + generation), capped by the model's "
+      "max_cache_len; pool HBM scales with slots x ctx")
+_knob("CAKE_PREFILL_CHUNK", int, 256, "serve",
+      "per-iteration chunked-admission token budget (clamped to a power "
+      "of two in [16, ctx]); also the prefix-cache block size")
+_knob("CAKE_PREFIX_CACHE_MB", float, 256.0, "serve",
+      "device bytes for shared-prefix KV blocks (LRU); 0 disables "
+      "prefix reuse")
+_knob("CAKE_QUEUE_DEADLINE_S", float, 0.0, "serve",
+      "max admission-queue wait before a request is 503ed instead of "
+      "admitted for a client that gave up; 0 disables")
+_knob("CAKE_DRAIN_TIMEOUT_S", float, 30.0, "serve",
+      "graceful-shutdown budget: admission stops (503 + Retry-After) and "
+      "active slots get this long to finish before close()")
+
+# -- speculative decoding -------------------------------------------------
+_knob("CAKE_SPEC", str, None, "spec",
+      'drafter for spec=None paths: "ngram" enables prompt-lookup '
+      'speculation; unset/empty/"off" disables')
+_knob("CAKE_SPEC_K", int, 6, "spec",
+      "draft tokens proposed per verify step, clamped to [1, 32]")
+_knob("CAKE_SPEC_MAX_BUSY", int, 0, "spec",
+      "engine occupancy ceiling for speculation (above it the scheduler "
+      "falls back to plain batched decode); 0 means slots // 2")
+
+# -- cluster --------------------------------------------------------------
+_knob("CAKE_CLUSTER_KEY", str, None, "cluster",
+      "pre-shared key enabling distributed mode (mutual auth between "
+      "master and workers); unset = single-host")
+_knob("CAKE_HOP_TIMEOUT_S", float, 120.0, "cluster",
+      "per-op deadline on every remote stage forward; an overrun is a "
+      "typed `timeout` StageFailure and recovery takes over")
+_knob("CAKE_HOP_DEGRADED_MS", float, 0.0, "cluster",
+      "gray-failure threshold: rolling RTT p95 above this flags the hop "
+      "degraded in /health without failing anything; 0 disables")
+_knob("CAKE_REVIVE_GRACE_S", float, 60.0, "cluster",
+      "deadline for the FIRST forward after a recovery reconnect (it may "
+      "carry an in-band XLA compile on the re-assigned worker)")
+_knob("CAKE_RECOVERY_RETRIES", int, 3, "cluster",
+      "quarantine -> reconnect -> replay cycles one generation may spend "
+      "before failing fast with ClusterDegradedError")
+_knob("CAKE_RECOVERY_BACKOFF_S", float, 0.5, "cluster",
+      "reconnect backoff base (exponential, capped, +/-25% jitter)")
+_knob("CAKE_RESTORE_INTERVAL_S", float, 5.0, "cluster",
+      "degraded-mode background probe interval until the lost worker "
+      "comes back")
+_knob("CAKE_FAULT_PLAN", str, None, "cluster",
+      'deterministic fault injection plan (tests/drills only), e.g. '
+      '"w0:drop_after_ops=5"')
+
+# -- observability --------------------------------------------------------
+_knob("CAKE_TRACE_DIR", str, None, "obs",
+      "directory for Chrome-trace span exports; setting it also enables "
+      "the span recorder at startup")
+_knob("CAKE_TRACE_EVENTS", int, 16384, "obs",
+      "span recorder ring-buffer capacity (oldest events drop first)")
+
+# -- ops / kernels --------------------------------------------------------
+_knob("CAKE_MOE_RAGGED", bool, True, "ops",
+      "ragged-dot MoE expert combine (falls back to the dense combine "
+      "when off or when the installed jax lacks ragged_dot_general)")
+_knob("CAKE_TPU_FLASH", bool, True, "ops",
+      "flash prefill attention on TPU backends (CPU always uses the "
+      "reference path)")
+
+# -- paths ----------------------------------------------------------------
+_knob("CAKE_TPU_CACHE", str, "~/.cache/cake-tpu", "paths",
+      "worker model-data cache root (split weights, downloaded shards)")
+
+
+_AREA_TITLES = (
+    ("serve", "Serving (continuous-batching engine)"),
+    ("spec", "Speculative decoding"),
+    ("cluster", "Cluster (distributed pipeline + fault tolerance)"),
+    ("obs", "Observability"),
+    ("ops", "Ops / kernels"),
+    ("paths", "Paths"),
+)
+
+
+def generate_doc() -> str:
+    """docs/knobs.md body — one table per area, straight from REGISTRY."""
+    out = [
+        "# Environment knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit. Source of truth is",
+        "     cake_tpu/knobs.py; regenerate with `make knobs-doc`",
+        "     (tests/test_analysis.py pins this file to the registry). -->",
+        "",
+        "Every `CAKE_*` environment variable, generated from the central",
+        "registry in `cake_tpu/knobs.py`. All knobs are read at use time",
+        "(not import time), and an empty value behaves like unset. The",
+        "`knob-registry` lint rule (see [static_analysis.md]"
+        "(static_analysis.md)) keeps raw `os.environ` reads of these",
+        "names out of the tree.",
+        "",
+    ]
+    for area, title in _AREA_TITLES:
+        knobs = [k for k in REGISTRY.values() if k.area == area]
+        if not knobs:
+            continue
+        out += [f"## {title}", "",
+                "| knob | type | default | meaning |",
+                "|---|---|---|---|"]
+        for kb in knobs:
+            default = "unset" if kb.default is None else str(kb.default)
+            out.append(f"| `{kb.name}` | {kb.cast.__name__} | {default} "
+                       f"| {kb.doc} |")
+        out.append("")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    print(generate_doc())

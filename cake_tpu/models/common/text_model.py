@@ -350,6 +350,9 @@ class TextModel:
             # never fetched — admission stays sync-free) rides the SAME
             # device->host transfer as this step's ids, so an iteration
             # costs exactly one fetch no matter how many slots joined
+            # lint: disable=recompile-hazard — nb is STATIC (slot_bucket powers of
+            # two) and the pool shape is fixed per engine: this branch resolves
+            # once per bucket at trace time, never per call
             if nb == toks.shape[0]:
                 # full-occupancy fast path: no prefix slice / write-back —
                 # the donated pool buffers update in place instead of
@@ -754,6 +757,8 @@ class TextModel:
         with RECORDER.span("sample", cat="phase"):
             first = sample(logits[0], sk, scfg, recent)
             recent = push_recent_token(recent, first)
+            # lint: disable=host-sync — deliberate: TTFT is only honest if the
+            # first token has actually reached the host
             tid = int(first)              # device sync: TTFT is honest
         ttft = now() - t0
 
@@ -791,6 +796,8 @@ class TextModel:
                         self.params, tok_arr, cache, rng, recent,
                         jnp.asarray(n_seg, jnp.int32), scfg,
                         bucket_for(n_seg, self.max_cache_len))
+                    # lint: disable=host-sync — the non-streaming path's one fetch per
+                    # SEGMENT (a whole while_loop decode burst), not per token
                     arr = np.asarray(packed)
                 count = int(arr[0])
                 seg = [int(t) for t in arr[1:1 + count]]
@@ -853,6 +860,8 @@ class TextModel:
                     self.params, tok_arr, cache, rng, recent,
                     jnp.asarray(remainder, jnp.int32), scfg,
                     bucket_for(remainder, self.max_cache_len))
+                # lint: disable=host-sync — cache-end remainder flush: one fetch for
+                # the final sub-chunk burst
                 arr = np.asarray(packed)
                 for t in arr[1:1 + int(arr[0])]:
                     out.append(int(t))

@@ -23,6 +23,8 @@ import time
 import uuid
 from collections import deque
 
+from .. import knobs
+
 # -- request-id propagation --------------------------------------------------
 
 _request_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
@@ -62,12 +64,12 @@ class SpanRecorder:
 
     def __init__(self, max_events: int | None = None, enabled: bool | None = None):
         if max_events is None:
-            max_events = int(os.environ.get("CAKE_TRACE_EVENTS", "16384"))
+            max_events = knobs.get("CAKE_TRACE_EVENTS")
         self._events: deque = deque(maxlen=max_events)
         self._lock = threading.Lock()
         self._export_seq = 0
         if enabled is None:
-            enabled = bool(os.environ.get("CAKE_TRACE_DIR"))
+            enabled = bool(knobs.get_str("CAKE_TRACE_DIR"))
         self.enabled = enabled
 
     def enable(self):
@@ -141,7 +143,7 @@ class SpanRecorder:
         """Write the buffer as Chrome-trace JSON (open in Perfetto /
         chrome://tracing). Default path: $CAKE_TRACE_DIR/cake-trace-<pid>-<n>.json."""
         if path is None:
-            trace_dir = os.environ.get("CAKE_TRACE_DIR") or "."
+            trace_dir = knobs.get_str("CAKE_TRACE_DIR") or "."
             os.makedirs(trace_dir, exist_ok=True)
             with self._lock:
                 self._export_seq += 1

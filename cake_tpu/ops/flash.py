@@ -23,7 +23,6 @@ is defined, so the differentiable training path never dispatches here.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -173,7 +172,8 @@ def flash_attention(q, k, v, scale: float | None = None, causal: bool = True,
 
 def flash_enabled() -> bool:
     """Flash prefill opt-in: on for TPU backends unless CAKE_TPU_FLASH=0."""
-    if os.environ.get("CAKE_TPU_FLASH") == "0":
+    from .. import knobs
+    if not knobs.get("CAKE_TPU_FLASH"):
         return False
     try:
         return jax.default_backend() == "tpu"

@@ -45,9 +45,8 @@ def _ragged_enabled() -> bool:
     """CAKE_MOE_RAGGED=0 pins every shape to the dense combine (escape
     hatch if a backend mishandles ragged_dot_general); also gated on the
     installed jax actually providing ragged_dot_general."""
-    import os
-    return (os.environ.get("CAKE_MOE_RAGGED", "1") != "0"
-            and _ragged_available())
+    from .. import knobs
+    return knobs.get("CAKE_MOE_RAGGED") and _ragged_available()
 
 
 def router_topk(logits, k: int, norm_topk_prob: bool, gate_act: str = "softmax"):

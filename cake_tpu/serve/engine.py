@@ -33,7 +33,6 @@ a miss would recompute), which the tier-1 e2e tests pin.
 """
 from __future__ import annotations
 
-import os
 import queue as queue_mod
 import threading
 import time
@@ -43,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
                    SERVE_QUEUE_TIMEOUTS, SERVE_QUEUE_WAIT_SECONDS,
                    SERVE_SLOTS_BUSY, now, set_request_id)
@@ -85,18 +85,10 @@ class QueueDeadlineExceeded(RuntimeError):
 RECENT_N = SamplingConfig().repeat_last_n
 
 # default pool row length when the model's max_cache_len is unbounded-ish:
-# the pool is B x ctx x layers of KV, allocated up front
-DEFAULT_CTX = 4096
-
-# default per-iteration prefill token budget (CAKE_PREFILL_CHUNK): one
-# chunk of at most this many prompt tokens advances per scheduler
-# iteration, so a decode step is never stalled behind more than one
-# chunk's worth of prefill compute
-DEFAULT_CHUNK = 256
-
-# default shared-prefix KV cache capacity in MB (CAKE_PREFIX_CACHE_MB);
-# 0 disables prefix reuse entirely
-DEFAULT_PREFIX_MB = 256.0
+# the pool is B x ctx x layers of KV, allocated up front. Derived from
+# the registry so ServeEngine callers that pass ctx_len=None without
+# going through maybe_engine can never drift from the knob default
+DEFAULT_CTX = int(knobs.REGISTRY["CAKE_SERVE_CTX"].default)
 
 
 def _pow2_chunk(n: int, ctx: int) -> int:
@@ -155,9 +147,12 @@ class ServeRequest:
         self.tokens: list[int] = []
         self.stats: dict = {}
         self.t_enqueue = now()
+        # delivery handoff state: written by API handler threads
+        # registering subscribers, read by the scheduler thread fanning
+        # tokens out (the lock-discipline lint enforces the annotations)
         self._sub_lock = threading.Lock()
-        self._token_cb = None           # push-mode subscriber (SSE bridge)
-        self._done_cbs: list = []
+        self._token_cb = None           # guarded-by: self._sub_lock
+        self._done_cbs: list = []       # guarded-by: self._sub_lock
         # scheduler-owned fields
         self.slot: int | None = None
         self.budget = 0                 # decode tokens left after the first
@@ -245,12 +240,10 @@ class ServeEngine:
         self.slots = slots
         self.ctx = min(ctx_len or DEFAULT_CTX, model.max_cache_len)
         if prefill_chunk is None:
-            prefill_chunk = int(os.environ.get("CAKE_PREFILL_CHUNK",
-                                               str(DEFAULT_CHUNK)))
+            prefill_chunk = knobs.get("CAKE_PREFILL_CHUNK")
         self.chunk = _pow2_chunk(prefill_chunk, self.ctx)
         if prefix_cache_mb is None:
-            prefix_cache_mb = float(os.environ.get("CAKE_PREFIX_CACHE_MB",
-                                                   str(DEFAULT_PREFIX_MB)))
+            prefix_cache_mb = knobs.get("CAKE_PREFIX_CACHE_MB")
         self.prefix_cache = PrefixCache.build(model, self.ctx, self.chunk,
                                               prefix_cache_mb)
         self.pool = SlotPool(slots)
@@ -259,8 +252,7 @@ class ServeEngine:
         # a request whose client-side timeout has surely elapsed is 503ed
         # by the sweep instead of admitted into a slot nobody will read
         if queue_deadline_s is None:
-            queue_deadline_s = float(os.environ.get("CAKE_QUEUE_DEADLINE_S",
-                                                    "0") or 0)
+            queue_deadline_s = knobs.get("CAKE_QUEUE_DEADLINE_S")
         self.queue_deadline_s = queue_deadline_s
         # -- speculative decoding: shallow-batch greedy slots only --------
         # CAKE_SPEC names the drafter ("ngram"; unset = off), CAKE_SPEC_K
@@ -279,8 +271,8 @@ class ServeEngine:
         self.spec_drafter = drafter
         self.spec_k = k
         if spec_max_busy is None:
-            spec_max_busy = int(os.environ.get("CAKE_SPEC_MAX_BUSY", "0")
-                                or 0) or max(1, slots // 2)
+            spec_max_busy = knobs.get("CAKE_SPEC_MAX_BUSY") \
+                or max(1, slots // 2)
         self.spec_max_busy = spec_max_busy
         self.spec_steps = self.spec_proposed = self.spec_accepted = 0
         self._draining = threading.Event()
@@ -556,6 +548,9 @@ class ServeEngine:
                     self._rr = idx          # removed: next job slid here
             # 5. ONE host fetch per iteration: fan the sampled ids out
             if packed is not None:
+                # lint: disable=host-sync — THE one planned fetch per iteration: the
+                # packed [input;sampled] ids for every slot in one
+                # transfer, after the next work is already dispatched
                 self._fanout(active, np.asarray(packed))
         return True
 
@@ -728,6 +723,8 @@ class ServeEngine:
                  self._recents) = self.model.spec_slot(
                     self._layers, self._toks, self._pos, self._rngs,
                     self._recents, slot, draft, self.spec_k, req.sampling)
+                # lint: disable=host-sync — the verify step's one planned fetch:
+                # (input, n_acc, next) in a single small transfer
                 arr = np.asarray(packed)
         finally:
             set_request_id(None)
@@ -829,12 +826,12 @@ def maybe_engine(model, slots: int | None = None,
     if not isinstance(model, TextModel):
         return None
     if slots is None:
-        slots = int(os.environ.get("CAKE_SERVE_SLOTS", "4"))
+        slots = knobs.get("CAKE_SERVE_SLOTS")
     if slots <= 0:
         return None
     if max_queue is None:
-        max_queue = int(os.environ.get("CAKE_MAX_QUEUE", "64"))
+        max_queue = knobs.get("CAKE_MAX_QUEUE")
     if ctx_len is None:
-        ctx_len = int(os.environ.get("CAKE_SERVE_CTX", str(DEFAULT_CTX)))
+        ctx_len = knobs.get("CAKE_SERVE_CTX")
     return ServeEngine(model, slots=slots, max_queue=max_queue,
                        ctx_len=ctx_len)

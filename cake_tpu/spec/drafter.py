@@ -25,10 +25,11 @@ in ops/sampling.spec_accept assumes.
 """
 from __future__ import annotations
 
-import os
 from typing import Protocol, Sequence, runtime_checkable
 
 import numpy as np
+
+from .. import knobs
 
 DEFAULT_SPEC_K = 6
 MAX_SPEC_K = 32
@@ -164,9 +165,13 @@ class DraftModelDrafter:
         logits, self.cache = m.prefill(self.cache, list(ids[start:n]),
                                        pos0=start)
         self.n_valid = n
+        # lint: disable=host-sync — draft proposals are host ints by contract
+        # (the drafter feeds the verify program's host-built token block)
         props = [int(np.argmax(np.asarray(logits[0])))]
         for _ in range(k - 1):
             logits, self.cache = m.decode_logits(self.cache, props[-1])
+            # lint: disable=host-sync — same: each draft id seeds the next draft
+            # decode step on the host path
             props.append(int(np.argmax(np.asarray(logits[0]))))
         if len(props) > 1:
             # decode committed positions n .. n+k-2 — our own speculation;
@@ -184,11 +189,10 @@ def resolve_drafter(spec, k: int | None = None):
     [1, 32].
     """
     if k is None:
-        k = int(os.environ.get("CAKE_SPEC_K", str(DEFAULT_SPEC_K))
-                or DEFAULT_SPEC_K)
+        k = knobs.get("CAKE_SPEC_K")
     k = max(1, min(int(k), MAX_SPEC_K))
     if spec is None:
-        spec = os.environ.get("CAKE_SPEC", "") or None
+        spec = knobs.get("CAKE_SPEC")
     if spec is None or spec is False:
         return None, k
     if isinstance(spec, str):

@@ -77,6 +77,8 @@ def spec_decode_loop(model, drafter, k: int, prompt_ids: list[int],
                            pos=pos):
             packed, cache, recent = model.verify_tokens(
                 cache, out[-1], draft, k, pos, sub, recent, scfg)
+            # lint: disable=host-sync — the verify loop's one planned fetch per
+            # step: [n_acc, next] in a single small transfer
             arr = np.asarray(packed)
         n_acc, nxt = int(arr[0]), int(arr[1])
         steps += 1

@@ -30,8 +30,8 @@ def hf_cache_dir() -> str:
 
 def cake_cache_dir() -> str:
     """Our own worker model-data cache root (ref: sharding/mod.rs cache dir)."""
-    return os.environ.get("CAKE_TPU_CACHE",
-                          os.path.expanduser("~/.cache/cake-tpu"))
+    from .. import knobs
+    return os.path.expanduser(knobs.get("CAKE_TPU_CACHE"))
 
 
 def probe_cached_repo(repo_id: str) -> str | None:

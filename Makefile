@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke spec-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke serve-chaos-smoke spec-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -50,6 +50,13 @@ serve-smoke: lint
 # prefill, and the recovery counters must be non-zero in /metrics
 chaos-smoke:
 	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+# serve-plane crash-only gate: engine under concurrent API load with one
+# injected step crash — every client completes 200 bit-identical to an
+# uninjected run, exactly one rebuild (non-zero
+# cake_serve_engine_rebuilds_total in /metrics), /health back to 200
+serve-chaos-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/serve_chaos_smoke.py
 
 # serve scheduler bench: TTFT p50/p99 + tok/s for a shared-system-prompt
 # workload cold (no prefix cache) vs warm (prefix cached), and the

@@ -17,6 +17,11 @@ HOT_PATHS = frozenset({
     "cake_tpu/serve/admission.py",
     "cake_tpu/serve/slots.py",
     "cake_tpu/serve/prefix_cache.py",
+    # crash-only supervision: arm/disarm + failure handling run per
+    # dispatch / per recovery, and the fault hook sits on the dispatch
+    # path itself
+    "cake_tpu/serve/supervisor.py",
+    "cake_tpu/serve/faults.py",
     # speculative decode: per verify step
     "cake_tpu/spec/drafter.py",
     "cake_tpu/spec/verify.py",

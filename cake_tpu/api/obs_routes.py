@@ -166,9 +166,16 @@ async def health(request: web.Request) -> web.Response:
         # probe must not restart a server that is merely warming up).
         einfo = engine.health()
         busy = einfo["slots_busy"] or einfo["queue_depth"]
-        einfo["wedged"] = bool(busy and einfo["last_step_age_s"]
-                               > ENGINE_WEDGED_S)
-        if not einfo["alive"] or einfo["wedged"]:
+        # wedged = the engine's own watchdog flag (a dispatch stuck past
+        # CAKE_STEP_WATCHDOG_S) OR the coarse fallback here for engines
+        # running without a watchdog
+        einfo["wedged"] = bool(einfo.get("wedged")) or bool(
+            busy and einfo["last_step_age_s"] > ENGINE_WEDGED_S)
+        # down = the supervisor's rebuild budget is exhausted: submits
+        # answer 503 + Retry-After and the restore loop is probing, so
+        # the balancer should route elsewhere until `down` clears. The
+        # block carries down_for_s + last_failure for the operator.
+        if not einfo["alive"] or einfo["wedged"] or einfo.get("down"):
             degraded = True
         body["engine"] = einfo
     body["status"] = "degraded" if degraded else "ok"

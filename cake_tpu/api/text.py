@@ -20,7 +20,9 @@ from aiohttp import web
 
 from ..obs import GENERATIONS, current_request_id, set_request_id
 from ..ops.sampling import SamplingConfig
-from ..serve import EngineDraining, QueueDeadlineExceeded, QueueFull
+from ..serve import (EngineDown, EngineDraining, PoisonedRequest,
+                     QueueDeadlineExceeded, QueueFull,
+                     RequestDeadlineExceeded)
 from .state import (ApiState, run_blocking, run_generation_blocking,
                     run_generation_streamed)
 
@@ -142,6 +144,24 @@ class StopMatcher:
 
 def _completion_id() -> str:
     return "chatcmpl-" + uuid.uuid4().hex[:24]
+
+
+def _typed_error_response(err: BaseException) -> web.Response | None:
+    """Map a typed engine failure onto its documented status — shared by
+    the blocking path and the SSE path's pre-commit refusal, so a
+    degraded engine answers the SAME way everywhere: 503 + Retry-After
+    for retry-elsewhere conditions (queue deadline, engine down), 504
+    for a request that outlived its deadline, 500 for a poisoned
+    request. None means not a typed engine error (caller decides)."""
+    if isinstance(err, (QueueDeadlineExceeded, EngineDown)):
+        return web.json_response(
+            {"error": str(err)}, status=503,
+            headers={"Retry-After": str(getattr(err, "retry_after_s", 5))})
+    if isinstance(err, RequestDeadlineExceeded):
+        return web.json_response({"error": str(err)}, status=504)
+    if isinstance(err, PoisonedRequest):
+        return web.json_response({"error": str(err)}, status=500)
+    return None
 
 
 async def chat_completions(request: web.Request) -> web.StreamResponse:
@@ -342,28 +362,34 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         return web.json_response(
             {"error": str(e)}, status=503,
             headers={"Retry-After": str(e.retry_after_s)})
+    except (EngineDown, PoisonedRequest) as e:
+        # typed refusals share the terminal-error mapping: 503 +
+        # Retry-After for a down engine (the balancer reroutes, the
+        # restore loop revives), 500 for a quarantined poison prompt
+        return _typed_error_response(e)
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
-    except RuntimeError as e:               # engine dead
+    except RuntimeError as e:               # engine dead (legacy path)
         return web.json_response({"error": str(e)}, status=503)
     if stream:
-        # with a queue deadline armed, don't commit to a 200 SSE while the
-        # request can still be shed: wait for admission (or a terminal
-        # failure) first, so an expired wait answers the documented 503 +
-        # Retry-After instead of an in-band error chunk no balancer sees
-        if state.engine.queue_deadline_s > 0:
-            try:
-                while not (req.admitted.is_set() or req.done.is_set()):
-                    await asyncio.sleep(0.02)
-            except asyncio.CancelledError:
-                req.cancel()            # client gone while queued
-                raise
-            err = req.result.get("error")
-            if isinstance(err, QueueDeadlineExceeded):
+        # never commit to a 200 SSE while the request can still be
+        # refused outright: wait for admission (or a terminal failure)
+        # first, so a shed request — queue deadline, engine going down,
+        # poison quarantine — answers its documented typed status
+        # instead of an in-band error chunk no balancer ever sees. A
+        # queued-but-unadmitted request has no tokens to stream anyway,
+        # so holding the headers back costs nothing.
+        try:
+            while not (req.admitted.is_set() or req.done.is_set()):
+                await asyncio.sleep(0.02)
+        except asyncio.CancelledError:
+            req.cancel()            # client gone while queued
+            raise
+        if req.done.is_set() and "error" in req.result:
+            resp = _typed_error_response(req.result["error"])
+            if resp is not None:
                 GENERATIONS.inc(kind="text", status="error")
-                return web.json_response(
-                    {"error": str(err)}, status=503,
-                    headers={"Retry-After": str(err.retry_after_s)})
+                return resp
         aiter, result = state.engine.stream(req)
         return await _sse_drain(request, state, cid, aiter, result,
                                 req.cancel, stops)
@@ -405,12 +431,12 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
     if "error" in req.result:
         err = req.result["error"]
         GENERATIONS.inc(kind="text", status="error")
-        if isinstance(err, QueueDeadlineExceeded):
-            # the client's patience is presumed spent; 503 tells honest
-            # retriers to come back rather than blaming the request
-            return web.json_response(
-                {"error": str(err)}, status=503,
-                headers={"Retry-After": str(err.retry_after_s)})
+        # typed engine failures answer their documented status (503 +
+        # Retry-After for retryable-elsewhere, 504 past the request
+        # deadline, 500 for poison) — only untyped bugs fall to bare 500
+        resp = _typed_error_response(err)
+        if resp is not None:
+            return resp
         return web.json_response(
             {"error": f"generation failed: {err}"}, status=500)
     GENERATIONS.inc(kind="text", status="ok")

@@ -92,6 +92,26 @@ _knob("CAKE_QUEUE_DEADLINE_S", float, 0.0, "serve",
 _knob("CAKE_DRAIN_TIMEOUT_S", float, 30.0, "serve",
       "graceful-shutdown budget: admission stops (503 + Retry-After) and "
       "active slots get this long to finish before close()")
+_knob("CAKE_REQUEST_DEADLINE_S", float, 0.0, "serve",
+      "max TOTAL request age (queue + prefill + decode) before an "
+      "admitted slot is cancelled with a typed 504; 0 disables")
+_knob("CAKE_STEP_WATCHDOG_S", float, 0.0, "serve",
+      "wedge watchdog: a device dispatch in flight longer than this "
+      "flags the engine wedged in /health (503) without killing it; "
+      "0 disables — set it above your worst in-iteration XLA compile")
+_knob("CAKE_ENGINE_REBUILDS", int, 3, "serve",
+      "slot-pool rebuild-by-replay budget per rolling "
+      "CAKE_ENGINE_REBUILD_WINDOW_S; exhausting it puts the engine in "
+      "the honest DOWN state (503 + Retry-After, restore loop probing)")
+_knob("CAKE_ENGINE_REBUILD_WINDOW_S", float, 300.0, "serve",
+      "rolling window over which CAKE_ENGINE_REBUILDS is counted — a "
+      "crash storm is a dying device, sparse blips are not")
+_knob("CAKE_ENGINE_RESTORE_S", float, 5.0, "serve",
+      "DOWN-state probe interval: a trial prefill runs this often until "
+      "one succeeds, then the pool is rebuilt and admission reopens")
+_knob("CAKE_SERVE_FAULT_PLAN", str, None, "serve",
+      'deterministic serve-engine fault injection (tests/drills only), '
+      'e.g. "raise_on_step=6;kind=device" — see serve/faults.py')
 
 # -- speculative decoding -------------------------------------------------
 _knob("CAKE_SPEC", str, None, "spec",

@@ -123,6 +123,36 @@ SERVE_QUEUE_TIMEOUTS = REGISTRY.counter(
     "Requests expired in the admission queue past CAKE_QUEUE_DEADLINE_S "
     "(answered 503 instead of occupying a slot for a client that gave up)")
 
+SERVE_STEP_FAILURES = REGISTRY.counter(
+    "cake_serve_step_failures_total",
+    "Classified serve-engine step failures handled by the supervisor",
+    labelnames=("kind",))           # wedge | device | poison | oom |
+                                    # internal
+
+SERVE_ENGINE_REBUILDS = REGISTRY.counter(
+    "cake_serve_engine_rebuilds_total",
+    "Slot-pool rebuild-by-replay recoveries after a step failure")
+
+SERVE_ENGINE_WEDGES = REGISTRY.counter(
+    "cake_serve_engine_wedges_total",
+    "Watchdog detections of a device dispatch stuck past "
+    "CAKE_STEP_WATCHDOG_S (the engine reports wedged in /health)")
+
+SERVE_ENGINE_DOWN = REGISTRY.gauge(
+    "cake_serve_engine_down",
+    "1 while the engine's rebuild budget is exhausted (submits answer "
+    "503 + Retry-After; the restore loop is probing the device)")
+
+SERVE_POISONED = REGISTRY.counter(
+    "cake_serve_poisoned_requests_total",
+    "Requests failed as poison (implicated in consecutive engine "
+    "crashes) and fingerprint-quarantined")
+
+SERVE_REQUEST_TIMEOUTS = REGISTRY.counter(
+    "cake_serve_request_timeouts_total",
+    "Admitted requests cancelled because their total age passed "
+    "CAKE_REQUEST_DEADLINE_S (answered 504)")
+
 CLUSTER_STAGE_FAILURES = REGISTRY.counter(
     "cake_cluster_stage_failures_total",
     "Classified remote-hop failures observed by the master",
@@ -168,7 +198,10 @@ __all__ = [
     "SERVE_QUEUE_DEPTH", "SERVE_SLOTS_BUSY", "SERVE_QUEUE_WAIT_SECONDS",
     "SERVE_BATCH_OCCUPANCY", "SERVE_PREFILL_CHUNKS", "SERVE_PREFIX_HITS",
     "SERVE_PREFIX_MISSES", "SERVE_PREFIX_EVICTIONS", "SERVE_PREFIX_BYTES",
-    "SERVE_QUEUE_TIMEOUTS", "CLUSTER_STAGE_FAILURES", "CLUSTER_RECONNECTS",
+    "SERVE_QUEUE_TIMEOUTS", "SERVE_STEP_FAILURES", "SERVE_ENGINE_REBUILDS",
+    "SERVE_ENGINE_WEDGES", "SERVE_ENGINE_DOWN", "SERVE_POISONED",
+    "SERVE_REQUEST_TIMEOUTS",
+    "CLUSTER_STAGE_FAILURES", "CLUSTER_RECONNECTS",
     "CLUSTER_REPLAYS", "CLUSTER_DEGRADED", "CLUSTER_HOP_DEGRADED",
     "SPEC_PROPOSED", "SPEC_ACCEPTED", "SPEC_ACCEPTED_LEN",
 ]

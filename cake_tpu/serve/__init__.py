@@ -1,12 +1,17 @@
 """Continuous-batching serving subsystem: slot-pooled batched decode with
-bounded admission, chunked prefill and shared-prefix KV reuse (see
-docs/serving.md)."""
+bounded admission, chunked prefill, shared-prefix KV reuse, and crash-only
+supervision (typed step failures, rebuild-by-replay, poison quarantine,
+wedge watchdog) — see docs/serving.md and docs/fault_tolerance.md."""
 from .admission import AdmissionQueue, QueueFull
 from .engine import (EngineDraining, QueueDeadlineExceeded, ServeEngine,
                      ServeRequest, maybe_engine)
 from .prefix_cache import PrefixCache
 from .slots import SlotPool
+from .supervisor import (EngineDown, PoisonedRequest,
+                         RequestDeadlineExceeded, StepFailure, Supervisor)
 
 __all__ = ["AdmissionQueue", "QueueFull", "EngineDraining",
-           "QueueDeadlineExceeded", "PrefixCache", "ServeEngine",
-           "ServeRequest", "SlotPool", "maybe_engine"]
+           "QueueDeadlineExceeded", "EngineDown", "PoisonedRequest",
+           "RequestDeadlineExceeded", "StepFailure", "Supervisor",
+           "PrefixCache", "ServeEngine", "ServeRequest", "SlotPool",
+           "maybe_engine"]

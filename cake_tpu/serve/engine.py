@@ -30,9 +30,23 @@ Greedy outputs are bit-identical to the sequential path (masked slots
 contribute exactly-zero attention weight; chunked prefill reproduces the
 monolithic program's numerics; a prefix-cache hit splices the exact bytes
 a miss would recompute), which the tier-1 e2e tests pin.
+
+The engine is CRASH-ONLY (supervisor.py owns the policy): a step failure
+no longer kills serving — the supervisor classifies it, reallocates the
+pool, and `_rebuild` replays every live slot's prompt+generated tokens
+through the chunked-prefill path (the prefix cache makes shared prefixes
+nearly free to replay; replay lands on the same chunk buckets admission
+compiled). Greedy continuations across a rebuild are bit-identical —
+every carry the decode program needs (last token, position, recent
+window) is reconstructible from the host-side token record; sampled
+(temperature > 0) streams resume on a FRESH rng fold, which is the one
+documented parity exception. Repeated failures are budgeted; past the
+budget the engine goes honestly DOWN (typed 503s, /health engine block,
+restore probe) instead of silently dead — see docs/fault_tolerance.md.
 """
 from __future__ import annotations
 
+import logging
 import queue as queue_mod
 import threading
 import time
@@ -44,17 +58,24 @@ import numpy as np
 
 from .. import knobs
 from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
-                   SERVE_QUEUE_TIMEOUTS, SERVE_QUEUE_WAIT_SECONDS,
+                   SERVE_POISONED, SERVE_QUEUE_TIMEOUTS,
+                   SERVE_QUEUE_WAIT_SECONDS, SERVE_REQUEST_TIMEOUTS,
                    SERVE_SLOTS_BUSY, now, set_request_id)
 from ..ops.sampling import SamplingConfig
 from ..spec import resolve_drafter
 from ..spec.verify import record_step
+from . import faults
 from .admission import AdmissionQueue, QueueFull
 from .prefix_cache import PrefixCache
 from .slots import SlotPool, slot_bucket
+from .supervisor import (EngineDown, PoisonedRequest,
+                         RequestDeadlineExceeded, Supervisor, classify)
 
 __all__ = ["ServeEngine", "ServeRequest", "QueueFull", "EngineDraining",
-           "QueueDeadlineExceeded", "maybe_engine"]
+           "QueueDeadlineExceeded", "EngineDown", "PoisonedRequest",
+           "RequestDeadlineExceeded", "maybe_engine"]
+
+log = logging.getLogger("cake_tpu.serve")
 
 
 class EngineDraining(RuntimeError):
@@ -229,8 +250,13 @@ class ServeEngine:
                  prefill_chunk: int | None = None,
                  prefix_cache_mb: float | None = None,
                  queue_deadline_s: float | None = None,
+                 request_deadline_s: float | None = None,
                  spec=None, spec_k: int | None = None,
-                 spec_max_busy: int | None = None):
+                 spec_max_busy: int | None = None,
+                 step_watchdog_s: float | None = None,
+                 rebuild_budget: int | None = None,
+                 rebuild_window_s: float | None = None,
+                 restore_interval_s: float | None = None):
         if not hasattr(model, "decode_slots"):
             raise TypeError(
                 f"{type(model).__name__} has no batched slot decode; the "
@@ -244,6 +270,7 @@ class ServeEngine:
         self.chunk = _pow2_chunk(prefill_chunk, self.ctx)
         if prefix_cache_mb is None:
             prefix_cache_mb = knobs.get("CAKE_PREFIX_CACHE_MB")
+        self._prefix_mb = prefix_cache_mb    # rebuilds reconstruct the cache
         self.prefix_cache = PrefixCache.build(model, self.ctx, self.chunk,
                                               prefix_cache_mb)
         self.pool = SlotPool(slots)
@@ -254,6 +281,12 @@ class ServeEngine:
         if queue_deadline_s is None:
             queue_deadline_s = knobs.get("CAKE_QUEUE_DEADLINE_S")
         self.queue_deadline_s = queue_deadline_s
+        # per-request TOTAL deadline (CAKE_REQUEST_DEADLINE_S, 0 disables):
+        # the queue sweep above only covers waiting — this one cancels
+        # ADMITTED slots whose whole-request age expired (504, typed)
+        if request_deadline_s is None:
+            request_deadline_s = knobs.get("CAKE_REQUEST_DEADLINE_S")
+        self.request_deadline_s = request_deadline_s
         # -- speculative decoding: shallow-batch greedy slots only --------
         # CAKE_SPEC names the drafter ("ngram"; unset = off), CAKE_SPEC_K
         # the draft width, CAKE_SPEC_MAX_BUSY the occupancy ceiling
@@ -277,30 +310,10 @@ class ServeEngine:
         self.spec_steps = self.spec_proposed = self.spec_accepted = 0
         self._draining = threading.Event()
 
-        pool_cache = model.new_cache(slots, kv_len=self.ctx)
-        self._layers = pool_cache["layers"]
-        vocab = model.cfg.vocab_size
-        self._vocab = vocab
-        # ALL per-slot state is device-resident: rows are written at
-        # admission/release only, and the whole carry (tokens, positions,
-        # RNG, recent windows) advances inside the batched decode program
-        # — an iteration ships nothing host->device and fetches only the
-        # nb sampled ids
-        self._toks = jnp.zeros((slots,), jnp.int32)
-        self._pos = jnp.zeros((slots,), jnp.int32)
-        self._temps = jnp.zeros((slots,), jnp.float32)
-        self._top_ks = jnp.full((slots,), vocab, jnp.int32)
-        self._top_ps = jnp.ones((slots,), jnp.float32)
-        self._pens = jnp.ones((slots,), jnp.float32)
-        self._rngs = jnp.stack([jax.random.PRNGKey(seed + i)
-                                for i in range(slots)])
-        self._recents = jnp.full((slots, RECENT_N), -1, jnp.int32)
-        # decode-eligibility mask: True only for slots whose prefill has
-        # COMPLETED. Mutated at transitions only (prefill done / release),
-        # never donated — the engine keeps its handle across iterations,
-        # so steady-state decode still ships nothing host->device
-        self._act = jnp.zeros((slots,), jnp.bool_)
+        self._seed = seed
+        self._vocab = model.cfg.vocab_size
         self._base_rng = jax.random.PRNGKey(seed)
+        self._init_device_state()
         self._reqs: list[ServeRequest | None] = [None] * slots
         self._prefills: list[_Prefill] = []   # in-flight chunked admissions
         self._rr = 0                          # round-robin cursor over them
@@ -311,21 +324,74 @@ class ServeEngine:
         self.steps = 0                  # completed scheduler iterations
         self.last_step = now()
         self.dead: BaseException | None = None
+        # the supervisor needs _stop (watchdog lifetime) — build it after
+        # the events, before the scheduler thread can possibly fail
+        self.supervisor = Supervisor(
+            self, watchdog_s=step_watchdog_s, rebuild_budget=rebuild_budget,
+            rebuild_window_s=rebuild_window_s,
+            restore_interval_s=restore_interval_s)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cake-serve")
         self._thread.start()
+
+    def _init_device_state(self, layers=None):
+        """(Re)allocate the pool cache and every per-slot carry — called
+        at construction and by crash recovery (`_rebuild`/`_revive`),
+        which trusts NOTHING device-resident after a failure (donated
+        buffers may be consumed, results may be garbage: crash-only).
+
+        ALL per-slot state is device-resident: rows are written at
+        admission/release only, and the whole carry (tokens, positions,
+        RNG, recent windows) advances inside the batched decode program
+        — an iteration ships nothing host->device and fetches only the
+        nb sampled ids."""
+        slots = self.slots
+        if layers is None:
+            layers = self.model.new_cache(slots, kv_len=self.ctx)["layers"]
+        self._layers = layers
+        self._toks = jnp.zeros((slots,), jnp.int32)
+        self._pos = jnp.zeros((slots,), jnp.int32)
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._top_ks = jnp.full((slots,), self._vocab, jnp.int32)
+        self._top_ps = jnp.ones((slots,), jnp.float32)
+        self._pens = jnp.ones((slots,), jnp.float32)
+        self._rngs = jnp.stack([jax.random.PRNGKey(self._seed + i)
+                                for i in range(slots)])
+        self._recents = jnp.full((slots, RECENT_N), -1, jnp.int32)
+        # decode-eligibility mask: True only for slots whose prefill has
+        # COMPLETED. Mutated at transitions only (prefill done / release),
+        # never donated — the engine keeps its handle across iterations,
+        # so steady-state decode still ships nothing host->device
+        self._act = jnp.zeros((slots,), jnp.bool_)
 
     # -- client surface (any thread) ----------------------------------------
 
     def submit(self, prompt_ids: list[int], max_new_tokens: int = 256,
                sampling: SamplingConfig | None = None,
                request_id: str | None = None) -> ServeRequest:
-        """Enqueue a generation. Raises QueueFull under backpressure and
-        ValueError for prompts the pool can never hold."""
+        """Enqueue a generation. Raises QueueFull under backpressure,
+        EngineDown while the engine is dead or in budget-exhausted
+        degraded mode (API: 503 + Retry-After), PoisonedRequest for
+        quarantined prompts, and ValueError for prompts the pool can
+        never hold."""
         if self.dead is not None or not self._thread.is_alive():
-            raise RuntimeError(f"serve engine is down: {self.dead}")
+            raise EngineDown(f"serve engine is down: {self.dead}",
+                             retry_after_s=30)
+        down = self.supervisor.down_info()
+        if down is not None:
+            raise EngineDown(
+                "serve engine down for "
+                f"{down['down_for_s']}s (rebuild budget exhausted: "
+                f"{down.get('last_failure', 'unknown failure')}); "
+                "restore loop probing",
+                retry_after_s=max(
+                    int(self.supervisor.restore_interval_s) + 1, 5))
         if self._draining.is_set():
             raise EngineDraining()
+        if self.supervisor.is_quarantined(prompt_ids):
+            raise PoisonedRequest(
+                "request fingerprint quarantined: identical prompt was "
+                "implicated in repeated engine crashes")
         n = len(prompt_ids)
         if n < 1:
             raise ValueError("empty prompt")
@@ -339,12 +405,21 @@ class ServeEngine:
         # admitted even though the scheduler drains one per iteration
         self.queue.put(req, allow_extra=self.pool.free_count)
         self._wake.set()
-        if self.dead is not None:
-            # the scheduler crashed between the liveness check above and
-            # the put: its crash drain may have missed this request, so
-            # release the waiter ourselves (double-fail is harmless)
+        if self.dead is not None or self.supervisor.is_down():
+            # the scheduler crashed (or went down) between the liveness
+            # check above and the put: its crash drain may have missed
+            # this request, so release the waiter ourselves (double-fail
+            # is harmless)
             self.queue.purge(lambda r: r is req)
-            err = RuntimeError(f"serve engine is down: {self.dead}")
+            if self.dead is not None:
+                err = EngineDown(f"serve engine is down: {self.dead}",
+                                 retry_after_s=30)
+            else:
+                err = EngineDown(
+                    "serve engine down: rebuild budget exhausted; "
+                    "restore loop probing",
+                    retry_after_s=max(
+                        int(self.supervisor.restore_interval_s) + 1, 5))
             self._fail(req, err)
             raise err
         return req
@@ -397,7 +472,19 @@ class ServeEngine:
             "draining": self._draining.is_set(),
             "steps": self.steps,
             "last_step_age_s": round(now() - self.last_step, 3),
+            # supervision: lifetime recovery counters + live wedge flag
+            "rebuilds": self.supervisor.rebuild_count,
+            "wedged": self.supervisor.wedged(),
         }
+        lf = self.supervisor.last_failure()
+        if lf is not None:
+            h["last_failure"] = lf
+        down = self.supervisor.down_info()
+        if down is not None:
+            h["down"] = down
+        q = self.supervisor.quarantined_count()
+        if q:
+            h["quarantined"] = q
         if self.prefix_cache is not None:
             h["prefix_cache"] = self.prefix_cache.occupancy()
         if self.spec_drafter is not None:
@@ -434,7 +521,7 @@ class ServeEngine:
         self._wake.set()
         self._thread.join(timeout=timeout)
         for req in self.queue.drain():
-            self._fail(req, RuntimeError("serve engine shut down"))
+            self._fail(req, EngineDown("serve engine shut down"))
         if self._thread.is_alive():
             # scheduler still inside a device call (e.g. a long compile):
             # release the waiters but do NOT touch pool/_reqs/_layers —
@@ -444,7 +531,7 @@ class ServeEngine:
                 "serve engine shutdown timed out")
             for req in list(self._reqs):
                 if req is not None:
-                    self._fail(req, RuntimeError("serve engine shut down"))
+                    self._fail(req, EngineDown("serve engine shut down"))
             return
         self._prefills.clear()
         for i, req in enumerate(self._reqs):
@@ -454,27 +541,93 @@ class ServeEngine:
     # -- scheduler thread ---------------------------------------------------
 
     def _loop(self):
+        """Supervision shell: the inner `_run` loop does the work; a
+        failure escaping it goes to the supervisor's recovery state
+        machine (classify -> rebuild-by-replay -> budget -> down). Only
+        when the supervisor itself gives up (or breaks) does the engine
+        fall to the legacy terminal `dead` state."""
+        while not self._stop.is_set():
+            try:
+                self._run()
+                return                      # clean _stop
+            except BaseException as e:
+                try:
+                    recovered = self.supervisor.on_failure(e)
+                except BaseException as sup_exc:
+                    self._die(sup_exc)      # supervisor bug: last resort
+                    return
+                if not recovered:
+                    self._die(e)
+                    return
+
+    def _run(self):
+        while not self._stop.is_set():
+            if self.supervisor.is_down():
+                self._down_cycle()
+                continue
+            worked = self._step()
+            self.supervisor.disarm()
+            self.last_step = now()
+            if worked:
+                self.steps += 1
+                self.supervisor.note_ok()
+            else:
+                # idle: block on the wake event (submit/cancel/close
+                # all set it); the 0.5s timeout is only a heartbeat
+                # for last_step, not a polling cadence
+                self._wake.wait(0.5)
+                self._wake.clear()
+
+    def _die(self, e: BaseException):
+        """Terminal failure: every waiter is released, loudly."""
+        self.dead = e
+        self._prefills.clear()      # their reqs are in _reqs below
+        for req in self.queue.drain():
+            self._fail(req, e)
+        for i, req in enumerate(self._reqs):
+            if req is not None:
+                req.result.setdefault("error", e)
+                self._finish(i, req, cancelled=True, release=False)
+
+    # -- degraded mode (rebuild budget exhausted) ---------------------------
+
+    def _down_cycle(self):
+        """One restore-loop turn while the engine is DOWN: shed whatever
+        raced into the queue, wait CAKE_ENGINE_RESTORE_S, then probe the
+        device with a trial prefill. Success rebuilds an empty pool and
+        resumes serving; failure stays down for the next probe."""
+        err = EngineDown("serve engine down: rebuild budget exhausted; "
+                         "restore loop probing")
+        for req in self.queue.drain():
+            self._fail(req, err)
+        if self._stop.wait(self.supervisor.restore_interval_s):
+            return
         try:
-            while not self._stop.is_set():
-                worked = self._step()
-                self.last_step = now()
-                if worked:
-                    self.steps += 1
-                else:
-                    # idle: block on the wake event (submit/cancel/close
-                    # all set it); the 0.5s timeout is only a heartbeat
-                    # for last_step, not a polling cadence
-                    self._wake.wait(0.5)
-                    self._wake.clear()
-        except BaseException as e:  # fail loudly: every waiter is released
-            self.dead = e
-            self._prefills.clear()      # their reqs are in _reqs below
-            for req in self.queue.drain():
-                self._fail(req, e)
-            for i, req in enumerate(self._reqs):
-                if req is not None:
-                    req.result.setdefault("error", e)
-                    self._finish(i, req, cancelled=True, release=False)
+            # recovery-grace watchdog limit: the trial may compile
+            self.supervisor.arm("trial", (), grace=True)
+            layers = self.model.new_cache(self.slots,
+                                          kv_len=self.ctx)["layers"]
+            _, layers = self.model.prefill_chunk(layers, 0, [1], 0)
+            layers = self.model.slot_release(layers, 0)
+            # the dispatches above are async — a broken device surfaces
+            # its error here, inside the probe's try, not mid-serving
+            jax.block_until_ready(layers)
+            self.supervisor.disarm()
+        except Exception as e:
+            self.supervisor.disarm()
+            self.supervisor.note_probe_failure(e)
+            return
+        self._revive(layers)
+
+    def _revive(self, layers):
+        """Trial step succeeded: adopt its (wiped) pool, fresh carries,
+        fresh prefix cache, and rejoin the serving loop."""
+        self._init_device_state(layers)
+        self.prefix_cache = PrefixCache.build(self.model, self.ctx,
+                                              self.chunk, self._prefix_mb)
+        self.supervisor.clear_down()
+        log.warning("serve engine revived: trial step succeeded, pool "
+                    "rebuilt empty, admission reopened")
 
     def _step(self) -> bool:
         busy = self.pool.busy()
@@ -483,6 +636,11 @@ class ServeEngine:
             return False
         with RECORDER.span("serve.step", cat="serve", slots=len(busy),
                            queued=self.queue.depth()):
+            # reset failure-attribution context: a crash in the host
+            # bookkeeping below must not implicate the PREVIOUS step's
+            # request set (the decode/prefill dispatches re-arm with
+            # their own sets)
+            self.supervisor.arm("step", ())
             # 1. cancel sweeps: decoding slots, mid-prefill slots, and
             # abandoned-while-queued requests (those would otherwise pin
             # queue capacity and 429 live clients while slots sit idle)
@@ -507,6 +665,27 @@ class ServeEngine:
                     SERVE_QUEUE_TIMEOUTS.inc()
                     self._fail(req, QueueDeadlineExceeded(
                         now() - req.t_enqueue))
+            # request-deadline sweep (CAKE_REQUEST_DEADLINE_S): ADMITTED
+            # requests whose TOTAL age expired are cancelled with a typed
+            # 504 — the queue sweep above only covers waiting, so without
+            # this a slow decode could hold a slot long past the point
+            # every client timeout has fired
+            if self.request_deadline_s > 0:
+                cutoff = now() - self.request_deadline_s
+                for i in self.pool.busy():
+                    req = self._reqs[i]
+                    if req is None or req.t_enqueue >= cutoff:
+                        continue
+                    SERVE_REQUEST_TIMEOUTS.inc()
+                    err = RequestDeadlineExceeded(
+                        now() - req.t_enqueue, self.request_deadline_s)
+                    pf = next((p for p in self._prefills if p.slot == i),
+                              None)
+                    if pf is not None:
+                        self._abort_prefill(pf, err)
+                    else:
+                        req.result["error"] = err
+                        self._finish(i, req, cancelled=True)
             # 2. every queued request takes a free slot NOW (cheap: at
             # most a prefix-cache splice — the prefill itself is chunked
             # below), so multiple admissions are in flight concurrently
@@ -523,12 +702,20 @@ class ServeEngine:
             active = [i for i in self.pool.busy()
                       if self._reqs[i] is not None and i not in prefilling]
             packed = None
+            active_ids = tuple(self._reqs[i].id for i in active)
             if self._spec_eligible(active):
                 for i in active:
                     self._spec_step(i)
             elif active:
                 nb = slot_bucket(active[-1] + 1, self.slots)
                 SERVE_BATCH_OCCUPANCY.observe(len(active))
+                # arm BEFORE the fault hook: an injected stall simulates a
+                # dispatch stuck on the device, and the watchdog must see
+                # it; real crashes here implicate every active request
+                self.supervisor.arm("decode", active_ids)
+                hook = faults.FAULT_HOOK
+                if hook is not None:
+                    hook.on_decode([self._reqs[i] for i in active])
                 (packed, self._layers, self._toks, self._pos, self._rngs,
                  self._recents) = self.model.decode_slots(
                     self._layers, self._toks, self._pos, self._rngs,
@@ -548,6 +735,11 @@ class ServeEngine:
                     self._rr = idx          # removed: next job slid here
             # 5. ONE host fetch per iteration: fan the sampled ids out
             if packed is not None:
+                # the fetch is where an async device failure (or a wedge)
+                # actually materializes on the host: re-arm with the
+                # decode set so the supervisor attributes it correctly
+                # even if a prefill chunk was dispatched in between
+                self.supervisor.arm("decode", active_ids)
                 # lint: disable=host-sync — THE one planned fetch per iteration: the
                 # packed [input;sampled] ids for every slot in one
                 # transfer, after the next work is already dispatched
@@ -577,25 +769,32 @@ class ServeEngine:
         req.slot = slot
         req.admitted.set()
         req.stats = {"queue_wait_s": now() - req.t_enqueue}
-        pf = _Prefill(req, slot)
-        set_request_id(req.id)
+        self._begin_prefill(_Prefill(req, slot))
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        return True
+
+    def _begin_prefill(self, pf: _Prefill) -> bool:
+        """Open a chunked admission for an already-registered request:
+        splice any cached shared prefix, then put it in flight. Shared by
+        fresh admissions and rebuild restarts. Returns False (request
+        failed) when the splice dies."""
+        set_request_id(pf.req.id)
         try:
             if self.prefix_cache is not None:
                 pf.keys = self.prefix_cache.chain_keys(pf.ids)
                 matched = self.prefix_cache.match(pf.ids, pf.keys)
                 if matched:
                     self._layers = self.prefix_cache.splice(
-                        self._layers, slot, pf.keys, matched)
+                        self._layers, pf.slot, pf.keys, matched)
                     pf.pos = matched * self.chunk
                     pf.next_block = matched
                     pf.hit_tokens = pf.pos
         except Exception as e:
             self._abort_prefill(pf, e, register=False)
-            return True
+            return False
         finally:
             set_request_id(None)
         self._prefills.append(pf)
-        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         return True
 
     def _advance_prefill(self, pf: _Prefill) -> bool:
@@ -608,28 +807,31 @@ class ServeEngine:
         try:
             with RECORDER.span("serve.prefill_chunk", cat="serve",
                                tokens=take, pos0=pf.pos, slot=pf.slot):
+                self.supervisor.arm("prefill", (pf.req.id,))
+                hook = faults.FAULT_HOOK
+                if hook is not None:
+                    hook.on_prefill(pf.req)
                 logits, self._layers = self.model.prefill_chunk(
                     self._layers, pf.slot, pf.ids[pf.pos:pf.pos + take],
                     pf.pos)
             pf.pos += take
             pf.chunks += 1
-            # a chunk boundary at a block multiple completed a new block;
-            # capture it while the row state IS that exact prefix (the
-            # linear-attention snapshot is only right at this boundary).
-            # The last prompt token is never cached (its logits must be
-            # computed live to seed sampling), hence the n-1 cap.
-            if self.prefix_cache is not None:
-                while (pf.next_block + 1) * self.chunk <= min(pf.pos,
-                                                              pf.n - 1):
-                    self.prefix_cache.insert(self._layers, pf.slot, pf.ids,
-                                             pf.next_block, pf.keys)
-                    pf.next_block += 1
+            pf.next_block = self._capture_blocks(pf.ids, pf.slot, pf.pos,
+                                                 pf.n, pf.next_block,
+                                                 pf.keys)
             if pf.pos >= pf.n:
                 self._complete_prefill(pf, logits)
                 return False
             return True
         except Exception as e:
+            # request-scoped containment first: the admission dies alone
+            # (poison isolation for free — a prompt that crashes its own
+            # prefill never takes the pool with it)...
             self._abort_prefill(pf, e)
+            if classify(e) in ("device", "oom"):
+                # ...but a device/oom failure impeaches the WHOLE pool's
+                # state, not just this row: escalate to the supervisor
+                raise
             return False
         finally:
             set_request_id(None)
@@ -652,11 +854,7 @@ class ServeEngine:
         self._recents = self._recents.at[slot].set(recent.at[-1].set(tid))
         self._toks = self._toks.at[slot].set(tid)
         self._pos = self._pos.at[slot].set(pf.n)
-        self._temps = self._temps.at[slot].set(scfg.temperature)
-        self._top_ks = self._top_ks.at[slot].set(scfg.top_k or self._vocab)
-        self._top_ps = self._top_ps.at[slot].set(
-            scfg.top_p if scfg.top_p is not None else 1.0)
-        self._pens = self._pens.at[slot].set(scfg.repeat_penalty)
+        self._set_slot_sampling(slot, scfg)
         self._act = self._act.at[slot].set(True)
         self._prefills.remove(pf)
         req.budget = min(req.max_new_tokens - 1, self.ctx - pf.n - 1)
@@ -668,21 +866,224 @@ class ServeEngine:
         req.stats["prefix_hit_tokens"] = pf.hit_tokens
         SERVE_PREFILL_CHUNKS.observe(max(pf.chunks, 1))
 
+    def _capture_blocks(self, ids, slot: int, pos: int, n: int,
+                        next_block: int, keys: list) -> int:
+        """Insert every prefix-cache block the chunk that just landed
+        completed — captured at the boundary while the row state IS that
+        exact prefix (the linear-attention snapshot is only right there).
+        The block holding the final token is never cached (its logits
+        must be computed live to seed sampling), hence the n-1 cap.
+        Shared by admission and crash-replay so the boundary rule cannot
+        drift between them. Returns the next uncaptured block index."""
+        if self.prefix_cache is None:
+            return next_block
+        while (next_block + 1) * self.chunk <= min(pos, n - 1):
+            self.prefix_cache.insert(self._layers, slot, ids, next_block,
+                                     keys)
+            next_block += 1
+        return next_block
+
+    def _set_slot_sampling(self, slot: int, scfg: SamplingConfig):
+        """Write a request's sampling params into the slot's traced
+        carries (same disabled-value conventions as sample_traced)."""
+        self._temps = self._temps.at[slot].set(scfg.temperature)
+        self._top_ks = self._top_ks.at[slot].set(scfg.top_k or self._vocab)
+        self._top_ps = self._top_ps.at[slot].set(
+            scfg.top_p if scfg.top_p is not None else 1.0)
+        self._pens = self._pens.at[slot].set(scfg.repeat_penalty)
+
     def _abort_prefill(self, pf: _Prefill, error: BaseException | None,
                        register: bool = True):
         """Tear down a mid-prefill admission (client cancel or device
         failure): release the waiter, free the slot, wipe the half-built
-        row. The wipe comes LAST and is allowed to raise — splice and
-        prefill_chunk assume a clean row, so a failed wipe must kill the
-        engine (the crash handler releases everyone) rather than silently
-        hand ghost KV to the row's next occupant."""
+        row. The wipe comes LAST and must still escalate on failure —
+        splice and prefill_chunk assume a clean row, so a failed wipe
+        cannot silently hand ghost KV to the row's next occupant (the
+        supervisor's rebuild reallocates the pool). But it must not MASK
+        the original error either: the step failure stays the exception
+        being raised, the wipe failure rides its __cause__ — first
+        exception wins, nothing swallowed or substituted."""
         if register:
             self._prefills.remove(pf)
         self._reqs[pf.slot] = None
         self.pool.free(pf.slot)
         SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         self._fail(pf.req, error)
-        self._layers = self.model.slot_release(self._layers, pf.slot)
+        try:
+            self._layers = self.model.slot_release(self._layers, pf.slot)
+        except Exception as wipe_exc:
+            if error is not None:
+                raise error from wipe_exc
+            raise
+
+    # -- crash recovery (called by the supervisor, scheduler thread) --------
+
+    def _rebuild(self, suspects: frozenset = frozenset()):
+        """Rebuild-by-replay after a step failure: trust NOTHING on the
+        device (donated inputs may be consumed, results may be garbage) —
+        reallocate the pool and prefix cache, then reconstruct every live
+        slot from its host-side token record by replaying prompt +
+        generated[:-1] through the chunked-prefill path. Replay lands on
+        the same chunk buckets admission compiled (usually zero new
+        executables), and the fresh prefix cache is repopulated as replay
+        runs, so slots sharing prefixes splice instead of recompute.
+
+        Greedy continuations are bit-identical afterwards: position, last
+        token, and the repeat-penalty window are all derivable from the
+        record (cache rows hold prompt+generated minus the LAST emitted
+        token — its KV is appended by the next decode step, exactly as it
+        would have been without the crash). Requests that had emitted
+        NOTHING yet restart admission from scratch instead.
+
+        Suspects (requests implicated in the triggering crash) replay
+        LAST and one at a time — a poisoned request re-crashes on its own
+        solo replay, which is how the supervisor attributes it."""
+        t0 = now()
+        replays: list[ServeRequest] = []
+        restarts: list[ServeRequest] = []
+        for i, req in enumerate(self._reqs):
+            if req is None:
+                continue
+            if req.cancelled.is_set() or req.done.is_set():
+                self._fail(req, None)       # no row left to wipe: gone
+                continue
+            if req.tokens:
+                replays.append(req)
+            else:
+                req._first_pending = False  # unfetched 1st token is lost
+                restarts.append(req)
+        self._prefills.clear()
+        self.pool = SlotPool(self.slots)
+        self._reqs = [None] * self.slots
+        # release the impeached device state BEFORE reallocating: the
+        # prefix cache's blocks and the old pool rows pin HBM, and an
+        # oom-classified failure would re-OOM every rebuild attempt if
+        # the replacement pool had to coexist with the one it replaces
+        self._layers = None
+        self.prefix_cache = None
+        self.prefix_cache = PrefixCache.build(self.model, self.ctx,
+                                              self.chunk, self._prefix_mb)
+        self._init_device_state()
+        # register EVERY survivor before any device work: if a replay
+        # crashes, the next rebuild's harvest must still see the ones
+        # that hadn't replayed yet
+        replays.sort(key=lambda r: r.id in suspects)    # innocents first
+        jobs = []
+        for req in replays:
+            slot = self.pool.alloc()
+            self._reqs[slot] = req
+            req.slot = slot
+            jobs.append((req, slot))
+        for req in restarts:
+            slot = self.pool.alloc()
+            self._reqs[slot] = req
+            req.slot = slot
+        for req, slot in jobs:
+            self._replay_slot(req, slot)
+            # each completed replay is the CONTRAST that lets a later
+            # replay crash be attributed to its own request's data
+            self.supervisor.note_replay_ok()
+        for req in restarts:
+            self._begin_prefill(_Prefill(req, slot=req.slot))
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        self._wake.set()
+        log.warning("serve engine rebuilt in %.0f ms: %d slot(s) replayed, "
+                    "%d admission(s) restarted, %d queued untouched",
+                    (now() - t0) * 1e3, len(jobs), len(restarts),
+                    self.queue.depth())
+
+    def _replay_slot(self, req: ServeRequest, slot: int):
+        """Replay one surviving request's recorded tokens into a fresh
+        pool row and restore its decode carries bit-exactly (greedy).
+        The rng carry is a fresh fold — unused under temperature 0; for
+        sampled requests the stream is documented as resuming on a new
+        rng after a rebuild."""
+        ids = req.prompt_ids + req.tokens[:-1]
+        n = len(ids)
+        hook = faults.FAULT_HOOK
+        set_request_id(req.id)
+        try:
+            with RECORDER.span("serve.replay", cat="serve", slot=slot,
+                               tokens=n):
+                pos = 0
+                keys: list = []
+                matched = 0
+                if self.prefix_cache is not None:
+                    keys = self.prefix_cache.chain_keys(ids)
+                    matched = self.prefix_cache.match(ids, keys)
+                    if matched:
+                        self._layers = self.prefix_cache.splice(
+                            self._layers, slot, keys, matched)
+                        pos = matched * self.chunk
+                next_block = matched
+                while pos < n:
+                    take = min(self.chunk, n - pos)
+                    # recovery-grace watchdog limit: a replay chunk may
+                    # carry an in-iteration compile for a bucket fresh
+                    # generations never hit
+                    self.supervisor.arm("replay", (req.id,), grace=True)
+                    if hook is not None:
+                        hook.on_prefill(req)
+                    _, self._layers = self.model.prefill_chunk(
+                        self._layers, slot, ids[pos:pos + take], pos)
+                    pos += take
+                    next_block = self._capture_blocks(ids, slot, pos, n,
+                                                      next_block, keys)
+        finally:
+            set_request_id(None)
+        last = req.tokens[-1]
+        recent = np.full((RECENT_N,), -1, np.int32)
+        tail = req.tokens[-RECENT_N:]
+        recent[RECENT_N - len(tail):] = tail
+        rng = jax.random.fold_in(self._base_rng, self._seq)
+        self._seq += 1
+        self._toks = self._toks.at[slot].set(last)
+        self._pos = self._pos.at[slot].set(n)
+        self._rngs = self._rngs.at[slot].set(rng)
+        self._recents = self._recents.at[slot].set(jnp.asarray(recent))
+        self._set_slot_sampling(slot, req.sampling)
+        self._act = self._act.at[slot].set(True)
+
+    def _drop_poisoned(self, rid: str, err: PoisonedRequest) -> bool:
+        """Fail ONE request (attributed poison) with its typed 500 and
+        quarantine its fingerprint; the pool lives on for everyone else.
+        Row state is not wiped — the caller is about to rebuild."""
+        for i, req in enumerate(self._reqs):
+            if req is None or req.id != rid:
+                continue
+            self._reqs[i] = None
+            self.pool.free(i)
+            self._prefills[:] = [p for p in self._prefills
+                                 if p.req.id != rid]
+            self.supervisor.quarantine(req.prompt_ids)
+            SERVE_POISONED.inc()
+            SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+            log.error("poisoned request %s dropped and quarantined: %s",
+                      rid, err)
+            self._fail(req, err)
+            return True
+        return False
+
+    def _fail_all(self, err: EngineDown):
+        """Budget exhausted: every live request is released with the
+        typed down error (503 at the API — never a hang), the pool
+        bookkeeping resets, and the device pool is dropped (the restore
+        trial allocates the replacement)."""
+        self._prefills.clear()
+        for req in self.queue.drain():
+            self._fail(req, err)
+        for i, req in enumerate(self._reqs):
+            if req is not None:
+                self._reqs[i] = None
+                self._fail(req, err)
+        self.pool = SlotPool(self.slots)
+        self._act = jnp.zeros((self.slots,), jnp.bool_)
+        # drop the device pool AND the prefix cache's blocks: an
+        # oom-downed engine must not pin the old HBM while the restore
+        # trial tries to allocate its replacement (_revive rebuilds both)
+        self._layers = None
+        self.prefix_cache = None
+        SERVE_SLOTS_BUSY.set(0)
 
     # -- speculative decode (shallow batch) ---------------------------------
 
@@ -719,6 +1120,10 @@ class ServeEngine:
         try:
             with RECORDER.span("spec.verify", cat="serve", slot=slot,
                                drafts=len(draft), pos=pos):
+                self.supervisor.arm("spec", (req.id,))
+                hook = faults.FAULT_HOOK
+                if hook is not None:
+                    hook.on_decode([req])
                 (packed, self._layers, self._toks, self._pos, self._rngs,
                  self._recents) = self.model.spec_slot(
                     self._layers, self._toks, self._pos, self._rngs,
@@ -817,10 +1222,13 @@ def maybe_engine(model, slots: int | None = None,
     (default 4, 0 disables), CAKE_MAX_QUEUE (default 64), CAKE_SERVE_CTX
     (default 4096, capped by the model's max_cache_len), CAKE_PREFILL_CHUNK
     (default 256 — per-iteration chunked-admission token budget),
-    CAKE_PREFIX_CACHE_MB (default 256, 0 disables shared-prefix KV reuse)
-    and the speculative-decoding knobs CAKE_SPEC / CAKE_SPEC_K /
-    CAKE_SPEC_MAX_BUSY (all read inside ServeEngine; see
-    docs/speculative.md). Distributed / offloaded models return None —
+    CAKE_PREFIX_CACHE_MB (default 256, 0 disables shared-prefix KV reuse),
+    the speculative-decoding knobs CAKE_SPEC / CAKE_SPEC_K /
+    CAKE_SPEC_MAX_BUSY (see docs/speculative.md), and the supervision
+    knobs CAKE_STEP_WATCHDOG_S / CAKE_ENGINE_REBUILDS /
+    CAKE_ENGINE_REBUILD_WINDOW_S / CAKE_ENGINE_RESTORE_S /
+    CAKE_REQUEST_DEADLINE_S (see docs/fault_tolerance.md) — all read
+    inside ServeEngine. Distributed / offloaded models return None —
     the API keeps its locked fallback."""
     from ..models.common.text_model import TextModel
     if not isinstance(model, TextModel):

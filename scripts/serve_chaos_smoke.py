@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Serve-plane chaos smoke: the crash-only engine under concurrent load
+must survive one injected step crash. A deterministic fault plan kills
+decode dispatch 6 while 3 clients stream through the aiohttp API; every
+client must still complete 200 with greedy text bit-identical to an
+uninjected engine, exactly one rebuild must be recorded (non-zero
+cake_serve_engine_rebuilds_total in /metrics), and /health must be back
+to 200 with the engine block clean afterwards. Every phase polls WITH A
+DEADLINE (fixed-sleep assumptions are what made earlier smokes flaky on
+this container's slow single-core CPU). Exits non-zero on any missing
+signal. Run via `make serve-chaos-smoke`.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                    # noqa: E402
+
+from cake_tpu.api import ApiState, create_app              # noqa: E402
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+from cake_tpu.serve import faults                          # noqa: E402
+
+CTX = 128
+CRASH_STEP = 6
+PROMPTS = [f"hello chaos client {i}" for i in range(3)]
+MAX_NEW = 12
+
+
+class SmokeTok:
+    def encode(self, text):
+        return [3 + (sum(w.encode()) % 200) for w in text.split()][:48] or [3]
+
+    def decode(self, ids):
+        return "".join(f"<{i}>" for i in ids)
+
+
+async def _chat(client, content: str):
+    resp = await client.post("/v1/chat/completions", json={
+        "messages": [{"role": "user", "content": content}],
+        "max_tokens": MAX_NEW, "temperature": 0.0})
+    body = await resp.json()
+    return resp.status, body
+
+
+async def main_async() -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=CTX)
+    tok = SmokeTok()
+    out: dict = {}
+
+    # -- reference pass: same engine config, no faults ----------------------
+    engine = ServeEngine(model, slots=4, max_queue=8, ctx_len=CTX)
+    state = ApiState(model=model, tokenizer=tok, model_id="tiny-chaos")
+    state.engine = engine
+    client = TestClient(TestServer(create_app(state)))
+    await client.start_server()
+    try:
+        ref = await asyncio.gather(*[_chat(client, p) for p in PROMPTS])
+        assert all(s == 200 for s, _ in ref), f"reference pass failed: {ref}"
+        out["reference_texts"] = [
+            b["choices"][0]["message"]["content"] for _, b in ref]
+    finally:
+        await client.close()
+        engine.close()
+
+    # -- chaos pass: kill decode dispatch CRASH_STEP mid-generation ---------
+    faults.install(f"raise_on_step={CRASH_STEP};kind=device")
+    try:
+        engine = ServeEngine(model, slots=4, max_queue=8, ctx_len=CTX)
+        state = ApiState(model=model, tokenizer=tok, model_id="tiny-chaos")
+        state.engine = engine
+        client = TestClient(TestServer(create_app(state)))
+        await client.start_server()
+        try:
+            t0 = time.monotonic()
+            res = await asyncio.gather(*[_chat(client, p) for p in PROMPTS])
+            out["chaos_wall_s"] = round(time.monotonic() - t0, 2)
+            assert all(s == 200 for s, _ in res), \
+                f"client failed across the crash: {res}"
+            texts = [b["choices"][0]["message"]["content"] for _, b in res]
+            assert texts == out["reference_texts"], \
+                f"continuation diverged: {texts} vs {out['reference_texts']}"
+            out["bit_identical"] = True
+            assert engine.supervisor.rebuild_count == 1, \
+                f"expected exactly 1 rebuild, saw " \
+                f"{engine.supervisor.rebuild_count}"
+            out["rebuilds"] = engine.supervisor.rebuild_count
+
+            # /metrics carries the recovery counter
+            mresp = await client.get("/metrics")
+            mtext = await mresp.text()
+            m = re.search(
+                r"^cake_serve_engine_rebuilds_total\s+(\d+)", mtext, re.M)
+            assert m and int(m.group(1)) >= 1, \
+                "cake_serve_engine_rebuilds_total missing/zero in /metrics"
+            out["metric_rebuilds"] = int(m.group(1))
+
+            # /health is back to 200 with a clean engine block
+            deadline = time.monotonic() + 30
+            hstatus, hbody = 0, {}
+            while time.monotonic() < deadline:
+                hresp = await client.get("/health")
+                hstatus, hbody = hresp.status, await hresp.json()
+                if hstatus == 200:
+                    break
+                await asyncio.sleep(0.05)
+            assert hstatus == 200, f"/health stuck degraded: {hbody}"
+            eng_block = hbody.get("engine", {})
+            assert eng_block.get("alive") and not eng_block.get("wedged") \
+                and not eng_block.get("down"), eng_block
+            assert eng_block.get("rebuilds") == 1, eng_block
+            out["health"] = 200
+        finally:
+            await client.close()
+            engine.close()
+    finally:
+        faults.clear()
+    return out
+
+
+def main() -> int:
+    out = asyncio.new_event_loop().run_until_complete(main_async())
+    print("serve-chaos-smoke OK:")
+    for k, v in out.items():
+        print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,407 @@
+"""Crash-only serve engine (ISSUE 8): supervised recovery from injected
+step failures. The acceptance pins: a mid-generation step crash with
+concurrent requests costs exactly one rebuild and every request finishes
+bit-identical (greedy) to an uninjected run; a poison request 500s alone
+while the pool survives; rebuild-budget exhaustion degrades honestly
+(typed 503 + /health engine block + restore-loop revival); the watchdog
+flags a stalled step. Wall-clock-sensitive cases are marked `slow` to
+protect the tier-1 870s budget."""
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models import TextModel, tiny_config
+from cake_tpu.ops.sampling import SamplingConfig
+from cake_tpu.serve import (EngineDown, PoisonedRequest,
+                            RequestDeadlineExceeded, ServeEngine)
+from cake_tpu.serve import faults
+from cake_tpu.serve.supervisor import classify, fingerprint
+
+GREEDY = SamplingConfig(temperature=0.0)
+CTX = 256
+
+P_A = [3, 17, 42, 99, 7]
+P_B = [8, 8, 1, 30]
+P_C = [100, 2, 5, 9, 11, 40]
+POISON_TOK = 77
+P_POISON = [8, POISON_TOK, 1, 30]
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    """Fault plans are process-global: never leak one into the next test."""
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# units: no model required
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_parsing():
+    inj = faults.parse_plan("raise_on_step=6;times=2;kind=oom")
+    assert (inj.raise_on_step, inj.times, inj.kind) == (6, 2, "oom")
+    inj = faults.parse_plan("poison_token=77;poison_after_ops=4")
+    assert (inj.poison_token, inj.poison_after_ops) == (77, 4)
+    inj = faults.parse_plan("stall_on_step=3;stall_step_ms=250")
+    assert (inj.stall_on_step, inj.stall_step_ms) == (3, 250.0)
+    with pytest.raises(ValueError):
+        faults.parse_plan("raise_on_step")          # no value
+    with pytest.raises(ValueError):
+        faults.parse_plan("unknown_key=1")
+    with pytest.raises(ValueError):
+        faults.parse_plan("kind=sharks")
+    with pytest.raises(ValueError):
+        faults.parse_plan("raise_on_step=1,raise_on_step=2")  # one clause
+
+
+def test_fault_plan_step_semantics():
+    """raise_on_step counts scheduler DISPATCHES (1-based) and times=K
+    kills K consecutive ones — the counter survives the rebuilds it
+    provokes, which is what makes multi-crash drills deterministic."""
+
+    class _R:
+        prompt_ids = [1, 2]
+        id = "r"
+
+    inj = faults.parse_plan("raise_on_step=2;times=2")
+    inj.on_decode([_R()])                           # op 1: clean
+    for _ in range(2):                              # ops 2, 3: crash
+        with pytest.raises(faults.InjectedFault):
+            inj.on_decode([_R()])
+    inj.on_decode([_R()])                           # op 4: clean again
+    assert inj.ops == 4
+
+
+def test_classify_kinds():
+    assert classify(faults.InjectedFault("x", fault_kind="oom")) == "oom"
+    assert classify(faults.InjectedFault("x", fault_kind="device")) \
+        == "device"
+    assert classify(MemoryError("small")) == "oom"
+    assert classify(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == "oom"
+    assert classify(ValueError("bad shape")) == "internal"
+
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert classify(XlaRuntimeError("device halted")) == "device"
+
+
+def test_typed_errors_and_fingerprint():
+    e = EngineDown("down", retry_after_s=7)
+    assert isinstance(e, RuntimeError) and e.retry_after_s == 7
+    d = RequestDeadlineExceeded(12.5, 10.0)
+    assert "deadline" in str(d) and d.age_s == 12.5
+    assert fingerprint([1, 2, 3]) == fingerprint([1, 2, 3])
+    assert fingerprint([1, 2, 3]) != fingerprint([1, 2, 4])
+
+
+# ---------------------------------------------------------------------------
+# engine-level recovery (tiny CPU model)
+# ---------------------------------------------------------------------------
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                           max_cache_len=CTX)
+    return _MODEL
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+def _ref(model, prompt, n, sampling=GREEDY):
+    toks, _ = model.generate(list(prompt), max_new_tokens=n,
+                             sampling=sampling)
+    return toks
+
+
+def test_step_crash_one_rebuild_bit_identical(model):
+    """THE acceptance pin: an injected mid-generation step crash with 3
+    concurrent requests costs exactly one rebuild-by-replay and every
+    request's greedy output equals the uninjected sequential run
+    token-for-token."""
+    plans = ((P_A, 12), (P_B, 10), (P_C, 9))
+    refs = [_ref(model, p, n) for p, n in plans]
+    faults.install("raise_on_step=6;kind=device")
+    eng = ServeEngine(model, slots=4, max_queue=8, ctx_len=CTX)
+    try:
+        rs = [eng.submit(p, max_new_tokens=n, sampling=GREEDY)
+              for p, n in plans]
+        assert all(r.wait(180) for r in rs)
+        for r, ref in zip(rs, refs):
+            assert "error" not in r.result, r.result.get("error")
+            assert r.result["tokens"] == ref
+        assert eng.supervisor.rebuild_count == 1
+        assert eng.health()["rebuilds"] == 1
+        assert eng.health()["last_failure"]["kind"] == "device"
+        # the pool is fully live afterwards: a fresh request still works
+        r = eng.submit(P_A, max_new_tokens=6, sampling=GREEDY)
+        assert r.wait(120) and r.result["tokens"] == refs[0][:6]
+    finally:
+        faults.clear()
+        eng.close()
+
+
+def test_poison_request_fails_alone_pool_survives(model):
+    """Poison isolation: a request whose tokens crash every dispatch that
+    touches them is attributed via the rebuild's solo replay (suspects
+    last), fails with a typed PoisonedRequest, and is quarantined — the
+    other requests complete bit-identically after at most 2 rebuilds."""
+    ref_a = _ref(model, P_A, 12)
+    ref_c = _ref(model, P_C, 9)
+    # arms after 4 decode dispatches, so the poison request admits
+    # cleanly and corrupts the pool MID-generation
+    faults.install(f"poison_token={POISON_TOK};poison_after_ops=4")
+    eng = ServeEngine(model, slots=4, max_queue=8, ctx_len=CTX)
+    try:
+        r_a = eng.submit(P_A, max_new_tokens=12, sampling=GREEDY)
+        r_p = eng.submit(P_POISON, max_new_tokens=12, sampling=GREEDY)
+        r_c = eng.submit(P_C, max_new_tokens=9, sampling=GREEDY)
+        assert all(r.wait(180) for r in (r_a, r_p, r_c))
+        assert isinstance(r_p.result.get("error"), PoisonedRequest)
+        assert r_a.result["tokens"] == ref_a
+        assert r_c.result["tokens"] == ref_c
+        assert eng.supervisor.rebuild_count <= 2
+        assert eng.health()["quarantined"] == 1
+        # the fingerprint is quarantined: a retry of the same prompt is
+        # refused up front instead of crash-looping the pool again
+        with pytest.raises(PoisonedRequest):
+            eng.submit(P_POISON, max_new_tokens=4, sampling=GREEDY)
+        # ...but other traffic still flows
+        r = eng.submit(P_C, max_new_tokens=5, sampling=GREEDY)
+        assert r.wait(120) and r.result["tokens"] == ref_c[:5]
+    finally:
+        faults.clear()
+        eng.close()
+
+
+@pytest.mark.slow
+def test_budget_exhaustion_down_then_restore(model):
+    """Crash-loop breaker: past CAKE_ENGINE_REBUILDS the engine goes
+    honestly DOWN — live requests released with the typed EngineDown,
+    submits refused with a Retry-After hint, /health carries the engine
+    failure block — and the restore loop revives it once a trial step
+    succeeds (the injected fault plan is exhausted by then)."""
+    ref = _ref(model, P_A, 12)
+    faults.install("raise_on_step=3;times=2;kind=device")
+    eng = ServeEngine(model, slots=2, max_queue=4, ctx_len=CTX,
+                      rebuild_budget=1, restore_interval_s=0.05)
+    try:
+        r = eng.submit(P_A, max_new_tokens=24, sampling=GREEDY)
+        assert r.wait(180)
+        assert isinstance(r.result.get("error"), EngineDown)
+        assert eng.supervisor.is_down()
+        info = eng.supervisor.down_info()
+        assert "down_for_s" in info and "last_failure" in info
+        with pytest.raises(EngineDown) as ei:
+            eng.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+        assert ei.value.retry_after_s >= 1
+        # revival: the restore loop's trial step succeeds (plan spent)
+        deadline = time.monotonic() + 60
+        while eng.supervisor.is_down() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not eng.supervisor.is_down(), "restore loop never revived"
+        r2 = eng.submit(P_A, max_new_tokens=12, sampling=GREEDY)
+        assert r2.wait(120)
+        assert r2.result["tokens"] == ref
+    finally:
+        faults.clear()
+        eng.close()
+
+
+@pytest.mark.slow
+def test_watchdog_flags_stalled_step(model):
+    """Wedge watchdog: a dispatch stalled past CAKE_STEP_WATCHDOG_S flags
+    the engine wedged (visible in health while stuck, counted in
+    cake_serve_engine_wedges_total) WITHOUT killing it — when the stall
+    releases, the request completes bit-identically and the flag clears
+    (the gray-failure contract). The engine is warmed first so the only
+    long dispatch is the injected stall, not a first-bucket compile."""
+    from cake_tpu.obs import SERVE_ENGINE_WEDGES
+    ref = _ref(model, P_A, 15)
+    eng = ServeEngine(model, slots=2, max_queue=4, ctx_len=CTX,
+                      step_watchdog_s=0.25)
+    try:
+        warm = eng.submit(P_A, max_new_tokens=15, sampling=GREEDY)
+        assert warm.wait(120) and warm.result["tokens"] == ref
+        w0 = SERVE_ENGINE_WEDGES.value()
+        faults.install("stall_on_step=3;stall_step_ms=1500")
+        r = eng.submit(P_A, max_new_tokens=15, sampling=GREEDY)
+        saw_wedge = False
+        deadline = time.monotonic() + 60
+        while not r.done.is_set() and time.monotonic() < deadline:
+            saw_wedge = saw_wedge or eng.health()["wedged"]
+            time.sleep(0.01)
+        assert saw_wedge, "watchdog never flagged the stalled dispatch"
+        assert SERVE_ENGINE_WEDGES.value() > w0
+        assert r.wait(60)
+        assert r.result["tokens"] == ref        # slow, not wrong
+        assert not eng.health()["wedged"]       # flag cleared on return
+        assert eng.supervisor.rebuild_count == 0
+    finally:
+        faults.clear()
+        eng.close()
+
+
+@pytest.mark.slow
+def test_request_deadline_cancels_admitted_slot(model):
+    """CAKE_REQUEST_DEADLINE_S: an ADMITTED request whose total age
+    passes the deadline is cancelled with the typed 504 error and
+    counted — the queue-deadline sweep alone never covers decoding."""
+    from cake_tpu.obs import SERVE_REQUEST_TIMEOUTS
+    c0 = SERVE_REQUEST_TIMEOUTS.value()
+    # warm the (B=4 pool, nb=1) decode executable on a deadline-free
+    # engine first: an in-iteration XLA compile (~10s cold on this box)
+    # would otherwise eat the whole deadline and cancel BOTH requests
+    warm_eng = ServeEngine(model, slots=4, max_queue=4, ctx_len=CTX)
+    try:
+        w = warm_eng.submit(P_B, max_new_tokens=3, sampling=GREEDY)
+        assert w.wait(180)
+    finally:
+        warm_eng.close()
+    # delay_ms paces decode deterministically: 220 tokens can never beat
+    # a 1.5s deadline at 50ms/iteration, while the 3-token follow-up
+    # finishes in a couple hundred ms regardless of machine load
+    faults.install("delay_ms=50")
+    eng = ServeEngine(model, slots=4, max_queue=4, ctx_len=CTX,
+                      request_deadline_s=1.5)
+    try:
+        r = eng.submit(P_A, max_new_tokens=220, sampling=GREEDY)
+        assert r.wait(120)
+        err = r.result.get("error")
+        assert isinstance(err, RequestDeadlineExceeded), err
+        assert len(r.tokens) < 220              # budget was NOT decoded out
+        assert SERVE_REQUEST_TIMEOUTS.value() > c0
+        # the slot is reusable immediately
+        r2 = eng.submit(P_B, max_new_tokens=3, sampling=GREEDY)
+        assert r2.wait(120)
+        assert "error" not in r2.result, r2.result.get("error")
+        assert r2.result["tokens"] == _ref(model, P_B, 3)
+    finally:
+        faults.clear()
+        eng.close()
+
+
+def test_abort_prefill_wipe_failure_chains_not_masks(model, monkeypatch):
+    """Satellite: a wipe failure during prefill crash handling must not
+    substitute the original error — the step failure stays primary with
+    the wipe failure chained as __cause__, and the supervisor still
+    recovers the engine."""
+    orig = RuntimeError("original prefill boom")
+
+    def bad_prefill(layers, slot, ids, pos0):
+        raise orig
+
+    real_release = model.slot_release
+    calls = {"n": 0}
+
+    def bad_release(layers, slot):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("wipe boom")
+        return real_release(layers, slot)
+
+    monkeypatch.setattr(model, "prefill_chunk", bad_prefill)
+    monkeypatch.setattr(model, "slot_release", bad_release)
+    eng = ServeEngine(model, slots=2, max_queue=4, ctx_len=CTX)
+    try:
+        r = eng.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+        assert r.wait(120)
+        err = r.result.get("error")
+        assert err is orig                      # first exception wins
+        # the request is released BEFORE the supervisor runs (its waiter
+        # must never block on recovery), so poll for the rebuild
+        deadline = time.monotonic() + 30
+        while eng.supervisor.rebuild_count < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # the supervisor rebuilt past the poisoned pool state
+        assert eng.supervisor.rebuild_count >= 1
+        lf = eng.health()["last_failure"]
+        assert "original prefill boom" in lf["error"]
+        monkeypatch.undo()
+        r2 = eng.submit(P_B, max_new_tokens=3, sampling=GREEDY)
+        assert r2.wait(120)
+        assert r2.result["tokens"] == _ref(model, P_B, 3)
+    finally:
+        eng.close()
+
+
+def test_dead_engine_submit_is_typed(model):
+    """Satellite: submit on a dead/closed engine raises the typed
+    EngineDown (503 + Retry-After at the API), not a bare RuntimeError."""
+    eng = ServeEngine(model, slots=1, max_queue=2, ctx_len=CTX)
+    eng.close()
+    with pytest.raises(EngineDown) as ei:
+        eng.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+    assert ei.value.retry_after_s >= 1
+
+
+# ---------------------------------------------------------------------------
+# API mapping
+# ---------------------------------------------------------------------------
+
+
+def test_typed_error_response_mapping():
+    """Every typed engine failure answers its documented status on BOTH
+    chat paths (the SSE path refuses via the same helper before
+    committing to a 200)."""
+    from cake_tpu.api.text import _typed_error_response
+    from cake_tpu.serve import QueueDeadlineExceeded
+
+    r = _typed_error_response(EngineDown("down", retry_after_s=9))
+    assert r.status == 503 and r.headers["Retry-After"] == "9"
+    r = _typed_error_response(QueueDeadlineExceeded(3.0))
+    assert r.status == 503 and "Retry-After" in r.headers
+    assert _typed_error_response(
+        RequestDeadlineExceeded(5.0, 4.0)).status == 504
+    assert _typed_error_response(PoisonedRequest("poisoned")).status == 500
+    assert _typed_error_response(ValueError("nope")) is None
+
+
+def test_api_down_engine_503_json_and_sse(model):
+    """A down engine answers 503 + Retry-After on the JSON path AND the
+    streaming path — the stream must refuse BEFORE committing to a 200
+    SSE response (same bug class PR 4 fixed for cluster degradation)."""
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from cake_tpu.api import ApiState, create_app
+
+    class TinyTok:
+        def encode(self, text):
+            return [3 + (sum(w.encode()) % 200)
+                    for w in text.split()][:24] or [3]
+
+        def decode(self, ids):
+            return "".join(f"<{i}>" for i in ids)
+
+    eng = ServeEngine(model, slots=1, max_queue=2, ctx_len=CTX)
+    eng.close()                                 # dead => typed EngineDown
+    st = ApiState(model=model, tokenizer=TinyTok(), model_id="tiny")
+    st.engine = eng
+
+    async def scenario():
+        app = create_app(st)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for stream in (False, True):
+                resp = await client.post("/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4, "stream": stream})
+                assert resp.status == 503, await resp.text()
+                assert "Retry-After" in resp.headers
+                assert resp.content_type == "application/json"  # no SSE
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

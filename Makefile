@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench chaos-smoke serve-chaos-smoke spec-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench serve-bench-longtail paged-smoke chaos-smoke serve-chaos-smoke spec-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -64,6 +64,19 @@ serve-chaos-smoke: lint
 # admitted chunk-by-chunk). Writes BENCH_SERVE_<tag>.json.
 serve-bench:
 	JAX_PLATFORMS=cpu python scripts/serve_bench.py
+
+# paged-KV long-tail bench: mixed short/long contexts through the paged
+# pool sized to the OLD 4-row pool's bytes — records peak concurrent
+# streams (> 4 = the paging win) + preemption/swap counts
+serve-bench-longtail:
+	JAX_PLATFORMS=cpu python scripts/serve_bench.py --long-tail --tag longtail
+
+# paged-KV gate: paged greedy bit-identical to the sequential path,
+# prefix hit = refcount bump (shared-blocks gauge > 0, no KV copy),
+# preempt-by-swap under an undersized pool with bit-identical
+# continuation, kv-block gauges + preemption counter in /metrics
+paged-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/paged_smoke.py
 
 # speculative-decoding gate: serve engine + n-gram drafter on the tiny
 # CPU model — greedy output bit-identical to a spec-off engine, >= 1

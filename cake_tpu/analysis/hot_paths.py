@@ -17,6 +17,12 @@ HOT_PATHS = frozenset({
     "cake_tpu/serve/admission.py",
     "cake_tpu/serve/slots.py",
     "cake_tpu/serve/prefix_cache.py",
+    # paged KV: the allocator + table remaps run per scheduler iteration,
+    # swap/preempt sit on the exhaustion path of the same loop
+    "cake_tpu/serve/paged/__init__.py",
+    "cake_tpu/serve/paged/allocator.py",
+    "cake_tpu/serve/paged/pool.py",
+    "cake_tpu/serve/paged/preempt.py",
     # crash-only supervision: arm/disarm + failure handling run per
     # dispatch / per recovery, and the fault hook sits on the dispatch
     # path itself

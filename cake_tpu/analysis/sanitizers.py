@@ -47,9 +47,9 @@ def decode_fns(model) -> dict[str, object]:
     steady-state serve iterations for `model` (a TextModel or anything
     publishing the same _build() attributes)."""
     out = {}
-    for name in ("_decode_slots", "_decode_step", "_decode_chunk",
-                 "_decode_until", "_prefill_slot", "_spec_slot",
-                 "_sample_traced"):
+    for name in ("_decode_slots", "_decode_slots_paged", "_decode_step",
+                 "_decode_chunk", "_decode_until", "_prefill_slot",
+                 "_prefill_slot_paged", "_spec_slot", "_sample_traced"):
         fn = getattr(model, name, None)
         if fn is not None and hasattr(fn, "_cache_size"):
             out[name] = fn

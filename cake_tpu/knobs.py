@@ -109,6 +109,20 @@ _knob("CAKE_ENGINE_REBUILD_WINDOW_S", float, 300.0, "serve",
 _knob("CAKE_ENGINE_RESTORE_S", float, 5.0, "serve",
       "DOWN-state probe interval: a trial prefill runs this often until "
       "one succeeds, then the pool is rebuilt and admission reopens")
+_knob("CAKE_KV_BLOCKS", int, 0, "serve",
+      "paged-KV pool size in physical blocks; > 0 replaces the "
+      "contiguous slots x ctx rows with a shared block pool behind "
+      "per-slot block tables (refcounted prefix sharing + preemption); "
+      "0 keeps the contiguous pool")
+_knob("CAKE_KV_BLOCK_TOKENS", int, 16, "serve",
+      "tokens per paged-KV block (clamped to a power of two in "
+      "[8, CAKE_PREFILL_CHUNK] so chunk boundaries stay block-aligned); "
+      "pool HBM = blocks x block-tokens of KV")
+_knob("CAKE_PREEMPT_MODE", str, "swap", "serve",
+      'paged-pool exhaustion policy: "swap" parks the victim\'s blocks '
+      'in host RAM (bit-identical resume, even sampled); "recompute" '
+      "drops them and replays prompt+generated at resume (greedy "
+      "bit-identical)")
 _knob("CAKE_SERVE_FAULT_PLAN", str, None, "serve",
       'deterministic serve-engine fault injection (tests/drills only), '
       'e.g. "raise_on_step=6;kind=device" — see serve/faults.py')

@@ -346,6 +346,121 @@ def truncate_cache(cfg: ModelConfig, cache: dict, new_end: int,
             "pos": jnp.minimum(cache["pos"], new_end)}
 
 
+# -- paged KV: block-pool storage behind a per-slot indirection table -------
+#
+# The slot pool above provisions every row for the worst-case context
+# (B x ctx of KV per layer). Paged mode (vLLM/PagedAttention) splits
+# full-attention KV into fixed BLOCK_TOKENS-sized physical blocks in one
+# shared pool per layer; a slot owns only the blocks its sequence has
+# actually reached, addressed through a [max_blocks] block TABLE whose
+# entry j maps logical positions [j*bt, (j+1)*bt) to a physical block id
+# (the sentinel id == num_blocks means unmapped). Only full-attention
+# layers page: a sliding-window ring is already O(window) per slot and a
+# linear-attention state is O(1), so both stay per-slot "row" state —
+# paging them would add indirection without saving a byte.
+#
+# The gather below materializes a slot's logical row from the pool with
+# EXACTLY the contiguous row's shape and layout (entry for position p at
+# row index p % L): the forward over a paged view is the same computation
+# on the same bytes, which is what makes paged decode bit-identical to
+# the contiguous path and lets forward_layers run unchanged.
+
+
+def init_paged_layers(cfg: ModelConfig, num_blocks: int, block_tokens: int,
+                      batch: int, ctx: int, dtype=jnp.bfloat16,
+                      layer_range: tuple[int, int] | None = None
+                      ) -> tuple[list[dict], list[dict]]:
+    """(pool_layers, row_layers) for a paged slot pool.
+
+    pool_layers[i] holds the physical block pool for full-attention layer
+    i ({k,v: [num_blocks, block_tokens, H, D], pos: [num_blocks,
+    block_tokens]}) and an EMPTY dict elsewhere; row_layers[i] holds the
+    per-slot state for sliding-window rings and linear-attention layers
+    (leading batch axis) and an empty dict at pooled positions. Empty
+    dicts keep both lists layer-aligned pytrees with zero leaves at the
+    other list's positions, so they vmap/donate cleanly side by side.
+    """
+    lo, hi = layer_range or (0, cfg.num_hidden_layers)
+    pool, rows = [], []
+    for i in range(lo, hi):
+        spec = cfg.layer_spec(i)
+        if spec.kind == "linear" or spec.window is not None:
+            pool.append({})
+            rows.append(init_layer_cache(cfg, spec, batch, ctx, dtype))
+        else:
+            pool.append({
+                "k": jnp.zeros((num_blocks, block_tokens,
+                                cfg.num_key_value_heads, cfg.head_dim),
+                               dtype),
+                "v": jnp.zeros((num_blocks, block_tokens,
+                                cfg.num_key_value_heads, cfg.head_dim),
+                               dtype),
+                "pos": jnp.full((num_blocks, block_tokens), -1, jnp.int32),
+            })
+            rows.append({})
+    return pool, rows
+
+
+def paged_gather_layer(pl: dict, table_row, frontier) -> dict:
+    """Materialize one slot's logical KV row from a layer's block pool
+    through its block table (`table_row`: [M] physical ids; id ==
+    num_blocks = unmapped). Returns {k, v, pos} WITHOUT a batch axis
+    (leaves [M*bt, ...]) — callers add [None] to feed forward_layers.
+
+    Stale-tenant guard: a freed block is never wiped on the device, so
+    a gathered entry is real iff BOTH hold:
+
+      * it lands in its table entry's own logical range
+        (pos // bt == table index j) — a recycled block still carrying
+        a previous tenant's positions from a DIFFERENT range is masked;
+      * pos < `frontier`, the slot's write frontier (prefill: pos0;
+        decode: the step's write position). The row's contract is
+        "holds exactly positions 0 .. frontier-1" — precisely what a
+        wiped contiguous row guarantees — which kills the same-index
+        recycling case: a stale entry claiming a position the sequence
+        has not reached yet would otherwise be VISIBLE to the
+        [cache ; in-pass chunk] prefill concat as a duplicate key.
+
+    Masked entries get pos = -1; attention weights for pos == -1 are
+    exactly zero, so the masking is bit-exact. The k/v garbage under a
+    masked pos is finite bytes, never read into the output."""
+    nblocks, bt = pl["pos"].shape
+    mapped = table_row < nblocks                           # [M]
+    safe = jnp.where(mapped, table_row, 0)
+    k = pl["k"][safe].reshape((-1,) + pl["k"].shape[2:])
+    v = pl["v"][safe].reshape((-1,) + pl["v"].shape[2:])
+    pos = pl["pos"][safe]                                  # [M, bt]
+    blk = jnp.arange(table_row.shape[0], dtype=jnp.int32)[:, None]
+    own = jnp.logical_and(mapped[:, None], pos // bt == blk)
+    own = jnp.logical_and(own, pos < frontier)
+    pos = jnp.where(own, pos, -1).reshape(-1)
+    return {"k": k, "v": v, "pos": pos}
+
+
+def paged_block_of(view_lc: dict, wb, bt: int) -> dict:
+    """Slice block `wb` (traced table index) out of a gathered/updated
+    row view — the write-back unit after a forward advanced the view.
+    Returns {k: [bt, H, D], v: [bt, H, D], pos: [bt]}."""
+    start = wb * bt
+    return {
+        "k": jax.lax.dynamic_slice_in_dim(view_lc["k"], start, bt, axis=0),
+        "v": jax.lax.dynamic_slice_in_dim(view_lc["v"], start, bt, axis=0),
+        "pos": jax.lax.dynamic_slice_in_dim(view_lc["pos"], start, bt,
+                                            axis=0),
+    }
+
+
+def paged_scatter_blocks(pl: dict, pids, blk: dict) -> dict:
+    """Write block contents back into a layer's pool at physical ids
+    `pids` ([n] int32, leaves [n, bt, ...]). Entries with pid ==
+    num_blocks are DROPPED (the masked-slot / beyond-frontier guard);
+    live pids are exclusively owned by their writer (refcounted blocks
+    are forked before any write), so the scatter is injective."""
+    return {"k": pl["k"].at[pids].set(blk["k"], mode="drop"),
+            "v": pl["v"].at[pids].set(blk["v"], mode="drop"),
+            "pos": pl["pos"].at[pids].set(blk["pos"], mode="drop")}
+
+
 def cache_reset(cache: dict) -> dict:
     """Clear all state (ref: cache clear on Goodbye, worker.rs:364-384)."""
     def zero_layer(lc):

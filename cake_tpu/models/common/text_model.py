@@ -39,10 +39,11 @@ from ...obs import (DECODE_TOKEN_SECONDS, GENERATED_TOKENS, RECORDER,
                     TTFT_SECONDS, now)
 from ...ops.sampling import (SamplingConfig, push_recent_token, sample,
                              sample_traced, spec_accept)
-from .cache import (grow_cache, init_cache, kv_capacity, slot_assign_layers,
-                    slot_extract_block_layers, slot_reset_layers,
-                    slot_splice_block_layers, slot_truncate_layers,
-                    truncate_layers)
+from .cache import (grow_cache, init_cache, kv_capacity, paged_block_of,
+                    paged_gather_layer, paged_scatter_blocks,
+                    slot_assign_layers, slot_extract_block_layers,
+                    slot_reset_layers, slot_splice_block_layers,
+                    slot_truncate_layers, truncate_layers)
 from .config import ModelConfig
 from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
 
@@ -501,6 +502,154 @@ class TextModel:
             return slot_splice_block_layers(cfg, layers, src_layers, slot,
                                             final)
 
+        # -- paged KV: decode/prefill through a block table ----------------
+        # Full-attention KV lives in a shared physical block pool
+        # ([num_blocks, block_tokens, ...] per layer); a slot addresses its
+        # logical row through a TRACED [B, max_blocks] block table, so the
+        # host-side allocator can remap/extend tables every iteration
+        # without compiling anything new — `nb` stays the only static
+        # argument, exactly like the contiguous _decode_slots. SWA rings
+        # and linear-attention state stay per-slot rows (`rows` pytree);
+        # the gathered view reproduces the contiguous row's layout
+        # byte-for-byte, so paged greedy decode is bit-identical to the
+        # contiguous path (pinned in tests/test_paged.py).
+
+        def _paged_row_cache(pool, rows_slot, table_row, p):
+            """Batch-1 cache for one slot: pooled layers gathered through
+            the table (masked to the row's frontier `p` — the write
+            position, so the view holds exactly positions 0..p-1), row
+            layers taken as-is (already the slot's rows)."""
+            lcs = [paged_gather_layer(pl, table_row, p) if pl else rl
+                   for pl, rl in zip(pool, rows_slot)]
+            return {"layers": jax.tree_util.tree_map(
+                lambda a: a[None], lcs), "pos": p}
+
+        @functools.partial(jax.jit, static_argnames=("nb",),
+                           donate_argnums=(1, 2, 4, 5, 6, 7))
+        def _decode_slots_paged(params, pool, rows, tables, toks, pos, rngs,
+                                recents, temps, top_ks, top_ps, penalties,
+                                active, nb):
+            """_decode_slots over a paged pool: per slot, gather the
+            logical row view, run the same embed -> layers -> head ->
+            sample step, then write back ONLY the block the step's KV
+            landed in (position p lives in table entry p // bt). Inactive
+            rows ride along with the write dropped (pid -> sentinel), so
+            their pool bytes stay untouched just like the contiguous
+            active-mask contract."""
+            bt = next(pl["pos"].shape[1] for pl in pool if pl)
+            nblocks = next(pl["pos"].shape[0] for pl in pool if pl)
+
+            def one(table_row, rows_slot, tok, p, rng, recent, temp, tk,
+                    tp, pen, act):
+                cache = _paged_row_cache(pool, rows_slot, table_row, p)
+                x = embed_tokens(cfg, params, tok[None, None])
+                x, cache = forward_layers(cfg, params, x, cache, p,
+                                          valid_len=act.astype(jnp.int32))
+                logits = lm_head_logits(cfg, params, x)[0, -1]
+                rng2, sk = jax.random.split(rng)
+                nxt = sample_traced(logits, sk, temp, tk, tp, pen, recent)
+                nxt = jnp.where(act, nxt, tok)
+                new_lcs = jax.tree_util.tree_map(lambda a: a[0],
+                                                 cache["layers"])
+                wb = jnp.clip(p // bt, 0, table_row.shape[0] - 1)
+                blks = [paged_block_of(lc, wb, bt) if pl else {}
+                        for pl, lc in zip(pool, new_lcs)]
+                new_rows = [{} if pl else lc
+                            for pl, lc in zip(pool, new_lcs)]
+                return (nxt, blks, new_rows, wb,
+                        jnp.where(act, rng2, rng),
+                        jnp.where(act, push_recent_token(recent, nxt),
+                                  recent))
+
+            step = active[:nb].astype(jnp.int32)
+            rows_nb = jax.tree_util.tree_map(lambda a: a[:nb], rows)
+            nxt, blks, new_rows, wbs, new_rngs, new_recents = jax.vmap(one)(
+                tables[:nb], rows_nb, toks[:nb], pos[:nb], rngs[:nb],
+                recents[:nb], temps[:nb], top_ks[:nb], top_ps[:nb],
+                penalties[:nb], active[:nb])
+            pids = jnp.take_along_axis(tables[:nb], wbs[:, None],
+                                       axis=1)[:, 0]
+            pids = jnp.where(active[:nb], pids, nblocks)   # inactive: drop
+            pool = [paged_scatter_blocks(pl, pids, blk) if pl else pl
+                    for pl, blk in zip(pool, blks)]
+            rows = jax.tree_util.tree_map(
+                lambda full, s: full.at[:nb].set(s), rows, new_rows)
+            return (jnp.stack([toks[:nb], nxt]), pool, rows,
+                    toks.at[:nb].set(nxt), pos.at[:nb].add(step),
+                    rngs.at[:nb].set(new_rngs),
+                    recents.at[:nb].set(new_recents))
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3),
+                           static_argnames=("flash_mode",))
+        def _prefill_slot_paged(params, tokens, pool, rows, tables, slot,
+                                pos0, valid_len, flash_mode):
+            """_prefill_slot over a paged pool: gather the slot's view,
+            run the chunk forward, write back the blocks the chunk
+            touched (a STATIC window of tokens.shape[1]//bt + 1 table
+            entries, masked down to the traced [pos0 // bt, last written
+            block] range), and update the slot's SWA/linear rows."""
+            bt = next(pl["pos"].shape[1] for pl in pool if pl)
+            nblocks = next(pl["pos"].shape[0] for pl in pool if pl)
+            table_row = tables[slot]
+            m = table_row.shape[0]
+            rows_slot = [jax.tree_util.tree_map(lambda a: a[slot], rl)
+                         for rl in rows]
+            cache = _paged_row_cache(pool, rows_slot, table_row, pos0)
+            x = embed_tokens(cfg, params, tokens)
+            x, rcache = forward_layers(cfg, params, x, cache, pos0,
+                                       valid_len=valid_len,
+                                       flash_mode=flash_mode, mesh=mesh)
+            idx = jnp.clip(valid_len - 1, 0, x.shape[1] - 1)
+            x_last = jax.lax.dynamic_slice_in_dim(x, idx, 1, axis=1)
+            logits = lm_head_logits(cfg, params, x_last)[:, 0]
+            # write-back window: blocks b0..last_b changed; the window is
+            # sized statically by the chunk bucket and slid (never
+            # clamped mid-block) so block alignment survives at the pool
+            # tail, with out-of-range entries masked to the drop sentinel
+            nwb = min(tokens.shape[1] // bt + 1, m)
+            b0 = pos0 // bt
+            last_b = (pos0 + jnp.maximum(valid_len, 1) - 1) // bt
+            shift = jnp.clip(b0, 0, m - nwb)
+            bidx = shift + jnp.arange(nwb, dtype=jnp.int32)
+            touched = jnp.logical_and(bidx >= b0, bidx <= last_b)
+            pids = jnp.where(touched, table_row[bidx], nblocks)
+            new_pool = []
+            new_rows = []
+            for pl, rl, nl in zip(pool, rows, rcache["layers"]):
+                if not pl:
+                    new_pool.append(pl)
+                    new_rows.append(jax.tree_util.tree_map(
+                        lambda full, r: full.at[slot].set(r[0]), rl, nl))
+                    continue
+                view = jax.tree_util.tree_map(lambda a: a[0], nl)
+                blk = {
+                    name: jax.lax.dynamic_slice_in_dim(
+                        view[name], shift * bt, nwb * bt, axis=0
+                    ).reshape((nwb, bt) + view[name].shape[1:])
+                    for name in ("k", "v", "pos")}
+                new_pool.append(paged_scatter_blocks(pl, pids, blk))
+                new_rows.append(rl)
+            return logits, new_pool, new_rows
+
+        @jax.jit
+        def _paged_row_snapshot(rows, slot):
+            """Batch-1 copy of one slot's UNPOOLED state (SWA rings +
+            linear conv/recurrent) — the boundary-exact snapshot the
+            paged prefix cache stores per share unit (pooled layers
+            share by block id instead and contribute no leaves here)."""
+            return [jax.tree_util.tree_map(lambda a: a[slot][None], rl)
+                    for rl in rows]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _paged_row_install(rows, snap, slot):
+            return [jax.tree_util.tree_map(
+                lambda full, s: full.at[slot].set(s[0]), rl, sn)
+                for rl, sn in zip(rows, snap)]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _paged_row_reset(rows, slot):
+            return slot_reset_layers(rows, slot)
+
         self._prefill = _prefill
         self._spec_verify = _spec_verify
         self._spec_slot = _spec_slot
@@ -510,6 +659,11 @@ class TextModel:
         self._prefill_slot = _prefill_slot
         self._slot_extract = _slot_extract
         self._slot_splice = _slot_splice
+        self._decode_slots_paged = _decode_slots_paged
+        self._prefill_slot_paged = _prefill_slot_paged
+        self._paged_row_snapshot = _paged_row_snapshot
+        self._paged_row_install = _paged_row_install
+        self._paged_row_reset = _paged_row_reset
         self._sample_traced = jax.jit(sample_traced)
         self._decode_chunk = _decode_chunk
         self._decode_until = _decode_until
@@ -610,6 +764,63 @@ class TextModel:
         first-token sample off the prefill logits)."""
         return self._sample_traced(logits, rng, temp, top_k, top_p, penalty,
                                    recent)
+
+    # -- paged-KV slot programs (serve engine, CAKE_KV_BLOCKS > 0) ----------
+
+    def decode_slots_paged(self, pool, rows, tables, toks, pos, rngs,
+                           recents, temps, top_ks, top_ps, penalties,
+                           active, nb: int):
+        """decode_slots over a paged pool: same carries and contract, but
+        full-attention KV is read/written through `tables` ([B,
+        max_blocks] int32 device array of physical block ids; entry ==
+        num_blocks is unmapped). `pool`/`rows` come from
+        cache.init_paged_layers and are donated; `tables` is NOT donated
+        (the engine remaps entries between iterations and keeps its
+        handle, like `active`). Returns (packed_ids [2, nb], pool, rows,
+        toks, pos, rngs, recents)."""
+        return self._decode_slots_paged(self.params, pool, rows, tables,
+                                        toks, pos, rngs, recents, temps,
+                                        top_ks, top_ps, penalties, active,
+                                        nb=nb)
+
+    def prefill_chunk_paged(self, pool, rows, tables, slot: int, token_ids,
+                            pos0: int, ctx: int):
+        """prefill_chunk over a paged pool: the chunk's KV scatters into
+        the physical blocks `tables[slot]` maps for positions pos0..
+        pos0+n-1 (the caller must have allocated them). `ctx` is the
+        slot's logical row length (max_blocks * block_tokens) — the
+        paged stand-in for the contiguous pool's buffer capacity.
+        Returns (logits [1, V] at the last valid position, pool, rows)."""
+        ids = np.asarray(list(token_ids), np.int32).ravel()
+        n = int(ids.shape[0])
+        bkt = check_prefill_bounds(n, pos0, ctx, self.max_cache_len)
+        padded = np.zeros((1, bkt), np.int32)
+        padded[0, :n] = ids
+        flash_mode = select_flash_mode(pos0, bkt, ctx)
+        return self._prefill_slot_paged(self.params, jnp.asarray(padded),
+                                        pool, rows, tables,
+                                        jnp.asarray(slot, jnp.int32),
+                                        jnp.asarray(pos0, jnp.int32),
+                                        jnp.asarray(n, jnp.int32),
+                                        flash_mode=flash_mode)
+
+    def row_snapshot(self, rows, slot: int):
+        """Batch-1 copy of slot `slot`'s unpooled state (SWA rings +
+        linear conv/recurrent) — the paged prefix cache's boundary-exact
+        share-unit snapshot (pooled layers share by block id instead)."""
+        return self._paged_row_snapshot(rows, jnp.asarray(slot, jnp.int32))
+
+    def row_install(self, rows, snap, slot: int):
+        """Install a row_snapshot into slot `slot` (rows donated) — the
+        final-block step of a paged prefix-cache hit."""
+        return self._paged_row_install(rows, snap,
+                                       jnp.asarray(slot, jnp.int32))
+
+    def row_reset(self, rows, slot: int):
+        """Clear slot `slot`'s unpooled state (rows donated) — the paged
+        release/preempt wipe; pooled blocks need no wipe (the gather's
+        stale-tenant pos guard makes freed blocks invisible)."""
+        return self._paged_row_reset(rows, jnp.asarray(slot, jnp.int32))
 
     # -- speculative decoding ------------------------------------------------
 

@@ -153,6 +153,26 @@ SERVE_REQUEST_TIMEOUTS = REGISTRY.counter(
     "Admitted requests cancelled because their total age passed "
     "CAKE_REQUEST_DEADLINE_S (answered 504)")
 
+SERVE_KV_BLOCKS_FREE = REGISTRY.gauge(
+    "cake_serve_kv_blocks_free",
+    "Unallocated physical blocks in the paged KV pool "
+    "(CAKE_KV_BLOCKS > 0)")
+
+SERVE_KV_BLOCKS_USED = REGISTRY.gauge(
+    "cake_serve_kv_blocks_used",
+    "Allocated physical blocks in the paged KV pool (live slots + "
+    "prefix-cache pins)")
+
+SERVE_KV_BLOCKS_SHARED = REGISTRY.gauge(
+    "cake_serve_kv_blocks_shared",
+    "Paged KV blocks with refcount >= 2 — prefix-cache hits share these "
+    "by reference instead of copying")
+
+SERVE_PREEMPTIONS = REGISTRY.counter(
+    "cake_serve_preemptions_total",
+    "Slots evicted because the paged KV pool was exhausted",
+    labelnames=("mode",))           # swap | recompute
+
 CLUSTER_STAGE_FAILURES = REGISTRY.counter(
     "cake_cluster_stage_failures_total",
     "Classified remote-hop failures observed by the master",
@@ -200,7 +220,8 @@ __all__ = [
     "SERVE_PREFIX_MISSES", "SERVE_PREFIX_EVICTIONS", "SERVE_PREFIX_BYTES",
     "SERVE_QUEUE_TIMEOUTS", "SERVE_STEP_FAILURES", "SERVE_ENGINE_REBUILDS",
     "SERVE_ENGINE_WEDGES", "SERVE_ENGINE_DOWN", "SERVE_POISONED",
-    "SERVE_REQUEST_TIMEOUTS",
+    "SERVE_REQUEST_TIMEOUTS", "SERVE_KV_BLOCKS_FREE",
+    "SERVE_KV_BLOCKS_USED", "SERVE_KV_BLOCKS_SHARED", "SERVE_PREEMPTIONS",
     "CLUSTER_STAGE_FAILURES", "CLUSTER_RECONNECTS",
     "CLUSTER_REPLAYS", "CLUSTER_DEGRADED", "CLUSTER_HOP_DEGRADED",
     "SPEC_PROPOSED", "SPEC_ACCEPTED", "SPEC_ACCEPTED_LEN",

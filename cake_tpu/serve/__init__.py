@@ -5,13 +5,15 @@ wedge watchdog) — see docs/serving.md and docs/fault_tolerance.md."""
 from .admission import AdmissionQueue, QueueFull
 from .engine import (EngineDraining, QueueDeadlineExceeded, ServeEngine,
                      ServeRequest, maybe_engine)
-from .prefix_cache import PrefixCache
+from .paged import BlockAllocator, KVPoolExhausted, PagedKV
+from .prefix_cache import PagedPrefixCache, PrefixCache
 from .slots import SlotPool
 from .supervisor import (EngineDown, PoisonedRequest,
                          RequestDeadlineExceeded, StepFailure, Supervisor)
 
 __all__ = ["AdmissionQueue", "QueueFull", "EngineDraining",
-           "QueueDeadlineExceeded", "EngineDown", "PoisonedRequest",
-           "RequestDeadlineExceeded", "StepFailure", "Supervisor",
+           "QueueDeadlineExceeded", "EngineDown", "KVPoolExhausted",
+           "PoisonedRequest", "RequestDeadlineExceeded", "StepFailure",
+           "Supervisor", "BlockAllocator", "PagedKV", "PagedPrefixCache",
            "PrefixCache", "ServeEngine", "ServeRequest", "SlotPool",
            "maybe_engine"]

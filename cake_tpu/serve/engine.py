@@ -58,7 +58,7 @@ import numpy as np
 
 from .. import knobs
 from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
-                   SERVE_POISONED, SERVE_QUEUE_TIMEOUTS,
+                   SERVE_POISONED, SERVE_PREEMPTIONS, SERVE_QUEUE_TIMEOUTS,
                    SERVE_QUEUE_WAIT_SECONDS, SERVE_REQUEST_TIMEOUTS,
                    SERVE_SLOTS_BUSY, now, set_request_id)
 from ..ops.sampling import SamplingConfig
@@ -66,14 +66,15 @@ from ..spec import resolve_drafter
 from ..spec.verify import record_step
 from . import faults
 from .admission import AdmissionQueue, QueueFull
-from .prefix_cache import PrefixCache
+from .paged import KVPoolExhausted, PagedKV, PreemptedSlot, choose_victim
+from .prefix_cache import PagedPrefixCache, PrefixCache
 from .slots import SlotPool, slot_bucket
 from .supervisor import (EngineDown, PoisonedRequest,
                          RequestDeadlineExceeded, Supervisor, classify)
 
 __all__ = ["ServeEngine", "ServeRequest", "QueueFull", "EngineDraining",
-           "QueueDeadlineExceeded", "EngineDown", "PoisonedRequest",
-           "RequestDeadlineExceeded", "maybe_engine"]
+           "QueueDeadlineExceeded", "EngineDown", "KVPoolExhausted",
+           "PoisonedRequest", "RequestDeadlineExceeded", "maybe_engine"]
 
 log = logging.getLogger("cake_tpu.serve")
 
@@ -256,7 +257,10 @@ class ServeEngine:
                  step_watchdog_s: float | None = None,
                  rebuild_budget: int | None = None,
                  rebuild_window_s: float | None = None,
-                 restore_interval_s: float | None = None):
+                 restore_interval_s: float | None = None,
+                 kv_blocks: int | None = None,
+                 kv_block_tokens: int | None = None,
+                 preempt_mode: str | None = None):
         if not hasattr(model, "decode_slots"):
             raise TypeError(
                 f"{type(model).__name__} has no batched slot decode; the "
@@ -271,8 +275,28 @@ class ServeEngine:
         if prefix_cache_mb is None:
             prefix_cache_mb = knobs.get("CAKE_PREFIX_CACHE_MB")
         self._prefix_mb = prefix_cache_mb    # rebuilds reconstruct the cache
-        self.prefix_cache = PrefixCache.build(model, self.ctx, self.chunk,
-                                              prefix_cache_mb)
+        # -- paged KV pool (CAKE_KV_BLOCKS > 0) ---------------------------
+        # Replaces the worst-case-provisioned slots x ctx rows with a
+        # shared pool of fixed-size blocks behind per-slot block tables:
+        # memory follows actual sequence length, prefix hits become
+        # refcount bumps, and exhaustion preempts a victim (swap or
+        # recompute) instead of capping admission. 0 keeps the
+        # contiguous pool (see docs/serving.md#paged-kv-pool).
+        if kv_blocks is None:
+            kv_blocks = knobs.get("CAKE_KV_BLOCKS")
+        self.kv_blocks = max(int(kv_blocks), 0)
+        if kv_block_tokens is None:
+            kv_block_tokens = knobs.get("CAKE_KV_BLOCK_TOKENS")
+        self.kv_block_tokens = kv_block_tokens
+        if preempt_mode is None:
+            preempt_mode = knobs.get("CAKE_PREEMPT_MODE")
+        if preempt_mode not in ("swap", "recompute"):
+            raise ValueError(
+                f"CAKE_PREEMPT_MODE must be 'swap' or 'recompute', got "
+                f"{preempt_mode!r}")
+        self.preempt_mode = preempt_mode
+        self.paged: PagedKV | None = None
+        self._preempted: list[PreemptedSlot] = []
         self.pool = SlotPool(slots)
         self.queue = AdmissionQueue(max_queue)
         # per-request queue deadline (CAKE_QUEUE_DEADLINE_S, 0 disables):
@@ -314,6 +338,7 @@ class ServeEngine:
         self._vocab = model.cfg.vocab_size
         self._base_rng = jax.random.PRNGKey(seed)
         self._init_device_state()
+        self.prefix_cache = self._build_prefix_cache()
         self._reqs: list[ServeRequest | None] = [None] * slots
         self._prefills: list[_Prefill] = []   # in-flight chunked admissions
         self._rr = 0                          # round-robin cursor over them
@@ -334,7 +359,7 @@ class ServeEngine:
                                         name="cake-serve")
         self._thread.start()
 
-    def _init_device_state(self, layers=None):
+    def _init_device_state(self, layers=None, paged=None):
         """(Re)allocate the pool cache and every per-slot carry — called
         at construction and by crash recovery (`_rebuild`/`_revive`),
         which trusts NOTHING device-resident after a failure (donated
@@ -344,11 +369,20 @@ class ServeEngine:
         admission/release only, and the whole carry (tokens, positions,
         RNG, recent windows) advances inside the batched decode program
         — an iteration ships nothing host->device and fetches only the
-        nb sampled ids."""
+        nb sampled ids. In paged mode the pool is a PagedKV (shared
+        physical blocks + per-slot tables) instead of B contiguous
+        rows; the carries are identical."""
         slots = self.slots
-        if layers is None:
-            layers = self.model.new_cache(slots, kv_len=self.ctx)["layers"]
-        self._layers = layers
+        if self.kv_blocks > 0:
+            self.paged = paged or PagedKV.build(
+                self.model, slots, self.ctx, self.kv_blocks,
+                self.kv_block_tokens, self.chunk)
+            self._layers = None
+        else:
+            if layers is None:
+                layers = self.model.new_cache(slots,
+                                              kv_len=self.ctx)["layers"]
+            self._layers = layers
         self._toks = jnp.zeros((slots,), jnp.int32)
         self._pos = jnp.zeros((slots,), jnp.int32)
         self._temps = jnp.zeros((slots,), jnp.float32)
@@ -363,6 +397,19 @@ class ServeEngine:
         # never donated — the engine keeps its handle across iterations,
         # so steady-state decode still ships nothing host->device
         self._act = jnp.zeros((slots,), jnp.bool_)
+
+    def _build_prefix_cache(self):
+        """Mode-matched prefix cache: the paged variant pins shared pool
+        blocks by refcount (a hit is a table remap, no KV copy) and is
+        wired in as the allocator's under-pressure evictor; the
+        contiguous variant keeps private block copies."""
+        if self.paged is not None:
+            pc = PagedPrefixCache.build_paged(self.model, self.paged,
+                                              self.chunk, self._prefix_mb)
+            self.paged.evictor = pc.evict_for_pressure if pc else None
+            return pc
+        return PrefixCache.build(self.model, self.ctx, self.chunk,
+                                 self._prefix_mb)
 
     # -- client surface (any thread) ----------------------------------------
 
@@ -399,6 +446,14 @@ class ServeEngine:
             raise ValueError(
                 f"prompt length {n} exceeds the serve context "
                 f"({self.ctx} tokens per slot)")
+        # bind a local: the scheduler thread nulls self.paged transiently
+        # during _rebuild/_fail_all, and submit runs on client threads
+        paged = self.paged
+        if paged is not None and paged.blocks_for(n + 1) > paged.num_blocks:
+            raise ValueError(
+                f"prompt needs {paged.blocks_for(n + 1)} KV blocks "
+                f"but the pool holds {paged.num_blocks} "
+                f"(CAKE_KV_BLOCKS x CAKE_KV_BLOCK_TOKENS tokens total)")
         req = ServeRequest(prompt_ids, max_new_tokens, sampling, request_id)
         req._engine = self
         # free slots extend the bound: a burst that fits the idle pool is
@@ -485,8 +540,23 @@ class ServeEngine:
         q = self.supervisor.quarantined_count()
         if q:
             h["quarantined"] = q
-        if self.prefix_cache is not None:
-            h["prefix_cache"] = self.prefix_cache.occupancy()
+        pc = self.prefix_cache
+        if pc is not None:
+            h["prefix_cache"] = pc.occupancy()
+        # local binding: health() runs on API threads while the scheduler
+        # may null self.paged transiently during _rebuild/_fail_all
+        paged = self.paged
+        if paged is not None:
+            live = {}
+            for i in self.pool.busy():
+                req = self._reqs[i]
+                if req is not None:
+                    live[i] = len(req.prompt_ids) \
+                        + max(len(req.tokens) - 1, 0)
+            h["kv_pool"] = {
+                **paged.occupancy(live),
+                "preempted_slots": len(self._preempted),
+            }
         if self.spec_drafter is not None:
             h["spec"] = {
                 "drafter": self.spec_drafter.name,
@@ -508,7 +578,7 @@ class ServeEngine:
         self._draining.set()
         self._wake.set()
         deadline = None if timeout is None else now() + timeout
-        while self.pool.busy_count or self.queue.depth():
+        while self.pool.busy_count or self.queue.depth() or self._preempted:
             if self.dead is not None or not self._thread.is_alive():
                 return False
             if deadline is not None and now() >= deadline:
@@ -534,9 +604,15 @@ class ServeEngine:
                     self._fail(req, EngineDown("serve engine shut down"))
             return
         self._prefills.clear()
+        for entry in self._drain_preempted():
+            self._fail(entry.req, EngineDown("serve engine shut down"))
         for i, req in enumerate(self._reqs):
             if req is not None:
                 self._finish(i, req, cancelled=True)
+
+    def _drain_preempted(self) -> list:
+        entries, self._preempted = self._preempted, []
+        return entries
 
     # -- scheduler thread ---------------------------------------------------
 
@@ -582,6 +658,8 @@ class ServeEngine:
         """Terminal failure: every waiter is released, loudly."""
         self.dead = e
         self._prefills.clear()      # their reqs are in _reqs below
+        for entry in self._drain_preempted():
+            self._fail(entry.req, e)
         for req in self.queue.drain():
             self._fail(req, e)
         for i, req in enumerate(self._reqs):
@@ -605,26 +683,39 @@ class ServeEngine:
         try:
             # recovery-grace watchdog limit: the trial may compile
             self.supervisor.arm("trial", (), grace=True)
-            layers = self.model.new_cache(self.slots,
-                                          kv_len=self.ctx)["layers"]
-            _, layers = self.model.prefill_chunk(layers, 0, [1], 0)
-            layers = self.model.slot_release(layers, 0)
-            # the dispatches above are async — a broken device surfaces
-            # its error here, inside the probe's try, not mid-serving
-            jax.block_until_ready(layers)
+            if self.kv_blocks > 0:
+                state = PagedKV.build(self.model, self.slots, self.ctx,
+                                      self.kv_blocks, self.kv_block_tokens,
+                                      self.chunk)
+                state.reserve_range(0, 0, 1)
+                state.prefill_into(0, [1], 0)
+                state.release_slot(0)
+                jax.block_until_ready((state.pool, state.rows))
+            else:
+                layers = self.model.new_cache(self.slots,
+                                              kv_len=self.ctx)["layers"]
+                _, layers = self.model.prefill_chunk(layers, 0, [1], 0)
+                layers = self.model.slot_release(layers, 0)
+                state = layers
+                # the dispatches above are async — a broken device
+                # surfaces its error here, inside the probe's try, not
+                # mid-serving
+                jax.block_until_ready(layers)
             self.supervisor.disarm()
         except Exception as e:
             self.supervisor.disarm()
             self.supervisor.note_probe_failure(e)
             return
-        self._revive(layers)
+        self._revive(state)
 
-    def _revive(self, layers):
+    def _revive(self, state):
         """Trial step succeeded: adopt its (wiped) pool, fresh carries,
         fresh prefix cache, and rejoin the serving loop."""
-        self._init_device_state(layers)
-        self.prefix_cache = PrefixCache.build(self.model, self.ctx,
-                                              self.chunk, self._prefix_mb)
+        if self.kv_blocks > 0:
+            self._init_device_state(paged=state)
+        else:
+            self._init_device_state(state)
+        self.prefix_cache = self._build_prefix_cache()
         self.supervisor.clear_down()
         log.warning("serve engine revived: trial step succeeded, pool "
                     "rebuilt empty, admission reopened")
@@ -632,7 +723,7 @@ class ServeEngine:
     def _step(self) -> bool:
         busy = self.pool.busy()
         queued = self.queue.depth() > 0
-        if not (busy or queued):
+        if not (busy or queued or self._preempted):
             return False
         with RECORDER.span("serve.step", cat="serve", slots=len(busy),
                            queued=self.queue.depth()):
@@ -653,6 +744,11 @@ class ServeEngine:
             for pf in [p for p in self._prefills
                        if p.req.cancelled.is_set()]:
                 self._abort_prefill(pf, None)
+            for entry in [e for e in self._preempted
+                          if e.req.cancelled.is_set()
+                          or e.req.done.is_set()]:
+                self._preempted.remove(entry)
+                self._fail(entry.req, None)
             for req in self.queue.purge(lambda r: r.cancelled.is_set()):
                 self._fail(req, None)
             # queue-deadline sweep: a request that has waited past
@@ -686,11 +782,27 @@ class ServeEngine:
                     else:
                         req.result["error"] = err
                         self._finish(i, req, cancelled=True)
-            # 2. every queued request takes a free slot NOW (cheap: at
-            # most a prefix-cache splice — the prefill itself is chunked
+                for entry in [e for e in self._preempted
+                              if e.req.t_enqueue < cutoff]:
+                    self._preempted.remove(entry)
+                    SERVE_REQUEST_TIMEOUTS.inc()
+                    self._fail(entry.req, RequestDeadlineExceeded(
+                        now() - entry.req.t_enqueue,
+                        self.request_deadline_s))
+            # 2. preempted slots resume FIRST (oldest-first, as soon as a
+            # slot + enough blocks free up — their clients are mid-stream),
+            # then every queued request takes a free slot (cheap: at most
+            # a prefix-cache splice — the prefill itself is chunked
             # below), so multiple admissions are in flight concurrently
+            if self._preempted:
+                self._resume_preempted()
             while self.pool.free_count > 0 and self._start_admission():
                 pass
+            if not (self.pool.busy_count or self.queue.depth()):
+                # only parked entries remain and none could resume yet:
+                # report idle so _run waits on the wake event (0.5s
+                # heartbeat retries the resume) instead of hot-spinning
+                return False
             # 3. dispatch ONE batched decode step over the slots whose
             # prefill has completed (mid-prefill rows ride along frozen
             # under the active mask)... unless the batch is SHALLOW and
@@ -698,9 +810,26 @@ class ServeEngine:
             # verify step instead (draft k, verify once, emit 1..k+1) —
             # occupancy above spec_max_busy falls back to plain batched
             # decode so speculation never slows a saturated pool
+            # 3a. choose the admission to advance this iteration (round-
+            # robin) and, in paged mode, reserve its chunk's blocks NOW —
+            # BEFORE the decode dispatch. The reservation may preempt a
+            # decoding victim, and preemption is only safe pre-dispatch:
+            # a swap-out after the decode was dispatched would capture
+            # post-step carries holding a sampled token the host never
+            # fanned out, silently dropping it from the stream on resume
+            pf_job = None
+            if self._prefills:
+                pf_job = self._prefills[self._rr % len(self._prefills)]
+                if self.paged is not None:
+                    pf_job = self._prepare_prefill(pf_job)
             prefilling = {p.slot for p in self._prefills}   # post-admission
             active = [i for i in self.pool.busy()
                       if self._reqs[i] is not None and i not in prefilling]
+            if self.paged is not None and active:
+                # every decoding slot needs its write-frontier block
+                # mapped BEFORE dispatch; exhaustion preempts a victim
+                # (which may shrink `active`) — see _ensure_decode_blocks
+                active = self._ensure_decode_blocks(active)
             packed = None
             active_ids = tuple(self._reqs[i].id for i in active)
             if self._spec_eligible(active):
@@ -716,20 +845,30 @@ class ServeEngine:
                 hook = faults.FAULT_HOOK
                 if hook is not None:
                     hook.on_decode([self._reqs[i] for i in active])
-                (packed, self._layers, self._toks, self._pos, self._rngs,
-                 self._recents) = self.model.decode_slots(
-                    self._layers, self._toks, self._pos, self._rngs,
-                    self._recents, self._temps, self._top_ks, self._top_ps,
-                    self._pens, self._act, nb=nb)
-            # 4. ...then advance at most ONE in-flight admission by one
-            # chunk, round-robin so every queued prompt makes progress.
+                if self.paged is not None:
+                    (packed, self.paged.pool, self.paged.rows, self._toks,
+                     self._pos, self._rngs,
+                     self._recents) = self.model.decode_slots_paged(
+                        self.paged.pool, self.paged.rows, self.paged.tables,
+                        self._toks, self._pos, self._rngs, self._recents,
+                        self._temps, self._top_ks, self._top_ps, self._pens,
+                        self._act, nb=nb)
+                else:
+                    (packed, self._layers, self._toks, self._pos,
+                     self._rngs, self._recents) = self.model.decode_slots(
+                        self._layers, self._toks, self._pos, self._rngs,
+                        self._recents, self._temps, self._top_ks,
+                        self._top_ps, self._pens, self._act, nb=nb)
+            # 4. ...then advance the chosen admission by one chunk.
             # Dispatch order matters: the decode program is already queued
             # on the device, so the packed-ids fetch below never waits for
             # this chunk — on real hardware the chunk overlaps the host's
-            # token fan-out
-            if self._prefills:
-                idx = self._rr % len(self._prefills)
-                if self._advance_prefill(self._prefills[idx]):
+            # token fan-out. (Its blocks were reserved in 3a; the job may
+            # have been requeued by a decode slot's own preemption since,
+            # hence the membership re-check.)
+            if pf_job is not None and pf_job in self._prefills:
+                idx = self._prefills.index(pf_job)
+                if self._advance_prefill(pf_job):
                     self._rr = idx + 1      # still in flight: move past it
                 else:
                     self._rr = idx          # removed: next job slid here
@@ -811,9 +950,13 @@ class ServeEngine:
                 hook = faults.FAULT_HOOK
                 if hook is not None:
                     hook.on_prefill(pf.req)
-                logits, self._layers = self.model.prefill_chunk(
-                    self._layers, pf.slot, pf.ids[pf.pos:pf.pos + take],
-                    pf.pos)
+                if self.paged is not None:
+                    logits = self.paged.prefill_into(
+                        pf.slot, pf.ids[pf.pos:pf.pos + take], pf.pos)
+                else:
+                    logits, self._layers = self.model.prefill_chunk(
+                        self._layers, pf.slot,
+                        pf.ids[pf.pos:pf.pos + take], pf.pos)
             pf.pos += take
             pf.chunks += 1
             pf.next_block = self._capture_blocks(pf.ids, pf.slot, pf.pos,
@@ -910,11 +1053,200 @@ class ServeEngine:
         SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         self._fail(pf.req, error)
         try:
-            self._layers = self.model.slot_release(self._layers, pf.slot)
+            self._release_row(pf.slot)
         except Exception as wipe_exc:
             if error is not None:
                 raise error from wipe_exc
             raise
+
+    def _release_row(self, slot: int):
+        """Per-request row release, mode-dispatched: contiguous wipes the
+        pool row; paged derefs the slot's blocks (shared blocks survive
+        under the prefix cache / other slots) and wipes only the SWA/
+        linear rows — freed pool blocks need no wipe thanks to the
+        gather's stale-tenant pos guard."""
+        if self.paged is not None:
+            self.paged.release_slot(slot)
+        else:
+            self._layers = self.model.slot_release(self._layers, slot)
+
+    # -- paged-pool pressure: reserve / preempt / resume --------------------
+
+    def _prepare_prefill(self, pf: _Prefill):
+        """Reserve the blocks pf's next chunk will write — called BEFORE
+        the decode dispatch so any preemption it triggers sees pre-step
+        carries (see _step 3a). Returns pf when the chunk may dispatch;
+        None when the admission was failed typed. Reservation failure
+        implies the pool is exhausted with pf as the ONLY occupant
+        (_reserve_blocks evicts the prefix cache, preempts every
+        decoding slot, and requeues every other admission before giving
+        up), so the prompt can never fit and parking would hang it."""
+        take = min(self.chunk, pf.n - pf.pos)
+        if self._reserve_blocks(pf.slot, pf.pos, take):
+            return pf
+        self._abort_prefill(pf, KVPoolExhausted(
+            f"KV pool exhausted admitting {pf.req.id}: the prompt needs "
+            "more blocks than the pool can ever free"))
+        return None
+
+    def _reserve_blocks(self, slot: int, pos0: int, n: int) -> bool:
+        """Back positions [pos0, pos0+n) of `slot` with physical blocks,
+        evicting prefix-cache LRU (inside the allocator) and then
+        preempting victims until it fits. False = nothing left to
+        reclaim."""
+        while not self.paged.reserve_range(slot, pos0, n):
+            if not self._preempt_one(exclude=slot):
+                return False
+        return True
+
+    def _ensure_decode_blocks(self, active: list[int]) -> list[int]:
+        """Map the write-frontier block of every decoding slot before
+        the batched dispatch (a decode step writes position p into table
+        entry p // block_tokens; p is derivable host-side from the token
+        record, so steady state ships nothing extra). Exhaustion evicts
+        prefix-cache LRU, then preempts a victim; a slot that cannot
+        grow with NOTHING left to reclaim is failed typed rather than
+        wedging the scheduler. Returns the surviving active list
+        (preemption and failure both shrink it)."""
+        for i in active:
+            req = self._reqs[i]
+            if req is None:
+                continue        # preempted by an earlier slot's ensure
+            wp = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
+            while not self.paged.ensure(i, wp // self.paged.bt):
+                if not self._preempt_one(exclude=i):
+                    req.result["error"] = KVPoolExhausted(
+                        f"KV pool exhausted: request {req.id} cannot "
+                        f"grow past {wp} tokens and nothing is left to "
+                        "reclaim")
+                    self._finish(i, req, cancelled=True)
+                    break
+        return [i for i in active if self._reqs[i] is not None]
+
+    def _preempt_one(self, exclude: int) -> bool:
+        """Free blocks by evicting one victim: a DECODING slot first
+        (latest admission — the cheapest to redo, and the oldest request
+        can never be starved by newcomers), else the youngest OTHER
+        in-flight admission goes back to readmission (it has emitted
+        nothing, so a restart is clean). False = nothing to preempt."""
+        prefilling = {p.slot for p in self._prefills}
+        cands = [(i, self._reqs[i]) for i in self.pool.busy()
+                 if i not in prefilling]
+        victim = choose_victim(cands, exclude=exclude)
+        if victim is not None:
+            self._preempt_slot(*victim)
+            return True
+        others = [p for p in self._prefills if p.slot != exclude]
+        if others:
+            self._requeue_admission(
+                max(others, key=lambda p: p.req.t_enqueue))
+            return True
+        return False
+
+    def _preempt_slot(self, slot: int, req: ServeRequest):
+        """Evict one decoding slot to free its blocks. Swap mode keeps
+        the bytes host-side — resume is bit-identical even for SAMPLED
+        streams (the RNG carry rides the blob); recompute mode drops
+        them and replays at resume (greedy bit-identical, the rebuild
+        parity rule)."""
+        wp = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
+        if self.preempt_mode == "swap":
+            blob = self.paged.swap_out(
+                slot, (self._toks, self._pos, self._rngs, self._recents))
+            entry = PreemptedSlot(req, "swap", wp, blob)
+        else:
+            self.paged.release_slot(slot)
+            if not req.tokens:
+                req._first_pending = False  # unfetched 1st token is lost
+            entry = PreemptedSlot(req, "recompute", wp)
+        SERVE_PREEMPTIONS.inc(mode=entry.mode)
+        self.pool.free(slot)
+        self._reqs[slot] = None
+        req.slot = None
+        self._act = self._act.at[slot].set(False)
+        self._toks = self._toks.at[slot].set(0)
+        self._pos = self._pos.at[slot].set(0)
+        self._preempted.append(entry)
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        log.warning("preempted slot %d (%s, %d tokens): KV pool "
+                    "exhausted", slot, entry.mode, wp)
+
+    def _requeue_admission(self, pf: _Prefill):
+        """Push a mid-prefill admission back to readmission to free its
+        blocks (no tokens emitted yet — a clean restart, ordered ahead
+        of every queued request via the preempted list)."""
+        self._prefills.remove(pf)
+        self.paged.release_slot(pf.slot)
+        self.pool.free(pf.slot)
+        self._reqs[pf.slot] = None
+        pf.req.slot = None
+        SERVE_PREEMPTIONS.inc(mode="recompute")
+        self._preempted.append(PreemptedSlot(pf.req, "recompute", 0))
+        SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+        log.warning("readmitting request %s: KV pool exhausted "
+                    "mid-prefill", pf.req.id)
+
+    def _resume_preempted(self):
+        """Oldest-first resume of preempted requests: swap entries
+        re-allocate blocks and restore bytes + carries; recompute
+        entries replay prompt + generated[:-1] through chunked prefill.
+        Stops at the first entry that does not fit yet — strict FIFO, so
+        a big parked request cannot be starved by smaller ones behind
+        it."""
+        while self._preempted and self.pool.free_count > 0:
+            entry = self._preempted[0]
+            req = entry.req
+            if entry.mode == "swap":
+                slot = self.pool.alloc()
+                if not self.paged.swap_in(slot, entry.blob):
+                    self.pool.free(slot)
+                    self._fail_unresumable(entry)
+                    return              # blocks still short; wait
+                self._preempted.pop(0)
+                toks_b, pos_b, rngs_b, recents_b = entry.blob["carries"]
+                self._toks = self._toks.at[slot].set(int(toks_b))
+                self._pos = self._pos.at[slot].set(int(pos_b))
+                self._rngs = self._rngs.at[slot].set(jnp.asarray(rngs_b))
+                self._recents = self._recents.at[slot].set(
+                    jnp.asarray(recents_b))
+                self._set_slot_sampling(slot, req.sampling)
+                self._act = self._act.at[slot].set(True)
+                self._reqs[slot] = req
+                req.slot = slot
+            else:
+                need = self.paged.blocks_for(entry.tokens_at_preempt + 1)
+                # ensure_free counts cache pins as reclaimable: a parked
+                # request never reaches the allocation path where lazy
+                # eviction runs, so the gate must evict for it
+                if not self.paged.ensure_free(need):
+                    self._fail_unresumable(entry)
+                    return      # replaying now would thrash straight
+                                # back into preemption; wait for room
+                slot = self.pool.alloc()
+                self._preempted.pop(0)
+                self._reqs[slot] = req
+                req.slot = slot
+                if req.tokens:
+                    self._replay_slot(req, slot)
+                else:
+                    self._begin_prefill(_Prefill(req, slot))
+            SERVE_SLOTS_BUSY.set(self.pool.busy_count)
+            log.warning("resumed preempted request %s into slot %d (%s)",
+                        req.id, req.slot if req.slot is not None else -1,
+                        entry.mode)
+
+    def _fail_unresumable(self, entry: PreemptedSlot):
+        """A parked entry whose resume gate failed: if live work still
+        holds blocks, more room is coming — leave it parked. With
+        NOTHING running and the cache already drained by the gate, no
+        future event can free another block, so the request is failed
+        typed instead of hanging its client forever."""
+        if self.pool.busy_count or self.queue.depth():
+            return
+        self._preempted.remove(entry)
+        self._fail(entry.req, KVPoolExhausted(
+            f"KV pool exhausted: preempted request {entry.req.id} needs "
+            "more blocks than the pool can ever free"))
 
     # -- crash recovery (called by the supervisor, scheduler thread) --------
 
@@ -956,14 +1288,17 @@ class ServeEngine:
         self.pool = SlotPool(self.slots)
         self._reqs = [None] * self.slots
         # release the impeached device state BEFORE reallocating: the
-        # prefix cache's blocks and the old pool rows pin HBM, and an
-        # oom-classified failure would re-OOM every rebuild attempt if
-        # the replacement pool had to coexist with the one it replaces
+        # prefix cache's blocks and the old pool (rows or paged blocks)
+        # pin HBM, and an oom-classified failure would re-OOM every
+        # rebuild attempt if the replacement pool had to coexist with
+        # the one it replaces. Preempted entries SURVIVE a rebuild —
+        # swap blobs are host memory and recompute entries replay from
+        # the host token record either way
         self._layers = None
+        self.paged = None
         self.prefix_cache = None
-        self.prefix_cache = PrefixCache.build(self.model, self.ctx,
-                                              self.chunk, self._prefix_mb)
         self._init_device_state()
+        self.prefix_cache = self._build_prefix_cache()
         # register EVERY survivor before any device work: if a replay
         # crashes, the next rebuild's harvest must still see the ones
         # that hadn't replayed yet
@@ -1018,14 +1353,26 @@ class ServeEngine:
                 next_block = matched
                 while pos < n:
                     take = min(self.chunk, n - pos)
+                    if self.paged is not None and not \
+                            self.paged.reserve_range(slot, pos, take):
+                        # replay never preempts (it runs inside recovery
+                        # / resume, where victim churn would thrash);
+                        # cache eviction already happened inside
+                        # reserve_range, so this is a genuinely full pool
+                        raise KVPoolExhausted(
+                            f"KV pool exhausted replaying {req.id}")
                     # recovery-grace watchdog limit: a replay chunk may
                     # carry an in-iteration compile for a bucket fresh
                     # generations never hit
                     self.supervisor.arm("replay", (req.id,), grace=True)
                     if hook is not None:
                         hook.on_prefill(req)
-                    _, self._layers = self.model.prefill_chunk(
-                        self._layers, slot, ids[pos:pos + take], pos)
+                    if self.paged is not None:
+                        self.paged.prefill_into(slot, ids[pos:pos + take],
+                                                 pos)
+                    else:
+                        _, self._layers = self.model.prefill_chunk(
+                            self._layers, slot, ids[pos:pos + take], pos)
                     pos += take
                     next_block = self._capture_blocks(ids, slot, pos, n,
                                                       next_block, keys)
@@ -1070,6 +1417,8 @@ class ServeEngine:
         bookkeeping resets, and the device pool is dropped (the restore
         trial allocates the replacement)."""
         self._prefills.clear()
+        for entry in self._drain_preempted():
+            self._fail(entry.req, err)
         for req in self.queue.drain():
             self._fail(req, err)
         for i, req in enumerate(self._reqs):
@@ -1082,6 +1431,7 @@ class ServeEngine:
         # oom-downed engine must not pin the old HBM while the restore
         # trial tries to allocate its replacement (_revive rebuilds both)
         self._layers = None
+        self.paged = None
         self.prefix_cache = None
         SERVE_SLOTS_BUSY.set(0)
 
@@ -1097,6 +1447,10 @@ class ServeEngine:
         to the one unfetched input the packed result carries), and the
         occupancy must not exceed spec_max_busy."""
         if self.spec_drafter is None or not active:
+            return False
+        if self.paged is not None:
+            # spec_slot has no block-table variant yet: ragged multi-token
+            # advance over paged blocks is the ROADMAP follow-up
             return False
         if len(active) > self.spec_max_busy:
             return False
@@ -1185,7 +1539,7 @@ class ServeEngine:
             # and chunked prefill both assume a clean row), and drop the
             # slot from the active mask — a freed row inside the decode
             # prefix is frozen outright, not stepped
-            self._layers = self.model.slot_release(self._layers, slot)
+            self._release_row(slot)
             self._toks = self._toks.at[slot].set(0)
             self._pos = self._pos.at[slot].set(0)
             self._act = self._act.at[slot].set(False)
@@ -1223,6 +1577,10 @@ def maybe_engine(model, slots: int | None = None,
     (default 4096, capped by the model's max_cache_len), CAKE_PREFILL_CHUNK
     (default 256 — per-iteration chunked-admission token budget),
     CAKE_PREFIX_CACHE_MB (default 256, 0 disables shared-prefix KV reuse),
+    the paged-KV knobs CAKE_KV_BLOCKS / CAKE_KV_BLOCK_TOKENS /
+    CAKE_PREEMPT_MODE (CAKE_KV_BLOCKS > 0 swaps the contiguous slot rows
+    for a shared block pool with refcounted prefix sharing and
+    preemption — see docs/serving.md#paged-kv-pool),
     the speculative-decoding knobs CAKE_SPEC / CAKE_SPEC_K /
     CAKE_SPEC_MAX_BUSY (see docs/speculative.md), and the supervision
     knobs CAKE_STEP_WATCHDOG_S / CAKE_ENGINE_REBUILDS /

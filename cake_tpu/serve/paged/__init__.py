@@ -1,0 +1,11 @@
+"""Paged KV subsystem: block-pool allocator, refcounted prefix sharing,
+preempt-by-swap — the scale refactor that replaces worst-case-provisioned
+KV rows (CAKE_SERVE_SLOTS x CAKE_SERVE_CTX) with on-demand fixed-size
+blocks behind per-slot indirection tables (vLLM/PagedAttention). Enabled
+by CAKE_KV_BLOCKS > 0; see docs/serving.md#paged-kv-pool."""
+from .allocator import BlockAllocator
+from .pool import KVPoolExhausted, PagedKV, pow2_block_tokens
+from .preempt import PreemptedSlot, choose_victim
+
+__all__ = ["BlockAllocator", "KVPoolExhausted", "PagedKV",
+           "PreemptedSlot", "choose_victim", "pow2_block_tokens"]

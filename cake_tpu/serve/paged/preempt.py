@@ -1,0 +1,49 @@
+"""Preemption policy + parked-request state for the paged serve engine.
+
+When the block pool is exhausted (and the prefix cache has nothing left
+to evict), a victim slot is evicted to make room:
+
+  * mode "swap": the slot's blocks, row state and decode carries are
+    fetched to host RAM and its blocks freed; resume writes the same
+    bytes into fresh blocks — continuation is bit-identical even for
+    SAMPLED streams (the RNG carry rides the blob).
+  * mode "recompute": the blocks are simply freed; resume replays
+    prompt + generated[:-1] through chunked prefill (PR 8's rebuild-by-
+    replay machinery) — greedy continuation is bit-identical, sampled
+    streams resume on a fresh rng fold (the documented rebuild
+    exception).
+
+Victim choice is latest-admission-first (LIFO, the vLLM rule): the
+request that has consumed the least scheduler work is the cheapest to
+re-run, and the oldest request can never be starved by newcomers.
+Parked requests resume oldest-first, before any new admission, as soon
+as a slot and enough blocks are free.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["PreemptedSlot", "choose_victim"]
+
+
+@dataclass
+class PreemptedSlot:
+    req: object                       # ServeRequest
+    mode: str                         # "swap" | "recompute"
+    tokens_at_preempt: int            # frontier tokens (resume gating)
+    blob: dict | None = None          # swap payload (None for recompute;
+                                      # sampling params re-derive from
+                                      # req.sampling at resume)
+
+
+def choose_victim(candidates: list[tuple[int, object]],
+                  exclude: int | None = None) -> tuple[int, object] | None:
+    """(slot, req) to preempt from `candidates` [(slot, req)], or None.
+    Latest admission first; `exclude` protects the slot whose allocation
+    triggered the preemption (a slot cannot make room by evicting
+    itself)."""
+    pool = [(s, r) for s, r in candidates
+            if s != exclude and r is not None]
+    if not pool:
+        return None
+    return max(pool, key=lambda sr: sr[1].t_enqueue)

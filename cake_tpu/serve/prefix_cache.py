@@ -40,13 +40,22 @@ import numpy as np
 from ..obs import (SERVE_PREFIX_BYTES, SERVE_PREFIX_EVICTIONS,
                    SERVE_PREFIX_HITS, SERVE_PREFIX_MISSES)
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "PagedPrefixCache"]
 
 
 @dataclass
 class _Block:
     tokens: np.ndarray      # the FULL prefix this block completes (verify)
     layers: list            # batch-1 layers pytree, this block's KV + state
+    nbytes: int
+
+
+@dataclass
+class _PagedEntry:
+    tokens: np.ndarray      # the FULL prefix this unit completes (verify)
+    pids: list              # physical block ids this entry PINS (refcount)
+    snap: list | None       # boundary row snapshot (SWA rings + linear
+                            # state), installed only as a chain's FINAL unit
     nbytes: int
 
 
@@ -176,3 +185,139 @@ class PrefixCache:
             "misses": self.misses,
             "evictions": self.evictions,
         }
+
+
+class PagedPrefixCache(PrefixCache):
+    """Prefix index over SHARED paged-pool blocks (the allocator unified
+    with the prefix cache): instead of extracting a block's bytes into a
+    private copy, an insert PINS the live slot's physical blocks by
+    refcount, and a hit maps those same blocks into the new slot's table
+    — a prefix hit moves ZERO KV bytes (observable as the
+    cake_serve_kv_blocks_shared gauge going positive). Only the boundary
+    row snapshot (SWA rings + linear-attention conv/recurrent state, a
+    few KB) is copied, because that state is per-slot, not pooled; it is
+    installed for the FINAL matched unit exactly like the contiguous
+    splice's `final` flag — the same boundary-exact GDN rule.
+
+    The share unit stays one CHUNK of tokens (== chunk // block_tokens
+    physical blocks), so the hash chain, match cap (n-1 live tokens) and
+    capture boundaries are identical to the contiguous cache — match()
+    and chain_keys() are inherited unchanged.
+
+    Cache-held blocks are RECLAIMABLE capacity: the allocator evicts LRU
+    units under allocation pressure (evict_for_pressure, wired as
+    PagedKV.evictor), so the cache can use every otherwise-idle block
+    without ever starving admissions. The contiguous gate "sliding
+    window >= block" does not apply here — SWA state rides the boundary
+    snapshot, not per-block ring extracts."""
+
+    def __init__(self, model, paged, unit: int, capacity_bytes: int):
+        super().__init__(model, unit, capacity_bytes)
+        self.paged = paged
+        self.bpu = unit // paged.bt           # physical blocks per unit
+
+    @classmethod
+    def build_paged(cls, model, paged, unit: int,
+                    capacity_mb: float) -> "PagedPrefixCache | None":
+        if capacity_mb <= 0 or unit > paged.ctx or unit % paged.bt:
+            return None
+        return cls(model, paged, unit, int(capacity_mb * 1024 * 1024))
+
+    # -- admission-side API (paged semantics) -------------------------------
+
+    def splice(self, layers, slot: int, keys: list[bytes], matched: int):
+        """Map the matched chain's physical blocks into `slot`'s table
+        (refcount bump per block — no KV copy) and install the final
+        unit's row snapshot. Refs and mappings are taken host-side and
+        the device table row is published ONCE (one scatter + one gauge
+        publish per hit, not per block — admission hot path). `layers`
+        is ignored (the paged engine keeps no contiguous pool) and
+        returned untouched."""
+        for b in range(matched):
+            entry = self._blocks[keys[b]]
+            for j, pid in enumerate(entry.pids):
+                self.paged.alloc.ref(pid)
+                self.paged.alloc.map(slot, b * self.bpu + j, pid)
+        self.paged.sync_table_row(slot)
+        final = self._blocks[keys[matched - 1]]
+        if final.snap is not None:
+            self.paged.rows = self.model.row_install(self.paged.rows,
+                                                     final.snap, slot)
+        return layers
+
+    def insert(self, layers, slot: int, prompt_ids: list[int],
+               block_index: int, keys: list[bytes]) -> None:
+        """Pin unit `block_index` of `slot` as a shared entry. Must be
+        called at the chunk boundary that completed the unit (the row
+        snapshot is exact only there). `layers` is ignored. Dedupes on
+        key — a concurrent admission that prefilled its own copy before
+        this one captured keeps its private blocks (correct, just
+        unshared)."""
+        end = (block_index + 1) * self.block
+        key = keys[block_index]
+        if key in self._blocks:
+            self._blocks.move_to_end(key)
+            return
+        pids = self.paged.alloc.tables[slot][block_index * self.bpu:
+                                             (block_index + 1) * self.bpu]
+        if self.paged.NULL in pids:
+            return                  # row not fully backed (cannot happen
+                                    # after a completed chunk; be safe)
+        snap = None
+        snap_bytes = 0
+        if self.paged.has_rows:
+            snap = self.model.row_snapshot(self.paged.rows, slot)
+            snap_bytes = _tree_bytes(snap)
+        nbytes = len(pids) * self.paged.block_bytes + snap_bytes
+        if nbytes > self.capacity:
+            return                          # could never fit; don't thrash
+        while self.bytes + nbytes > self.capacity and self._blocks:
+            self._evict_lru()
+        for pid in pids:
+            self.paged.alloc.ref(pid, cache_pin=True)
+        self._blocks[key] = _PagedEntry(
+            tokens=np.asarray(prompt_ids[:end], np.int32),
+            pids=list(pids), snap=snap, nbytes=nbytes)
+        self.bytes += nbytes
+        self.paged._publish()
+        SERVE_PREFIX_BYTES.set(self.bytes)
+
+    # -- eviction -----------------------------------------------------------
+
+    def _evict_lru(self) -> int:
+        """Drop the LRU entry; returns how many device blocks were
+        actually FREED (0 when every pinned block is still mapped by a
+        live slot)."""
+        _, old = self._blocks.popitem(last=False)
+        self.bytes -= old.nbytes
+        self.evictions += 1
+        SERVE_PREFIX_EVICTIONS.inc()
+        freed = sum(1 for pid in old.pids
+                    if self.paged.alloc.deref(pid, cache_pin=True))
+        SERVE_PREFIX_BYTES.set(self.bytes)
+        self.paged._publish()
+        return freed
+
+    def evict_for_pressure(self) -> int:
+        """Allocator pressure hook (PagedKV.evictor): evict LRU entries
+        until at least one block frees or the cache is empty. Returns
+        blocks freed (0 = nothing reclaimable — escalate to
+        preemption)."""
+        while self._blocks:
+            freed = self._evict_lru()
+            if freed:
+                return freed
+        return 0
+
+    def release_all(self) -> None:
+        """Drop every entry and its pins (engine rebuild/shutdown of the
+        paged pool; the allocator is being thrown away with us, so only
+        the bookkeeping needs to stay consistent)."""
+        while self._blocks:
+            self._evict_lru()
+
+    def occupancy(self) -> dict:
+        out = super().occupancy()
+        out["shared_blocks"] = self.paged.alloc.shared_count
+        out["unit_blocks"] = self.bpu
+        return out

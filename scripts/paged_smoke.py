@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Paged-KV gate (tiny CPU model, in-process):
+
+  1. greedy parity — paged engine output bit-identical to the sequential
+     (contiguous) path;
+  2. refcount prefix sharing — a second request with the same system
+     prefix reports skipped tokens, and the shared-blocks gauge goes
+     positive while it decodes (no KV copy, by construction);
+  3. preemption — a pool sized below the working set preempts a victim
+     and BOTH streams still finish bit-identical;
+  4. observability — cake_serve_kv_blocks_{free,used,shared} and
+     cake_serve_preemptions_total are present and non-zero in the
+     Prometheus exposition.
+
+Run via `make paged-smoke`.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                    # noqa: E402
+
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.obs import REGISTRY                          # noqa: E402
+from cake_tpu.ops.sampling import SamplingConfig           # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+
+GREEDY = SamplingConfig(temperature=0.0)
+CTX = 128
+CHUNK = 16
+SYS = [3 + (i * 7) % 200 for i in range(40)]
+P_A = [3, 17, 42, 99, 7]
+P_B = [100, 2, 5, 9, 11, 40]
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"FAIL: {msg}", file=sys.stderr)
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def main() -> int:
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=CTX)
+
+    # 1+2: parity and refcount sharing on a roomy pool
+    eng = ServeEngine(model, slots=2, max_queue=8, ctx_len=CTX,
+                      prefill_chunk=CHUNK, kv_blocks=24, kv_block_tokens=8,
+                      prefix_cache_mb=8)
+    try:
+        ref, _ = model.generate(SYS + [9, 11], max_new_tokens=8,
+                                sampling=GREEDY)
+        r = eng.submit(SYS + [9, 11], max_new_tokens=8, sampling=GREEDY)
+        check(r.wait(300) and r.result["tokens"] == ref,
+              "paged greedy bit-identical to sequential path")
+        rb = eng.submit(SYS + [77, 31], max_new_tokens=40, sampling=GREEDY)
+        deadline = time.monotonic() + 60
+        shared = 0
+        while time.monotonic() < deadline and not rb.done.is_set():
+            shared = max(shared, eng.paged.alloc.shared_count)
+            if shared and rb.tokens:
+                break
+            time.sleep(0.002)
+        rb.cancel()
+        rb.wait(60)
+        check(rb.stats.get("prefix_hit_tokens", 0) > 0,
+              f"prefix hit skipped {rb.stats.get('prefix_hit_tokens')} "
+              "tokens")
+        check(shared >= 2, f"blocks shared by refcount (peak {shared})")
+    finally:
+        eng.close()
+
+    # 3: preemption under a pool below the working set
+    eng = ServeEngine(model, slots=2, max_queue=8, ctx_len=CTX,
+                      prefill_chunk=CHUNK, kv_blocks=12, kv_block_tokens=8,
+                      prefix_cache_mb=0, preempt_mode="swap")
+    try:
+        ref_a, _ = model.generate(P_A, max_new_tokens=60, sampling=GREEDY)
+        ref_b, _ = model.generate(P_B, max_new_tokens=60, sampling=GREEDY)
+        ra = eng.submit(P_A, max_new_tokens=60, sampling=GREEDY)
+        rb = eng.submit(P_B, max_new_tokens=60, sampling=GREEDY)
+        check(ra.wait(600) and rb.wait(600), "both streams finished")
+        check(ra.result["tokens"] == ref_a
+              and rb.result["tokens"] == ref_b,
+              "bit-identical continuation across preempt-by-swap")
+        check(eng.paged.swaps >= 1, f"swap preemptions: {eng.paged.swaps}")
+    finally:
+        eng.close()
+
+    # 4: exposition carries the new instruments
+    text = REGISTRY.render()
+    for name in ("cake_serve_kv_blocks_free", "cake_serve_kv_blocks_used",
+                 "cake_serve_kv_blocks_shared",
+                 "cake_serve_preemptions_total"):
+        check(name in text, f"{name} exported")
+    check('cake_serve_preemptions_total{mode="swap"}' in text,
+          "preemption counter labeled by mode")
+    print("PAGED SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

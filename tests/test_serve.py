@@ -275,32 +275,35 @@ def test_engine_interleaves_short_past_long(model, engine):
     assert r_long.result["tokens"] == long_ref
 
 
-def test_engine_concurrent_wallclock(model, engine):
-    """4 concurrent requests complete in < 2x one request's wall-clock:
-    the batched decode amortizes the per-iteration cost across slots.
-    Generations are long enough that decode (the thing that batches)
-    dominates the 4 serialized admissions, and the measurement is
-    min-of-3 interleaved trials (timing on shared CI is noisy)."""
-    # warm every executable: long enough that occupancy actually reaches
-    # 4 (nb=1/2/4 buckets all compile before the timed region)
-    warm = [engine.submit(P_LONG, max_new_tokens=24, sampling=GREEDY)
+def test_engine_concurrent_overlap(model, engine):
+    """All 4 concurrent requests decode SIMULTANEOUSLY under iteration-
+    level batching: a moment exists where every request has emitted >= 1
+    token and none has finished. This is the scheduling property the old
+    wall-clock-ratio assert (t_four / t_single < 2) inferred from
+    timing — which flaked under CI machine load while passing standalone.
+    Occupancy is load-immune: contention slows the scheduler and the
+    poller together, and the overlap window only WIDENS (admissions
+    stagger by ~1 iteration, completions sit ~36 iterations later)."""
+    ref = _ref(model, P_LONG, 36)
+    assert len(ref) == 36                 # precondition: no early EOS
+    reqs = [engine.submit(P_LONG, max_new_tokens=36, sampling=GREEDY)
             for _ in range(4)]
-    assert all(r.wait(120) for r in warm)
-
-    ratios = []
-    for _ in range(3):
-        t0 = time.monotonic()
-        r = engine.submit(P_LONG, max_new_tokens=96, sampling=GREEDY)
-        assert r.wait(120)
-        t_single = time.monotonic() - t0
-
-        t0 = time.monotonic()
-        rs = [engine.submit(P_LONG, max_new_tokens=96, sampling=GREEDY)
-              for _ in range(4)]
-        assert all(r.wait(120) for r in rs)
-        t_four = time.monotonic() - t0
-        ratios.append(t_four / t_single)
-    assert min(ratios) < 2.0, ratios
+    overlap = False
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        counts = [len(r.tokens) for r in reqs]
+        done = [r.done.is_set() for r in reqs]
+        if all(done):
+            break
+        if all(c > 0 for c in counts) and not any(done):
+            overlap = True
+            break
+        time.sleep(0.002)
+    assert overlap, \
+        "4 concurrent requests never decoded simultaneously"
+    for r in reqs:
+        assert r.wait(300)
+        assert r.result["tokens"] == ref  # batching never costs parity
 
 
 def test_engine_cancel_frees_slot(model, engine):

@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench serve-bench-longtail paged-smoke chaos-smoke serve-chaos-smoke spec-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench serve-bench-longtail serve-bench-spec paged-smoke chaos-smoke serve-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -83,6 +83,20 @@ paged-smoke: lint
 # multi-token accept, non-zero cake_serve_spec_{proposed,accepted}_total
 spec-smoke:
 	JAX_PLATFORMS=cpu python scripts/spec_smoke.py
+
+# batched-speculation serve gate: concurrent API clients through the
+# PAGED speculating engine (no stand-down) — bit-identical greedy
+# outputs vs a spec-off engine, non-zero spec counters in /metrics,
+# batched spec block in /health
+spec-serve-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/spec_serve_smoke.py
+
+# batched-speculation bench: acceptance-rate x occupancy x effective
+# tok/s, spec on vs off, contiguous + paged engines; fails if greedy
+# parity breaks or the best effective speedup on templated traffic
+# lands under 1.3x. Writes BENCH_SERVE_<tag>.json.
+serve-bench-spec:
+	JAX_PLATFORMS=cpu python scripts/serve_bench.py --spec --tag spec
 
 # speculation bench: tokens/s + acceptance (accepted tokens per verify
 # step), spec on vs off, repetitive vs non-repetitive prompt. Writes

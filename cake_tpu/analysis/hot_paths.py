@@ -28,9 +28,11 @@ HOT_PATHS = frozenset({
     # path itself
     "cake_tpu/serve/supervisor.py",
     "cake_tpu/serve/faults.py",
-    # speculative decode: per verify step
+    # speculative decode: per verify step (drafting + accept/resample
+    # ride every batched spec iteration)
     "cake_tpu/spec/drafter.py",
     "cake_tpu/spec/verify.py",
+    "cake_tpu/ops/sampling.py",
     # cluster data plane: per hop
     "cake_tpu/cluster/master.py",
     "cake_tpu/cluster/worker.py",

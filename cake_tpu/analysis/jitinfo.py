@@ -19,11 +19,12 @@ __all__ = ["JitFn", "collect_jit_fns", "collect_attr_bindings",
 # `model` object the per-module AST cannot see into.
 KNOWN_DONATING_METHODS: dict[str, tuple[int, ...]] = {
     "decode_slots": (0, 1, 2, 3, 4),    # layers, toks, pos, rngs, recents
-    "spec_slot": (0, 1, 2, 3, 4),
+    "spec_slots": (0, 1, 2, 3, 4),
     "prefill_chunk": (0,),              # layers
     # paged variants: pool + rows donated, the block TABLE is not (the
     # engine remaps entries between iterations and keeps its handle)
     "decode_slots_paged": (0, 1, 3, 4, 5, 6),
+    "spec_slots_paged": (0, 1, 3, 4, 5, 6),
     "prefill_chunk_paged": (0, 1),
     "row_install": (0,),                # rows
     "row_reset": (0,),

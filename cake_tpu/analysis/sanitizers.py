@@ -49,7 +49,8 @@ def decode_fns(model) -> dict[str, object]:
     out = {}
     for name in ("_decode_slots", "_decode_slots_paged", "_decode_step",
                  "_decode_chunk", "_decode_until", "_prefill_slot",
-                 "_prefill_slot_paged", "_spec_slot", "_sample_traced"):
+                 "_prefill_slot_paged", "_spec_slots", "_spec_slots_paged",
+                 "_sample_traced"):
         fn = getattr(model, name, None)
         if fn is not None and hasattr(fn, "_cache_size"):
             out[name] = fn

@@ -132,10 +132,19 @@ _knob("CAKE_SPEC", str, None, "spec",
       'drafter for spec=None paths: "ngram" enables prompt-lookup '
       'speculation; unset/empty/"off" disables')
 _knob("CAKE_SPEC_K", int, 6, "spec",
-      "draft tokens proposed per verify step, clamped to [1, 32]")
-_knob("CAKE_SPEC_MAX_BUSY", int, 0, "spec",
-      "engine occupancy ceiling for speculation (above it the scheduler "
-      "falls back to plain batched decode); 0 means slots // 2")
+      "per-slot draft window: tokens proposed per verify step, clamped "
+      "to [1, 32]; in the serve engine every occupied slot carries its "
+      "own window through ONE batched verify dispatch (one executable "
+      "per slot-bucket, k static via the draft shape)")
+_knob("CAKE_SPEC_NGRAM", int, 3, "spec",
+      "n-gram drafter max match window: the prompt-lookup drafter "
+      "matches the last [2, this] tokens against the slot's own history "
+      "(bigger = more specific matches tried first)")
+_knob("CAKE_SPEC_RESERVE", int, 0, "spec",
+      "paged-mode speculative frontier-reservation cap, tokens per slot "
+      "per verify: draft windows are clamped so at most this much "
+      "unwritten frontier is backed by blocks ahead of the dispatch "
+      "(rolled back on rejection/preemption); 0 = the full draft window")
 
 # -- cluster --------------------------------------------------------------
 _knob("CAKE_CLUSTER_KEY", str, None, "cluster",

@@ -304,28 +304,6 @@ def truncate_layers(cfg: ModelConfig, layers: list[dict], new_end,
     return out
 
 
-def slot_truncate_layers(cfg: ModelConfig, pool_layers: list[dict], slot,
-                         new_end,
-                         layer_range: tuple[int, int] | None = None
-                         ) -> list[dict]:
-    """truncate_layers for ONE row of a batched cache pool: entries of row
-    `slot` at positions >= new_end become empty, other rows untouched —
-    the serve engine's per-slot speculative rollback. `slot`/`new_end`
-    may be traced scalars. Linear layers pass through (see
-    truncate_layers for the contract)."""
-    lo, hi = layer_range or (0, cfg.num_hidden_layers)
-    out = []
-    for i, pl in zip(range(lo, hi), pool_layers):
-        if cfg.layer_spec(i).kind == "linear":
-            out.append(pl)
-            continue
-        row = pl["pos"][slot]
-        out.append({"k": pl["k"], "v": pl["v"],
-                    "pos": pl["pos"].at[slot].set(
-                        jnp.where(row >= new_end, -1, row))})
-    return out
-
-
 def truncate_cache(cfg: ModelConfig, cache: dict, new_end: int,
                    layer_range: tuple[int, int] | None = None) -> dict:
     """Host-level cache rollback to positions < new_end (pos scalar
@@ -333,8 +311,9 @@ def truncate_cache(cfg: ModelConfig, cache: dict, new_end: int,
     suffix with this between proposals. Raises for linear-attention
     layers: their state cannot roll back, and a silent pass-through here
     would hand the caller a cache that CLAIMS new_end tokens but carries
-    state from more (slot_truncate_layers documents pass-through instead
-    because its in-trace caller handles linear commit itself)."""
+    state from more (truncate_layers documents pass-through instead
+    because its in-trace callers — the verify programs — handle the
+    linear commit themselves via a valid_len-masked re-forward)."""
     lo, hi = layer_range or (0, cfg.num_hidden_layers)
     for i in range(lo, hi):
         if cfg.layer_spec(i).kind == "linear":

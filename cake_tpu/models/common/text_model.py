@@ -37,13 +37,14 @@ import numpy as np
 
 from ...obs import (DECODE_TOKEN_SECONDS, GENERATED_TOKENS, RECORDER,
                     TTFT_SECONDS, now)
-from ...ops.sampling import (SamplingConfig, push_recent_token, sample,
-                             sample_traced, spec_accept)
+from ...ops.sampling import (SamplingConfig, config_has_filters,
+                             push_recent_token, sample, sample_traced,
+                             spec_accept)
 from .cache import (grow_cache, init_cache, kv_capacity, paged_block_of,
                     paged_gather_layer, paged_scatter_blocks,
                     slot_assign_layers, slot_extract_block_layers,
                     slot_reset_layers, slot_splice_block_layers,
-                    slot_truncate_layers, truncate_layers)
+                    truncate_layers)
 from .config import ModelConfig
 from .layers import embed_tokens, forward_layers, init_params, lm_head_logits
 
@@ -423,7 +424,7 @@ class TextModel:
         has_linear = any(s.kind == "linear" for s in cfg.layer_specs())
 
         def _verify_core(params, tokens, cache, pos0, n_input, draft, rng,
-                         recent, temp, top_k, top_p, penalty):
+                         recent, temp, top_k, top_p, penalty, filt=True):
             """tokens: [1, S] (S = K+1, entries >= n_input are padding);
             draft: [K]; n_input = n_draft + 1 (traced). Returns
             (n_acc, next_token, committed_cache, recent').
@@ -448,7 +449,8 @@ class TextModel:
             logits = lm_head_logits(cfg, params, x1)[0]        # [S, V]
             n_acc, nxt, recent = spec_accept(logits, draft, n_input - 1,
                                              rng, temp, top_k, top_p,
-                                             penalty, recent)
+                                             penalty, recent,
+                                             use_filters=filt)
             commit = n_acc + 1
             if has_linear:
                 _, committed = forward_layers(cfg, params, x, cache, pos0,
@@ -458,40 +460,99 @@ class TextModel:
                     cfg, c1["layers"], pos0 + commit), "pos": pos0 + commit}
             return n_acc, nxt, committed, recent
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
+        @functools.partial(jax.jit, donate_argnums=(2,),
+                           static_argnames=("filt",))
         def _spec_verify(params, tokens, cache, pos0, n_input, draft, rng,
-                         recent, temp, top_k, top_p, penalty):
-            """Batch-1 verify (the generate() speculative loop)."""
+                         recent, temp, top_k, top_p, penalty, filt):
+            """Batch-1 verify (the generate() speculative loop). `filt`
+            is the static no-vocab-filters escape hatch (one executable
+            per value — two at most)."""
             n_acc, nxt, cache, recent = _verify_core(
                 params, tokens, cache, pos0, n_input, draft, rng, recent,
-                temp, top_k, top_p, penalty)
+                temp, top_k, top_p, penalty, filt)
             return jnp.stack([n_acc, nxt]), cache, recent
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
-        def _spec_slot(params, layers, toks, pos, rngs, recents, slot,
-                       draft, n_draft, temp, top_k, top_p, penalty):
-            """Row-targeted verify for the serve engine: gather pool row
-            `slot` to a batch-1 view (the prefill_chunk idiom), verify the
-            drafts against it, scatter the committed row back, and advance
-            the slot's device-resident carries (token/pos/rng/recent) by
-            the accepted length. Returns (packed [3] = [input_token,
-            n_acc, next_token], layers, toks, pos, rngs, recents) — the
-            input token rides along so a just-activated slot's unemitted
-            first token still reaches the host in the same fetch."""
-            row = {"layers": jax.tree_util.tree_map(
-                lambda a: a[slot][None], layers), "pos": pos[slot]}
-            tok_in = toks[slot]
-            tokens = jnp.concatenate([tok_in[None], draft])[None, :]
-            rng, sub = jax.random.split(rngs[slot])
-            n_acc, nxt, committed, recent = _verify_core(
-                params, tokens, row, pos[slot], n_draft + 1, draft, sub,
-                recents[slot], temp, top_k, top_p, penalty)
+        @functools.partial(jax.jit, static_argnames=("nb", "filt"),
+                           donate_argnums=(1, 2, 3, 4, 5))
+        def _spec_slots(params, layers, toks, pos, rngs, recents, temps,
+                        top_ks, top_ps, penalties, active, drafts,
+                        n_drafts, nb, filt):
+            """Batched multi-token speculative verify over pool rows
+            0..nb-1 — the `_decode_slots` of the speculative path. Each
+            slot forwards [input_token, d_0 .. d_{k-1}] at its OWN
+            position in one vmapped program, runs the traced
+            accept/reject rule with its own sampling params, commits
+            exactly the accepted prefix, and advances its carries by
+            n_acc + 1. Acceptance is RAGGED per slot: a slot that rejects
+            at position 0 and a slot that accepts all k coexist in the
+            same executable (the rejected-suffix rollback is a per-row
+            pos truncation / valid_len-masked state commit, both traced).
+            A slot whose drafter abstained (n_drafts == 0) degenerates to
+            a plain decode step inside the same program, so mixed
+            draft/no-draft iterations never fall back to a second
+            dispatch. nb and `filt` (False = no slot in the dispatch
+            filters the vocabulary — the accept rule skips its per-row
+            sorts) are the only static arguments; the draft width k
+            rides the drafts shape — one executable per (slot-bucket, k,
+            filt), zero recompiles in steady state.
+
+            Inactive rows (free / mid-chunked-prefill) ride along frozen
+            exactly like _decode_slots: valid_len 0 drops the KV scatter
+            and freezes linear state, the truncate end sits past every
+            real entry, and every carry passes through unchanged."""
+            def one(tok, lcs, p, rng, recent, temp, tk, tp, pen, act,
+                    draft, ndr):
+                cache = {"layers": jax.tree_util.tree_map(
+                    lambda a: a[None], lcs), "pos": p}
+                tokens = jnp.concatenate([tok[None], draft])[None, :]
+                n_input = jnp.where(act, ndr + 1, 0)
+                x = embed_tokens(cfg, params, tokens)
+                x1, c1 = forward_layers(cfg, params, x, cache, p,
+                                        valid_len=n_input)
+                logits = lm_head_logits(cfg, params, x1)[0]     # [k+1, V]
+                rng2, sk = jax.random.split(rng)
+                n_acc, nxt, recent2 = spec_accept(
+                    logits, draft, ndr, sk, temp, tk, tp, pen, recent,
+                    use_filters=filt)
+                commit = n_acc + 1
+                if has_linear:
+                    _, committed = forward_layers(
+                        cfg, params, x, cache, p,
+                        valid_len=jnp.where(act, commit, 0))
+                    new_layers = committed["layers"]
+                else:
+                    new_layers = truncate_layers(
+                        cfg, c1["layers"],
+                        jnp.where(act, p + commit, jnp.int32(2**30)))
+                new_lcs = jax.tree_util.tree_map(lambda a: a[0],
+                                                 new_layers)
+                return (jnp.where(act, nxt, tok),
+                        jnp.where(act, n_acc, 0),
+                        jnp.where(act, commit, 0), new_lcs,
+                        jnp.where(act, rng2, rng),
+                        jnp.where(act, recent2, recent))
+
+            # lint: disable=recompile-hazard — nb is STATIC (slot_bucket powers of
+            # two) and the pool shape is fixed per engine: this branch resolves
+            # once per bucket at trace time, never per call
+            if nb == toks.shape[0]:
+                nxt, n_accs, adv, layers, rngs, recents = jax.vmap(one)(
+                    toks, layers, pos, rngs, recents, temps, top_ks,
+                    top_ps, penalties, active, drafts, n_drafts)
+                return (jnp.stack([toks, n_accs, nxt]), layers, nxt,
+                        pos + adv, rngs, recents)
+            sub = jax.tree_util.tree_map(lambda a: a[:nb], layers)
+            nxt, n_accs, adv, new_sub, new_rngs, new_recents = \
+                jax.vmap(one)(
+                    toks[:nb], sub, pos[:nb], rngs[:nb], recents[:nb],
+                    temps[:nb], top_ks[:nb], top_ps[:nb], penalties[:nb],
+                    active[:nb], drafts[:nb], n_drafts[:nb])
             layers = jax.tree_util.tree_map(
-                lambda full, r: full.at[slot].set(r[0]), layers,
-                committed["layers"])
-            return (jnp.stack([tok_in, n_acc, nxt]), layers,
-                    toks.at[slot].set(nxt), pos.at[slot].add(n_acc + 1),
-                    rngs.at[slot].set(rng), recents.at[slot].set(recent))
+                lambda full, s: full.at[:nb].set(s), layers, new_sub)
+            return (jnp.stack([toks[:nb], n_accs, nxt]), layers,
+                    toks.at[:nb].set(nxt), pos.at[:nb].add(adv),
+                    rngs.at[:nb].set(new_rngs),
+                    recents.at[:nb].set(new_recents))
 
         @functools.partial(jax.jit, static_argnames=("width",))
         def _slot_extract(layers, slot, start, width):
@@ -631,6 +692,102 @@ class TextModel:
                 new_rows.append(rl)
             return logits, new_pool, new_rows
 
+        @functools.partial(jax.jit, static_argnames=("nb", "filt"),
+                           donate_argnums=(1, 2, 4, 5, 6, 7))
+        def _spec_slots_paged(params, pool, rows, tables, toks, pos, rngs,
+                              recents, temps, top_ks, top_ps, penalties,
+                              active, drafts, n_drafts, nb, filt):
+            """_spec_slots over a paged pool: per slot, gather the logical
+            row view through the block table, verify [input, drafts] at
+            the slot's frontier, then write back ONLY the blocks holding
+            the COMMITTED positions p .. p+n_acc — the block cursor moves
+            by accepted length and speculative writes past it are dropped
+            (rejected drafts' KV never reaches the pool: positions at or
+            past the commit frontier are masked to -1 inside the written
+            window, and blocks wholly past it fall outside the window).
+            The engine must have reserved blocks for [p, p+n_drafts]
+            before dispatch (speculative frontier reservation). Inactive
+            rows ride along with every write dropped, exactly like
+            _decode_slots_paged."""
+            bt = next(pl["pos"].shape[1] for pl in pool if pl)
+            nblocks = next(pl["pos"].shape[0] for pl in pool if pl)
+            k = drafts.shape[1]
+
+            def one(table_row, rows_slot, tok, p, rng, recent, temp, tk,
+                    tp, pen, act, draft, ndr):
+                m = table_row.shape[0]
+                cache = _paged_row_cache(pool, rows_slot, table_row, p)
+                tokens = jnp.concatenate([tok[None], draft])[None, :]
+                n_input = jnp.where(act, ndr + 1, 0)
+                x = embed_tokens(cfg, params, tokens)
+                x1, c1 = forward_layers(cfg, params, x, cache, p,
+                                        valid_len=n_input)
+                logits = lm_head_logits(cfg, params, x1)[0]
+                rng2, sk = jax.random.split(rng)
+                n_acc, nxt, recent2 = spec_accept(
+                    logits, draft, ndr, sk, temp, tk, tp, pen, recent,
+                    use_filters=filt)
+                commit = jnp.where(act, n_acc + 1, 0)
+                if has_linear:
+                    _, committed = forward_layers(cfg, params, x, cache,
+                                                  p, valid_len=commit)
+                else:
+                    committed = c1
+                new_lcs = jax.tree_util.tree_map(lambda a: a[0],
+                                                 committed["layers"])
+                # write-back window: blocks b0..last_b hold the committed
+                # positions; sized statically by the draft width, slid
+                # (never clamped mid-block) like the prefill window
+                nwb = min(k // bt + 2, m)
+                b0 = p // bt
+                last_b = (p + jnp.maximum(commit, 1) - 1) // bt
+                shift = jnp.clip(b0, 0, m - nwb)
+                bidx = shift + jnp.arange(nwb, dtype=jnp.int32)
+                touched = jnp.logical_and(bidx >= b0, bidx <= last_b)
+                touched = jnp.logical_and(touched, act)
+                pids = jnp.where(touched, table_row[bidx], nblocks)
+                blks = []
+                new_rows = []
+                for pl, lc in zip(pool, new_lcs):
+                    if not pl:
+                        blks.append({})
+                        new_rows.append(lc)
+                        continue
+                    blk = {
+                        name: jax.lax.dynamic_slice_in_dim(
+                            lc[name], shift * bt, nwb * bt, axis=0
+                        ).reshape((nwb, bt) + lc[name].shape[1:])
+                        for name in ("k", "v", "pos")}
+                    # the speculative suffix never reaches the pool: a
+                    # swapped-out victim must not carry uncommitted KV
+                    blk["pos"] = jnp.where(blk["pos"] >= p + commit, -1,
+                                           blk["pos"])
+                    blks.append(blk)
+                    new_rows.append({})
+                return (jnp.where(act, nxt, tok),
+                        jnp.where(act, n_acc, 0), commit, blks, new_rows,
+                        pids, jnp.where(act, rng2, rng),
+                        jnp.where(act, recent2, recent))
+
+            rows_nb = jax.tree_util.tree_map(lambda a: a[:nb], rows)
+            (nxt, n_accs, adv, blks, new_rows, pids, new_rngs,
+             new_recents) = jax.vmap(one)(
+                tables[:nb], rows_nb, toks[:nb], pos[:nb], rngs[:nb],
+                recents[:nb], temps[:nb], top_ks[:nb], top_ps[:nb],
+                penalties[:nb], active[:nb], drafts[:nb], n_drafts[:nb])
+            flat_pids = pids.reshape(-1)        # [nb * nwb]
+            pool = [paged_scatter_blocks(
+                        pl, flat_pids, jax.tree_util.tree_map(
+                            lambda a: a.reshape((-1,) + a.shape[2:]), blk))
+                    if pl else pl
+                    for pl, blk in zip(pool, blks)]
+            rows = jax.tree_util.tree_map(
+                lambda full, s: full.at[:nb].set(s), rows, new_rows)
+            return (jnp.stack([toks[:nb], n_accs, nxt]), pool, rows,
+                    toks.at[:nb].set(nxt), pos.at[:nb].add(adv),
+                    rngs.at[:nb].set(new_rngs),
+                    recents.at[:nb].set(new_recents))
+
         @jax.jit
         def _paged_row_snapshot(rows, slot):
             """Batch-1 copy of one slot's UNPOOLED state (SWA rings +
@@ -652,7 +809,8 @@ class TextModel:
 
         self._prefill = _prefill
         self._spec_verify = _spec_verify
-        self._spec_slot = _spec_slot
+        self._spec_slots = _spec_slots
+        self._spec_slots_paged = _spec_slots_paged
         self._decode_slots = _decode_slots
         self._slot_assign = _slot_assign
         self._slot_reset = _slot_reset
@@ -856,26 +1014,44 @@ class TextModel:
                                  jnp.asarray(pos0, jnp.int32),
                                  jnp.asarray(n_draft + 1, jnp.int32),
                                  jnp.asarray(draft), rng, recent,
-                                 temp, top_k, top_p, pen)
+                                 temp, top_k, top_p, pen,
+                                 filt=config_has_filters(scfg))
 
-    def spec_slot(self, layers, toks, pos, rngs, recents, slot: int,
-                  draft_ids, k: int, scfg: SamplingConfig):
-        """Speculative verify step against pool row `slot` (the serve
-        engine's shallow-batch speculation unit): drafts are checked
-        against the row's KV in one program that also advances the slot's
-        device-resident token/pos/rng/recent carries by the accepted
-        length. Returns (packed [3] = [input_token, n_acc, next_token],
-        layers, toks, pos, rngs, recents)."""
-        draft = np.zeros((k,), np.int32)
-        n_draft = min(len(draft_ids), k)
-        draft[:n_draft] = np.asarray(list(draft_ids[:n_draft]), np.int32)
-        temp, top_k, top_p, pen = self._scfg_traced(scfg,
-                                                    self.cfg.vocab_size)
-        return self._spec_slot(self.params, layers, toks, pos, rngs,
-                               recents, jnp.asarray(slot, jnp.int32),
-                               jnp.asarray(draft),
-                               jnp.asarray(n_draft, jnp.int32),
-                               temp, top_k, top_p, pen)
+    def spec_slots(self, layers, toks, pos, rngs, recents, temps, top_ks,
+                   top_ps, penalties, active, drafts, n_drafts, nb: int,
+                   filt: bool = True):
+        """Batched multi-token speculative verify over pool rows 0..nb-1
+        (the serve engine's speculative iteration unit — decode_slots'
+        contract with a per-slot draft window). drafts: [B, k] int32
+        (host-built proposals, right-padded); n_drafts: [B] int32 valid
+        draft counts (0 = plain decode step for that slot). Acceptance is
+        ragged per slot; each slot's carries advance by its own accepted
+        length. `filt` (static): pass False when no slot in the dispatch
+        uses top-k/top-p — the accept rule skips its per-row sorts.
+        Returns (packed_ids [3, nb] = [input token ; n_acc ; next token]
+        per slot, layers, toks, pos, rngs, recents)."""
+        return self._spec_slots(self.params, layers, toks, pos, rngs,
+                                recents, temps, top_ks, top_ps, penalties,
+                                active, jnp.asarray(drafts, jnp.int32),
+                                jnp.asarray(n_drafts, jnp.int32), nb=nb,
+                                filt=bool(filt))
+
+    def spec_slots_paged(self, pool, rows, tables, toks, pos, rngs,
+                         recents, temps, top_ks, top_ps, penalties,
+                         active, drafts, n_drafts, nb: int,
+                         filt: bool = True):
+        """spec_slots over a paged pool: same contract, KV read/written
+        through `tables`. The caller must have reserved physical blocks
+        covering each slot's speculative frontier [pos, pos + n_drafts]
+        before dispatch; the program commits only the accepted prefix —
+        the block cursor moves by accepted length and speculative writes
+        past it are dropped. Returns (packed_ids [3, nb], pool, rows,
+        toks, pos, rngs, recents)."""
+        return self._spec_slots_paged(
+            self.params, pool, rows, tables, toks, pos, rngs, recents,
+            temps, top_ks, top_ps, penalties, active,
+            jnp.asarray(drafts, jnp.int32),
+            jnp.asarray(n_drafts, jnp.int32), nb=nb, filt=bool(filt))
 
     # -- inference ----------------------------------------------------------
 

@@ -118,6 +118,14 @@ SPEC_ACCEPTED_LEN = REGISTRY.histogram(
     "rejected; the step still emits its correction token)",
     buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
 
+SPEC_BUCKET_ACCEPTED = REGISTRY.histogram(
+    "cake_serve_spec_bucket_accepted_length",
+    "Accepted draft tokens per slot verify, labeled by the batched "
+    "dispatch's slot-count bucket — the acceptance x occupancy tradeoff "
+    "the serve bench reports",
+    labelnames=("bucket",),
+    buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+
 SERVE_QUEUE_TIMEOUTS = REGISTRY.counter(
     "cake_serve_queue_timeouts_total",
     "Requests expired in the admission queue past CAKE_QUEUE_DEADLINE_S "
@@ -225,4 +233,5 @@ __all__ = [
     "CLUSTER_STAGE_FAILURES", "CLUSTER_RECONNECTS",
     "CLUSTER_REPLAYS", "CLUSTER_DEGRADED", "CLUSTER_HOP_DEGRADED",
     "SPEC_PROPOSED", "SPEC_ACCEPTED", "SPEC_ACCEPTED_LEN",
+    "SPEC_BUCKET_ACCEPTED",
 ]

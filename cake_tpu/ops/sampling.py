@@ -159,6 +159,15 @@ def sample_traced(logits, rng, temperature, top_k, top_p, repeat_penalty,
     return jnp.where(temperature > 0.0, choice, order[0]).astype(jnp.int32)
 
 
+def config_has_filters(scfg: "SamplingConfig") -> bool:
+    """True when `scfg` actually filters the vocabulary (top-k or
+    top-p enabled) — the host-side gate for the verify programs' static
+    `use_filters` escape hatch. Greedy and pure-temperature configs
+    return False: their target distribution needs no sort."""
+    return scfg.top_k is not None or (
+        scfg.top_p is not None and scfg.top_p < 1.0)
+
+
 def push_recent_token(recent_tokens, token):
     """Shift a new token into the device-resident recent-token ring
     (drives the repeat penalty without host round-trips)."""
@@ -169,7 +178,7 @@ def push_recent_token(recent_tokens, token):
 
 
 def filtered_probs(logits, temperature, top_k, top_p, repeat_penalty,
-                   recent_tokens):
+                   recent_tokens, use_filters: bool = True):
     """The target distribution p the sampled decode path draws from, as an
     explicit [V] probability vector in VOCAB order — the quantity the
     speculative accept/reject rule needs (sample_traced only ever needs the
@@ -182,7 +191,15 @@ def filtered_probs(logits, temperature, top_k, top_p, repeat_penalty,
     the penalized argmax — ties split evenly, and downstream greedy
     consumers take jnp.argmax(p), which breaks ties to the lowest id
     exactly like sample_argmax.
-    """
+
+    `use_filters` is a STATIC escape hatch for callers that know top_k
+    and top_p are disabled for the whole dispatch (greedy and pure-
+    temperature traffic — the serve engine's common case): the sort that
+    serves the rank and cumulative-mass masks is skipped entirely and p
+    is the plain penalized/tempered softmax. XLA's CPU sort is slow
+    enough that it dominated the batched verify's accept rule; with
+    filters disabled the masks are identity, so skipping the sort is
+    exact (argmax and softmax are permutation-free)."""
     v = logits.shape[-1]
     lf = logits.astype(jnp.float32)
     idx = jnp.where(recent_tokens < 0, v, recent_tokens)
@@ -190,6 +207,8 @@ def filtered_probs(logits, temperature, top_k, top_p, repeat_penalty,
     penalized = jnp.where(lf >= 0, lf / repeat_penalty, lf * repeat_penalty)
     lf = jnp.where(flagged, penalized, lf)
     scaled = lf / jnp.maximum(temperature, 1e-6)
+    if not use_filters:
+        return jax.nn.softmax(scaled)
     order = jnp.argsort(-scaled)                       # stable: ties -> low id
     sorted_logits = scaled[order]
     rank = jnp.arange(v, dtype=jnp.int32)
@@ -203,7 +222,7 @@ def filtered_probs(logits, temperature, top_k, top_p, repeat_penalty,
 
 
 def spec_accept(logits, draft, n_draft, rng, temperature, top_k, top_p,
-                repeat_penalty, recent_tokens):
+                repeat_penalty, recent_tokens, use_filters: bool = True):
     """Traced speculative accept/reject loop (Leviathan et al. 2023; Chen
     et al. 2023) for a DETERMINISTIC drafter (point-mass q — the n-gram
     drafter and the greedy draft-model drafter both are).
@@ -228,27 +247,48 @@ def spec_accept(logits, draft, n_draft, rng, temperature, top_k, top_p,
     next_token pushed — positions later in the same verify step see
     earlier accepted tokens in their repeat-penalty window, matching the
     one-token-at-a-time path.
+
+    The rule is evaluated BATCHED, not as a sequential scan: row i's
+    outcome only matters when every earlier draft accepted (acceptance
+    is a prefix), so row i's target distribution may be computed under
+    the assumption that drafts 0..i-1 were pushed into the penalty
+    window — every row's filtered_probs runs in one vmap, the accepted
+    prefix length falls out of a cumulative product, and the per-row
+    penalty windows are a sliding gather over [recent ; draft]. A
+    sequential fori_loop here cost ~1 ms/step on CPU (it serialized k
+    sorts and k threefry folds) and dominated the whole batched-verify
+    dispatch; the vectorized rule is shape-identical and draws the SAME
+    per-row uniforms (fold_in(rng, i)), so outcomes are unchanged.
+
+    `use_filters` (STATIC) mirrors filtered_probs': pass False when the
+    caller knows every slot in the dispatch has top-k/top-p disabled and
+    the per-row sorts vanish.
     """
     k = draft.shape[0]
+    n = recent_tokens.shape[0]
     greedy = temperature <= 0.0
-
-    def body(i, carry):
-        n_acc, alive, recent = carry
-        p = filtered_probs(logits[i], temperature, top_k, top_p,
-                           repeat_penalty, recent)
-        d = draft[i]
-        u = jax.random.uniform(jax.random.fold_in(rng, i))
-        ok = jnp.where(greedy, d == jnp.argmax(p), u < p[d])
-        accept = alive & ok & (i < n_draft)
-        n_acc = n_acc + accept.astype(jnp.int32)
-        recent = jnp.where(accept, push_recent_token(recent, d), recent)
-        return n_acc, accept, recent
-
-    n_acc, _, recent = jax.lax.fori_loop(
-        0, k, body,
-        (jnp.asarray(0, jnp.int32), jnp.asarray(True), recent_tokens))
-    p = filtered_probs(logits[n_acc], temperature, top_k, top_p,
-                       repeat_penalty, recent)
+    # per-row penalty windows under the accepted-prefix assumption:
+    # win[i] = [recent ; draft][i : i+n] (row i sees drafts 0..i-1)
+    big = jnp.concatenate([recent_tokens, draft])
+    win = big[jnp.arange(k + 1)[:, None] + jnp.arange(n)[None, :]]
+    # S may be as small as n_draft + 1: clamp row gathers like the old
+    # traced logits[i] indexing did (rows past S are never accepted)
+    row = jnp.minimum(jnp.arange(k + 1), logits.shape[0] - 1)
+    probs = jax.vmap(
+        lambda lg, w: filtered_probs(lg, temperature, top_k, top_p,
+                                     repeat_penalty, w,
+                                     use_filters))(logits[row], win)
+    idx = jnp.arange(k, dtype=jnp.int32)
+    u = jax.vmap(lambda i: jax.random.uniform(jax.random.fold_in(rng, i)))(
+        idx)
+    p_draft = jnp.take_along_axis(probs[:k], draft[:, None], axis=1)[:, 0]
+    ok = jnp.where(greedy, draft == jnp.argmax(probs[:k], axis=1),
+                   u < p_draft)
+    ok = ok & (idx < n_draft)
+    # accepted prefix length: leading run of accepts
+    n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+    p = probs[n_acc]
+    recent = win[n_acc]
     # rejected at n_acc: resample from the residual (p minus the rejected
     # point mass, renormalized); all accepted: plain sample from p
     rejected = n_acc < n_draft

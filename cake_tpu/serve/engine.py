@@ -15,13 +15,19 @@ paging — slots here are whole KV rows of a preallocated batch-B cache):
     (`TextModel.prefill_chunk` scatters straight into the pool row at
     pos0), round-robin over in-flight prefills so a huge prompt cannot
     starve the queue behind it;
-  * each iteration also runs ONE batched `decode_slots` step over the
-    occupied prefix (per-slot positions, RNG keys, recent-token windows,
-    traced sampling params, and an `active` mask that freezes rows still
-    mid-prefill), fanning each slot's sampled token out to its request's
-    stream — decode latency under admission is bounded by the CHUNK, not
-    the prompt, which kills the head-of-line blocking a monolithic
-    prefill imposed on every active decode;
+  * each iteration also runs ONE batched step over the occupied prefix
+    (per-slot positions, RNG keys, recent-token windows, traced sampling
+    params, and an `active` mask that freezes rows still mid-prefill):
+    a plain `decode_slots` step, or — when a drafter is configured and
+    proposed for any slot — a batched multi-token `spec_slots` verify in
+    which every slot carries its own draft window and accepts a RAGGED
+    per-slot prefix (Leviathan-style speculative decoding folded into
+    continuous batching; the paged layout moves each slot's block cursor
+    by its accepted length). Either way the iteration fans each slot's
+    new tokens out to its request's stream — decode latency under
+    admission is bounded by the CHUNK, not the prompt, which kills the
+    head-of-line blocking a monolithic prefill imposed on every active
+    decode;
   * EOS / budget / client-cancel free the slot for the next admission.
 
 Every jax call happens on the scheduler thread, so the engine needs no
@@ -61,7 +67,7 @@ from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
                    SERVE_POISONED, SERVE_PREEMPTIONS, SERVE_QUEUE_TIMEOUTS,
                    SERVE_QUEUE_WAIT_SECONDS, SERVE_REQUEST_TIMEOUTS,
                    SERVE_SLOTS_BUSY, now, set_request_id)
-from ..ops.sampling import SamplingConfig
+from ..ops.sampling import SamplingConfig, config_has_filters
 from ..spec import resolve_drafter
 from ..spec.verify import record_step
 from . import faults
@@ -253,7 +259,7 @@ class ServeEngine:
                  queue_deadline_s: float | None = None,
                  request_deadline_s: float | None = None,
                  spec=None, spec_k: int | None = None,
-                 spec_max_busy: int | None = None,
+                 spec_reserve: int | None = None,
                  step_watchdog_s: float | None = None,
                  rebuild_budget: int | None = None,
                  rebuild_window_s: float | None = None,
@@ -311,14 +317,16 @@ class ServeEngine:
         if request_deadline_s is None:
             request_deadline_s = knobs.get("CAKE_REQUEST_DEADLINE_S")
         self.request_deadline_s = request_deadline_s
-        # -- speculative decoding: shallow-batch greedy slots only --------
+        # -- speculative decoding: batched over every occupied slot ------
         # CAKE_SPEC names the drafter ("ngram"; unset = off), CAKE_SPEC_K
-        # the draft width, CAKE_SPEC_MAX_BUSY the occupancy ceiling
-        # (default slots // 2): a shallow batch leaves the MXUs idle, so a
-        # verify step converts that idle compute into accepted tokens —
-        # but a SATURATED pool is already compute-efficient, and per-slot
-        # verify calls would serialize what one batched decode step does
-        # in parallel, so speculation must stand down as occupancy rises.
+        # the per-slot draft window. Speculation rides the SAME batched
+        # iteration as plain decode: every occupied slot carries its own
+        # draft window through one spec_slots dispatch with ragged
+        # per-slot acceptance, so there is no occupancy cliff and no
+        # paged-mode stand-down — a slot whose drafter abstains simply
+        # takes a plain decode step inside the same executable.
+        # CAKE_SPEC_RESERVE caps how much speculative frontier a paged
+        # slot may reserve ahead of a verify (0 = the full window).
         drafter, k = resolve_drafter(spec, spec_k)
         if drafter is not None and not drafter.shareable:
             raise ValueError(
@@ -327,11 +335,14 @@ class ServeEngine:
                 "(DraftModelDrafter belongs on the generate() path)")
         self.spec_drafter = drafter
         self.spec_k = k
-        if spec_max_busy is None:
-            spec_max_busy = knobs.get("CAKE_SPEC_MAX_BUSY") \
-                or max(1, slots // 2)
-        self.spec_max_busy = spec_max_busy
+        if spec_reserve is None:
+            spec_reserve = knobs.get("CAKE_SPEC_RESERVE")
+        self.spec_reserve = max(int(spec_reserve), 0)
         self.spec_steps = self.spec_proposed = self.spec_accepted = 0
+        # this iteration's per-slot draft lengths (slot -> n_draft):
+        # the speculative-frontier trim must keep blocks the PENDING
+        # verify dispatch will write, so rollback reads it
+        self._cur_nd: dict[int, int] = {}
         self._draining = threading.Event()
 
         self._seed = seed
@@ -561,7 +572,7 @@ class ServeEngine:
             h["spec"] = {
                 "drafter": self.spec_drafter.name,
                 "k": self.spec_k,
-                "max_busy": self.spec_max_busy,
+                "mode": "batched",
                 "steps": self.spec_steps,
                 "proposed": self.spec_proposed,
                 "accepted": self.spec_accepted,
@@ -803,13 +814,13 @@ class ServeEngine:
                 # report idle so _run waits on the wake event (0.5s
                 # heartbeat retries the resume) instead of hot-spinning
                 return False
-            # 3. dispatch ONE batched decode step over the slots whose
-            # prefill has completed (mid-prefill rows ride along frozen
-            # under the active mask)... unless the batch is SHALLOW and
-            # all-greedy, in which case each slot takes a speculative
-            # verify step instead (draft k, verify once, emit 1..k+1) —
-            # occupancy above spec_max_busy falls back to plain batched
-            # decode so speculation never slows a saturated pool
+            # 3. dispatch ONE batched step over the slots whose prefill
+            # has completed (mid-prefill rows ride along frozen under the
+            # active mask): a speculative verify step when the drafter
+            # proposed for ANY slot — every slot's draft window rides the
+            # same dispatch with ragged per-slot acceptance — else a
+            # plain batched decode. Both paths cost exactly one device
+            # call and one fetch per iteration.
             # 3a. choose the admission to advance this iteration (round-
             # robin) and, in paged mode, reserve its chunk's blocks NOW —
             # BEFORE the decode dispatch. The reservation may preempt a
@@ -817,6 +828,7 @@ class ServeEngine:
             # a swap-out after the decode was dispatched would capture
             # post-step carries holding a sampled token the host never
             # fanned out, silently dropping it from the stream on resume
+            self._cur_nd = {}
             pf_job = None
             if self._prefills:
                 pf_job = self._prefills[self._rr % len(self._prefills)]
@@ -825,17 +837,21 @@ class ServeEngine:
             prefilling = {p.slot for p in self._prefills}   # post-admission
             active = [i for i in self.pool.busy()
                       if self._reqs[i] is not None and i not in prefilling]
+            # 3b. host-side draft building (the n-gram lookup runs while
+            # the PREVIOUS iteration's prefill chunk is still on the
+            # device — host work here is overlapped, not serialized)
+            spec_job = None
+            if active and self.spec_drafter is not None:
+                spec_job = self._build_drafts(active)
             if self.paged is not None and active:
-                # every decoding slot needs its write-frontier block
+                # every decoding slot needs blocks for its write frontier
+                # — and, when speculating, its whole draft window —
                 # mapped BEFORE dispatch; exhaustion preempts a victim
                 # (which may shrink `active`) — see _ensure_decode_blocks
-                active = self._ensure_decode_blocks(active)
+                active = self._ensure_decode_blocks(active, spec_job)
             packed = None
             active_ids = tuple(self._reqs[i].id for i in active)
-            if self._spec_eligible(active):
-                for i in active:
-                    self._spec_step(i)
-            elif active:
+            if active:
                 nb = slot_bucket(active[-1] + 1, self.slots)
                 SERVE_BATCH_OCCUPANCY.observe(len(active))
                 # arm BEFORE the fault hook: an injected stall simulates a
@@ -845,7 +861,38 @@ class ServeEngine:
                 hook = faults.FAULT_HOOK
                 if hook is not None:
                     hook.on_decode([self._reqs[i] for i in active])
-                if self.paged is not None:
+                if spec_job is not None:
+                    drafts, n_drafts = spec_job
+                    # static no-vocab-filters fast path: when no slot in
+                    # the dispatch uses top-k/top-p the accept rule skips
+                    # its per-row sorts (at most one extra executable per
+                    # bucket — traffic mixes flip between two programs,
+                    # both warm in steady state)
+                    filt = any(config_has_filters(self._reqs[i].sampling)
+                               for i in active)
+                    with RECORDER.span("spec.verify", cat="serve",
+                                       slots=len(active),
+                                       drafts=int(n_drafts.sum())):
+                        if self.paged is not None:
+                            (packed, self.paged.pool, self.paged.rows,
+                             self._toks, self._pos, self._rngs,
+                             self._recents) = self.model.spec_slots_paged(
+                                self.paged.pool, self.paged.rows,
+                                self.paged.tables, self._toks, self._pos,
+                                self._rngs, self._recents, self._temps,
+                                self._top_ks, self._top_ps, self._pens,
+                                self._act, drafts, n_drafts, nb=nb,
+                                filt=filt)
+                        else:
+                            (packed, self._layers, self._toks, self._pos,
+                             self._rngs,
+                             self._recents) = self.model.spec_slots(
+                                self._layers, self._toks, self._pos,
+                                self._rngs, self._recents, self._temps,
+                                self._top_ks, self._top_ps, self._pens,
+                                self._act, drafts, n_drafts, nb=nb,
+                                filt=filt)
+                elif self.paged is not None:
                     (packed, self.paged.pool, self.paged.rows, self._toks,
                      self._pos, self._rngs,
                      self._recents) = self.model.decode_slots_paged(
@@ -880,9 +927,15 @@ class ServeEngine:
                 # even if a prefill chunk was dispatched in between
                 self.supervisor.arm("decode", active_ids)
                 # lint: disable=host-sync — THE one planned fetch per iteration: the
-                # packed [input;sampled] ids for every slot in one
-                # transfer, after the next work is already dispatched
-                self._fanout(active, np.asarray(packed))
+                # packed ids ([input;sampled], or [input;n_acc;next] on a
+                # speculative iteration) for every slot in one transfer,
+                # after the next work is already dispatched
+                arr = np.asarray(packed)
+                if spec_job is not None:
+                    self._fanout_spec(active, arr, spec_job[0],
+                                      spec_job[1], nb)
+                else:
+                    self._fanout(active, arr)
         return True
 
     # -- chunked admission --------------------------------------------------
@@ -1099,21 +1152,38 @@ class ServeEngine:
                 return False
         return True
 
-    def _ensure_decode_blocks(self, active: list[int]) -> list[int]:
-        """Map the write-frontier block of every decoding slot before
-        the batched dispatch (a decode step writes position p into table
-        entry p // block_tokens; p is derivable host-side from the token
-        record, so steady state ships nothing extra). Exhaustion evicts
-        prefix-cache LRU, then preempts a victim; a slot that cannot
-        grow with NOTHING left to reclaim is failed typed rather than
-        wedging the scheduler. Returns the surviving active list
-        (preemption and failure both shrink it)."""
+    def _ensure_decode_blocks(self, active: list[int],
+                              spec_job=None) -> list[int]:
+        """Back every decoding slot's write reach with physical blocks
+        before the batched dispatch: the write-frontier block for a plain
+        decode step, the whole speculative frontier [wp, wp + n_draft]
+        when the slot carries a draft window (the verify may commit up to
+        n_draft + 1 positions — reserving past the frontier is what lets
+        the block cursor move by accepted length without a mid-program
+        allocation). Exhaustion evicts prefix-cache LRU, then rolls back
+        other slots' speculative tails, then preempts a victim; a slot
+        that cannot grow with NOTHING left to reclaim is failed typed
+        rather than wedging the scheduler. Returns the surviving active
+        list (preemption and failure both shrink it)."""
+        n_drafts = spec_job[1] if spec_job is not None else None
         for i in active:
             req = self._reqs[i]
             if req is None:
                 continue        # preempted by an earlier slot's ensure
             wp = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
-            while not self.paged.ensure(i, wp // self.paged.bt):
+            reach = 1 + (int(n_drafts[i]) if n_drafts is not None else 0)
+            while not self.paged.reserve_range(i, wp, reach):
+                if reach > 1:
+                    # speculation never costs anyone their blocks: under
+                    # pressure the slot DROPS its draft window to a plain
+                    # decode step (n_drafts gates it out of the dispatch)
+                    # and retries with just the write-frontier block —
+                    # preemption and typed failure stay reserved for the
+                    # growth a non-speculating engine would need too
+                    reach = 1
+                    n_drafts[i] = 0
+                    self._cur_nd[i] = 0
+                    continue
                 if not self._preempt_one(exclude=i):
                     req.result["error"] = KVPoolExhausted(
                         f"KV pool exhausted: request {req.id} cannot "
@@ -1124,11 +1194,15 @@ class ServeEngine:
         return [i for i in active if self._reqs[i] is not None]
 
     def _preempt_one(self, exclude: int) -> bool:
-        """Free blocks by evicting one victim: a DECODING slot first
-        (latest admission — the cheapest to redo, and the oldest request
-        can never be starved by newcomers), else the youngest OTHER
-        in-flight admission goes back to readmission (it has emitted
-        nothing, so a restart is clean). False = nothing to preempt."""
+        """Free blocks by reclaiming the cheapest thing first: other
+        slots' speculative frontier tails (pure rollback — nobody loses
+        work), then a DECODING victim (latest admission — the cheapest
+        to redo, and the oldest request can never be starved by
+        newcomers), else the youngest OTHER in-flight admission goes
+        back to readmission (it has emitted nothing, so a restart is
+        clean). False = nothing left to reclaim or preempt."""
+        if self.spec_drafter is not None and self._trim_spec_tails(exclude):
+            return True
         prefilling = {p.slot for p in self._prefills}
         cands = [(i, self._reqs[i]) for i in self.pool.busy()
                  if i not in prefilling]
@@ -1151,6 +1225,11 @@ class ServeEngine:
         parity rule)."""
         wp = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
         if self.preempt_mode == "swap":
+            # roll back the speculative frontier first: a swapped-out
+            # victim must carry only COMMITTED state — uncommitted
+            # draft-window blocks return to the pool instead of riding
+            # the blob into host RAM and back
+            self.paged.trim_to(slot, wp)
             blob = self.paged.swap_out(
                 slot, (self._toks, self._pos, self._rngs, self._recents))
             entry = PreemptedSlot(req, "swap", wp, blob)
@@ -1435,69 +1514,99 @@ class ServeEngine:
         self.prefix_cache = None
         SERVE_SLOTS_BUSY.set(0)
 
-    # -- speculative decode (shallow batch) ---------------------------------
+    # -- speculative decode (batched, accept-aware) -------------------------
 
-    def _spec_eligible(self, active: list[int]) -> bool:
-        """Speculate THIS iteration? All-or-nothing per iteration: every
-        active slot must be greedy (the engine verifies with the slot's
-        own sampling params, but mixed spec/decode iterations would need
-        a partial active mask — not worth it at the shallow occupancies
-        where speculation pays), past its first-token fetch (the verify
-        input token must be known to the drafter's host-side sequence, up
-        to the one unfetched input the packed result carries), and the
-        occupancy must not exceed spec_max_busy."""
-        if self.spec_drafter is None or not active:
-            return False
-        if self.paged is not None:
-            # spec_slot has no block-table variant yet: ragged multi-token
-            # advance over paged blocks is the ROADMAP follow-up
-            return False
-        if len(active) > self.spec_max_busy:
-            return False
+    def _build_drafts(self, active: list[int]):
+        """Host-side draft windows for this iteration's batched verify:
+        the shared drafter proposes up to spec_k continuation tokens per
+        slot from the slot's own committed token history (prompt +
+        generated — the drafter-free n-gram mode needs no weights and no
+        device work, and the lookup overlaps the previous iteration's
+        still-queued prefill chunk). Slots the drafter abstains on, slots
+        whose first token the host has not fetched yet, and slots out of
+        budget/context headroom get an empty window — they take a plain
+        decode step INSIDE the same dispatch. Returns (drafts [B, k]
+        int32, n_drafts [B] int32), or None when every window came back
+        empty (the iteration then dispatches the cheaper width-1 decode
+        program)."""
+        k = self.spec_k
+        drafts = np.zeros((self.slots, k), np.int32)
+        n_drafts = np.zeros((self.slots,), np.int32)
+        any_draft = False
         for i in active:
             req = self._reqs[i]
-            if req.sampling.temperature > 0 or req._first_pending:
-                return False
-        return True
+            if req._first_pending:
+                continue        # newest token still rides the next fetch
+            pos = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
+            ki = min(k, self.ctx - pos - 1, max(req.budget, 0))
+            if self.paged is not None and self.spec_reserve > 0:
+                # frontier-reservation cap: never back more speculative
+                # frontier with blocks than CAKE_SPEC_RESERVE tokens
+                ki = min(ki, self.spec_reserve)
+            if ki <= 0:
+                continue
+            d = list(self.spec_drafter.propose(
+                req.prompt_ids + req.tokens, ki))[:ki]
+            if not d:
+                continue
+            drafts[i, :len(d)] = d
+            n_drafts[i] = len(d)
+            self._cur_nd[i] = len(d)
+            any_draft = True
+        return (drafts, n_drafts) if any_draft else None
 
-    def _spec_step(self, slot: int):
-        """One speculative verify step for `slot`: host drafter proposes
-        from the request's committed sequence, the row-targeted verify
-        program checks all proposals in one device call, and the fetched
-        (input, n_acc, next) triple fans 1..k+1 tokens into the stream."""
-        req = self._reqs[slot]
-        pos = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
-        k = min(self.spec_k, self.ctx - pos - 1, max(req.budget, 0))
-        draft = list(self.spec_drafter.propose(
-            req.prompt_ids + req.tokens, k))[:k] if k > 0 else []
-        set_request_id(req.id)
-        try:
-            with RECORDER.span("spec.verify", cat="serve", slot=slot,
-                               drafts=len(draft), pos=pos):
-                self.supervisor.arm("spec", (req.id,))
-                hook = faults.FAULT_HOOK
-                if hook is not None:
-                    hook.on_decode([req])
-                (packed, self._layers, self._toks, self._pos, self._rngs,
-                 self._recents) = self.model.spec_slot(
-                    self._layers, self._toks, self._pos, self._rngs,
-                    self._recents, slot, draft, self.spec_k, req.sampling)
-                # lint: disable=host-sync — the verify step's one planned fetch:
-                # (input, n_acc, next) in a single small transfer
-                arr = np.asarray(packed)
-        finally:
-            set_request_id(None)
-        n_acc, nxt = int(arr[1]), int(arr[2])
-        self.spec_steps += 1
-        self.spec_proposed += len(draft)
-        self.spec_accepted += n_acc
-        record_step(len(draft), n_acc)
-        for t in draft[:n_acc] + [nxt]:
-            req.budget -= 1
-            self._emit(req, t)
-            if self.model.cfg.is_eos(t) or req.budget <= 0:
-                self._finish(slot, req)
-                return
+    def _trim_spec_tails(self, exclude: int | None = None) -> bool:
+        """Pressure-relief ROLLBACK of speculative frontier reservations:
+        blocks mapped past what each slot's committed tokens plus its
+        PENDING draft window need are returned to the pool — strictly
+        cheaper than preempting a victim, so the exhaustion path tries
+        this first. Keeps every block the in-flight or about-to-dispatch
+        verify may still write (the _cur_nd window). True = at least one
+        block freed (the caller retries its allocation)."""
+        freed = 0
+        for i in self.pool.busy():
+            if i == exclude:
+                continue
+            req = self._reqs[i]
+            if req is None:
+                continue
+            wp = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
+            freed += self.paged.trim_to(
+                i, wp + self._cur_nd.get(i, 0) + 1)
+        return freed > 0
+
+    def _fanout_spec(self, active: list[int], arr: np.ndarray, drafts,
+                     n_drafts, nb: int):
+        """Fan one speculative iteration's packed ids out to the streams:
+        row 0 carries each slot's input token (a just-activated slot's
+        unemitted FIRST token), row 1 its accepted-draft count, row 2 the
+        verify step's correction/bonus token. The host already knows the
+        drafts it proposed, so n_acc + 1 tokens per slot ride a fetch no
+        bigger than the plain decode path's."""
+        for i in active:
+            req = self._reqs[i]
+            if req._first_pending:
+                req._first_pending = False
+                req.t_first = now()
+                req.stats["ttft_s"] = req.t_first - req.t_enqueue
+                first = int(arr[0, i])
+                self._emit(req, first)
+                if self.model.cfg.is_eos(first) or req.budget <= 0:
+                    self._finish(i, req)
+                    continue
+            n_prop = int(n_drafts[i])
+            n_acc, nxt = int(arr[1, i]), int(arr[2, i])
+            if n_prop:
+                self.spec_steps += 1
+                self.spec_proposed += n_prop
+                self.spec_accepted += n_acc
+                record_step(n_prop, n_acc, bucket=nb)
+            for t in list(drafts[i, :n_acc]) + [nxt]:
+                req.budget -= 1
+                self._emit(req, int(t))
+                if self.model.cfg.is_eos(int(t)) or req.budget <= 0:
+                    self._finish(i, req)
+                    break
 
     # -- batched decode -----------------------------------------------------
 
@@ -1582,7 +1691,9 @@ def maybe_engine(model, slots: int | None = None,
     for a shared block pool with refcounted prefix sharing and
     preemption — see docs/serving.md#paged-kv-pool),
     the speculative-decoding knobs CAKE_SPEC / CAKE_SPEC_K /
-    CAKE_SPEC_MAX_BUSY (see docs/speculative.md), and the supervision
+    CAKE_SPEC_NGRAM / CAKE_SPEC_RESERVE (batched draft/verify/accept
+    rides the same slot iteration — see docs/speculative.md), and the
+    supervision
     knobs CAKE_STEP_WATCHDOG_S / CAKE_ENGINE_REBUILDS /
     CAKE_ENGINE_REBUILD_WINDOW_S / CAKE_ENGINE_RESTORE_S /
     CAKE_REQUEST_DEADLINE_S (see docs/fault_tolerance.md) — all read

@@ -121,6 +121,16 @@ class BlockAllocator:
         self.tables[slot][idx] = pid
         return pid
 
+    def unmap_entry(self, slot: int, idx: int) -> bool:
+        """Unmap ONE table entry (deref; shared blocks survive under
+        their other owners). Returns True when the block was actually
+        FREED — the speculative-frontier rollback unit."""
+        pid = self.tables[slot][idx]
+        if pid == self.NULL:
+            return False
+        self.tables[slot][idx] = self.NULL
+        return self.deref(pid)
+
     def unmap_slot(self, slot: int) -> list[int]:
         """Release every block the slot maps (deref; shared blocks
         survive under their other owners). Returns the pids that were

@@ -174,6 +174,30 @@ class PagedKV:
             self.sync_table_row(slot)
         return not short
 
+    def trim_to(self, slot: int, tokens: int) -> int:
+        """Speculative-frontier ROLLBACK: unmap every table entry past
+        the blocks a `tokens`-token sequence occupies. The batched
+        verify reserves blocks for the widest possible accept
+        ([frontier, frontier + k]); after a rejection — or before a
+        swap-out — the tail past the committed frontier is speculative
+        over-reservation and this returns it to the pool. Freed blocks
+        were exclusively owned (the frontier never maps shared blocks),
+        and their bytes need no wipe: the committed write-back already
+        masked uncommitted positions, and the gather's stale-tenant
+        guard covers recycling. Returns the number of blocks freed."""
+        keep = self.blocks_for(max(tokens, 1))
+        freed = 0
+        touched = False
+        for idx in range(keep, self.max_blocks):
+            if self.alloc.tables[slot][idx] == self.NULL:
+                continue
+            touched = True
+            if self.alloc.unmap_entry(slot, idx):
+                freed += 1
+        if touched:
+            self.sync_table_row(slot)
+        return freed
+
     def map_shared(self, slot: int, block_idx: int, pid: int) -> None:
         """Point (slot, block_idx) at an existing block, sharing it
         (refcount bump — the paged prefix hit; NO bytes move)."""

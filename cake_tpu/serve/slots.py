@@ -24,6 +24,23 @@ def slot_bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def slot_buckets(cap: int) -> tuple[int, ...]:
+    """The bucket ladder a cap-slot pool can dispatch at: 1, 2, 4, ...
+    cap (cap itself included even when not a power of two). Scaling
+    CAKE_SERVE_SLOTS from 4 to 8/16 adds exactly ONE rung per doubling —
+    a bucket transition compiles only the new bucket's executable, and
+    existing rungs keep their compiled programs (pinned in
+    tests/test_spec_serve.py). Warmup code and benches iterate this
+    ladder instead of hand-rolling powers of two."""
+    out = []
+    b = 1
+    while b < cap:
+        out.append(b)
+        b <<= 1
+    out.append(cap)
+    return tuple(out)
+
+
 class SlotPool:
     def __init__(self, n: int):
         if n < 1:

@@ -13,15 +13,19 @@ keeps the target distribution (Leviathan et al. 2023; Chen et al. 2023).
 Layout:
   drafter.py — Drafter protocol, NGramDrafter (zero-weight prompt
                lookup), DraftModelDrafter (two-model speculation)
-  verify.py  — the host loop + shared spec metrics; the traced pieces
-               are ops/sampling.spec_accept and TextModel's verify
-               programs (models/common/text_model.py), with the
-               rejected-suffix rollback in cache.{truncate_layers,
-               slot_truncate_layers}
+  verify.py  — the generate()-path host loop + shared spec metrics;
+               the traced pieces are ops/sampling.spec_accept (batched
+               accept/resample) and TextModel's verify programs
+               (verify_tokens batch-1; spec_slots/spec_slots_paged —
+               the serve engine's batched multi-token verify with
+               ragged per-slot acceptance), with the rejected-suffix
+               rollback in cache.truncate_layers (contiguous) and the
+               paged write-back's commit mask
 
 Entry points: TextModel.generate(spec=..., spec_k=...) and the serve
-engine's slot-occupancy-aware speculation (serve/engine.py); env knobs
-CAKE_SPEC / CAKE_SPEC_K / CAKE_SPEC_MAX_BUSY. See docs/speculative.md.
+engine's batched accept-aware iteration (serve/engine.py); env knobs
+CAKE_SPEC / CAKE_SPEC_K / CAKE_SPEC_NGRAM / CAKE_SPEC_RESERVE. See
+docs/speculative.md.
 """
 from .drafter import (DEFAULT_SPEC_K, Drafter, DraftModelDrafter,
                       MAX_SPEC_K, NGramDrafter, resolve_drafter)

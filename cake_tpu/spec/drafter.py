@@ -64,11 +64,16 @@ class NGramDrafter:
 
     Matches the last m tokens (m from max_ngram down to min_ngram)
     against the earlier sequence; on a hit, proposes the k tokens that
-    followed the MOST RECENT earlier occurrence. Abstains when nothing
-    repeats — a random prompt costs speculation nothing, a repetitive
-    one (quote the context, fix this code, summarize) gets multi-token
-    accepts for free. min_ngram >= 2 by default so single-token
-    coincidences don't spray junk proposals.
+    followed the most recent earlier occurrence WITH A FULL k-token
+    continuation (matches near the sequence end can only offer a stub —
+    a 1-token proposal wastes the verify's amortized weight read, so a
+    slightly older occurrence that fills the whole draft window beats a
+    fresher one that cannot; when no occurrence fills it, the longest
+    available continuation wins). Abstains when nothing repeats — a
+    random prompt costs speculation nothing, a repetitive one (quote
+    the context, fix this code, summarize) gets multi-token accepts for
+    free. min_ngram >= 2 by default so single-token coincidences don't
+    spray junk proposals.
     """
 
     name = "ngram"
@@ -96,7 +101,10 @@ class NGramDrafter:
             hits = np.nonzero((windows == suffix).all(axis=1))[0]
             if hits.size == 0:
                 continue
-            j = int(hits[-1])                   # most recent occurrence
+            # most recent occurrence whose continuation fills the whole
+            # draft window; else the longest continuation on offer
+            full = hits[hits + m + k <= n]
+            j = int(full[-1]) if full.size else int(hits[0])
             cont = arr[j + m:j + m + k]
             if cont.size:
                 return [int(t) for t in cont]
@@ -186,7 +194,8 @@ def resolve_drafter(spec, k: int | None = None):
     spec: None reads env CAKE_SPEC ("" / unset = off, "ngram" = prompt
     lookup); False forces off; "ngram" / a Drafter instance / a draft
     TextModel are taken as-is. k defaults from CAKE_SPEC_K, clamped to
-    [1, 32].
+    [1, 32]; the n-gram drafter's match window comes from
+    CAKE_SPEC_NGRAM (max match length, min stays 2).
     """
     if k is None:
         k = knobs.get("CAKE_SPEC_K")
@@ -200,7 +209,11 @@ def resolve_drafter(spec, k: int | None = None):
         if s in ("", "0", "off", "none", "false"):
             return None, k
         if s in ("ngram", "prompt", "prompt_lookup", "lookup"):
-            return NGramDrafter(), k
+            # clamp to >= 2: min_ngram stays at the documented
+            # junk-proposal guard (single-token coincidences must never
+            # spray k-token drafts through the wider verify forward)
+            mg = max(2, int(knobs.get("CAKE_SPEC_NGRAM")))
+            return NGramDrafter(max_ngram=mg), k
         raise ValueError(
             f"unknown drafter {spec!r}: pass 'ngram', a Drafter instance, "
             "or a draft TextModel")

@@ -1,9 +1,11 @@
 """Host-side speculative decode loop: draft -> verify -> emit.
 
 The traced pieces live elsewhere — ops/sampling.spec_accept (the
-Leviathan/Chen accept/reject rule), TextModel._spec_verify /._spec_slot
-(one bucketed forward + acceptance + rejected-suffix rollback per device
-call) — this module owns what must stay on the host: asking the drafter,
+Leviathan/Chen accept/reject rule, batched), TextModel._spec_verify /
+._spec_slots / ._spec_slots_paged (one bucketed forward + acceptance +
+rejected-suffix rollback per device call; the _slots variants serve the
+engine's batched ragged-acceptance iteration) — this module owns what
+must stay on the host: asking the drafter,
 growing the KV bucket, truncating emission at EOS / budget, and the spec
 metrics every path shares (cake_serve_spec_{proposed,accepted}_total +
 the accepted-length histogram).
@@ -13,16 +15,21 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..obs import RECORDER, SPEC_ACCEPTED, SPEC_ACCEPTED_LEN, SPEC_PROPOSED
+from ..obs import (RECORDER, SPEC_ACCEPTED, SPEC_ACCEPTED_LEN,
+                   SPEC_BUCKET_ACCEPTED, SPEC_PROPOSED)
 
 
-def record_step(n_proposed: int, n_acc: int) -> None:
+def record_step(n_proposed: int, n_acc: int, bucket: int | None = None) -> None:
     """Feed the shared spec instruments from one completed verify step
     (generate loop and serve engine both call this — one call-site shape,
-    both paths)."""
+    both paths). `bucket` is the batched dispatch's slot-count bucket
+    (engine path only): it labels the acceptance-x-occupancy histogram
+    the serve bench reads."""
     SPEC_PROPOSED.inc(n_proposed)
     SPEC_ACCEPTED.inc(n_acc)
     SPEC_ACCEPTED_LEN.observe(n_acc)
+    if bucket is not None:
+        SPEC_BUCKET_ACCEPTED.observe(n_acc, bucket=str(bucket))
 
 
 def spec_stats_dict(steps: int, proposed: int, accepted: int) -> dict:

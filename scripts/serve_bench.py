@@ -213,6 +213,116 @@ def bench_long_tail(model):
         eng.close()
 
 
+# -- batched speculation: acceptance x occupancy x effective tok/s ----------
+# Templated traffic for the speculative bench: motif prompts whose greedy
+# continuation re-quotes context the n-gram drafter can look up (the
+# summarize/code-edit/RAG shape). The tiny model has random weights, so
+# the motifs are pre-screened for continuations the drafter predicts over
+# long runs — acceptance (tokens/step) is the hardware-independent
+# signal; the CPU wall numbers measure how much of it the SCHEDULER
+# converts into effective tok/s.
+SPEC_MOTIFS = (3, 23, 16, 4)
+SPEC_CTX = 256
+SPEC_MAX_NEW = 96
+SPEC_K = 8
+
+
+def _spec_prompts(model, n):
+    out = []
+    for j in SPEC_MOTIFS[:n]:
+        motif = [(5 + j * 7) % 200 + 3, (9 + j * 7) % 200 + 3,
+                 (17 + j * 7) % 200 + 3, (23 + j * 7) % 200 + 3]
+        pre = motif * 6 + motif[:2]
+        cont, _ = model.generate(pre, max_new_tokens=24, sampling=GREEDY,
+                                 spec=False)
+        out.append(pre + cont)      # templated: output re-quotes context
+    return out
+
+
+def bench_spec(model):
+    """Speculation on vs off through the BATCHED engine at occupancy
+    1 / 2 / 4: effective tok/s (all requests' tokens over the
+    concurrent-workload wall), acceptance rate, accepted tokens per
+    verify step, per-slot-bucket acceptance (the
+    cake_serve_spec_bucket_accepted_length histogram), and greedy
+    bit-parity spec-on vs spec-off. The paged variant runs the same
+    sweep at occupancy 4 to show speculation no longer stands down."""
+    from cake_tpu.obs import SPEC_BUCKET_ACCEPTED
+
+    def run(spec, occ, **ekw):
+        eng = ServeEngine(model, slots=occ, max_queue=32, ctx_len=SPEC_CTX,
+                          prefill_chunk=32, prefix_cache_mb=0,
+                          spec=spec, spec_k=SPEC_K, **ekw)
+        try:
+            ps = _spec_prompts(model, occ)
+            warm = [eng.submit(p, max_new_tokens=SPEC_MAX_NEW,
+                               sampling=GREEDY) for p in ps]
+            assert all(r.wait(600) for r in warm), "warmup timed out"
+            t0 = time.monotonic()
+            rs = [eng.submit(p, max_new_tokens=SPEC_MAX_NEW,
+                             sampling=GREEDY) for p in ps]
+            assert all(r.wait(600) for r in rs), "bench run timed out"
+            for r in rs:
+                assert "error" not in r.result, r.result.get("error")
+            wall = time.monotonic() - t0
+            toks = sum(len(r.tokens) for r in rs)
+            return (toks / wall, [list(r.tokens) for r in rs],
+                    eng.health().get("spec"))
+        finally:
+            eng.close()
+
+    cases = []
+    for occ in (1, 2, 4):
+        off_tps, off_out, _ = run(False, occ)
+        pre = {b: (SPEC_BUCKET_ACCEPTED.sum(bucket=str(b)),
+                   SPEC_BUCKET_ACCEPTED.count(bucket=str(b)))
+               for b in (1, 2, 4)}
+        on_tps, on_out, h = run("ngram", occ)
+        per_bucket = {}
+        for b in (1, 2, 4):
+            ds = SPEC_BUCKET_ACCEPTED.sum(bucket=str(b)) - pre[b][0]
+            dn = SPEC_BUCKET_ACCEPTED.count(bucket=str(b)) - pre[b][1]
+            if dn:
+                per_bucket[str(b)] = round(ds / dn, 3)
+        cases.append({
+            "occupancy": occ,
+            "bit_identical": on_out == off_out,
+            "off_tok_per_s": round(off_tps, 1),
+            "on_tok_per_s": round(on_tps, 1),
+            "effective_speedup": round(on_tps / off_tps, 3),
+            "verify_steps": h["steps"],
+            "proposed": h["proposed"],
+            "accepted": h["accepted"],
+            "accept_rate": round(h["accepted"] / h["proposed"], 4)
+            if h["proposed"] else 0.0,
+            "tokens_per_step": round(
+                (h["accepted"] + h["steps"]) / h["steps"], 3)
+            if h["steps"] else 0.0,
+            "accepted_per_step_by_bucket": per_bucket,
+        })
+    # paged mode at the deepest occupancy: speculation active, no
+    # stand-down (blocks sized so the workload fits without preemption)
+    blocks = 4 * SPEC_CTX // 16
+    pg_off, pg_off_out, _ = run(False, 4, kv_blocks=blocks,
+                                kv_block_tokens=16)
+    pg_on, pg_on_out, ph = run("ngram", 4, kv_blocks=blocks,
+                               kv_block_tokens=16)
+    paged = {
+        "occupancy": 4,
+        "bit_identical": pg_on_out == pg_off_out,
+        "off_tok_per_s": round(pg_off, 1),
+        "on_tok_per_s": round(pg_on, 1),
+        "effective_speedup": round(pg_on / pg_off, 3),
+        "verify_steps": ph["steps"],
+        "accepted": ph["accepted"],
+    }
+    best = max(c["effective_speedup"] for c in cases)
+    return {"contiguous": cases, "paged": paged,
+            "spec_k": SPEC_K, "max_new_tokens": SPEC_MAX_NEW,
+            "best_effective_speedup": best,
+            "speculation_pays": best >= 1.3}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tag", default="local")
@@ -221,7 +331,38 @@ def main() -> int:
                     help="paged-pool mode: mixed short/long contexts, "
                     "records occupancy + preemptions instead of the "
                     "TTFT/interference benches")
+    ap.add_argument("--spec", action="store_true",
+                    help="batched-speculation mode: acceptance x "
+                    "occupancy x effective tok/s, spec on vs off, "
+                    "contiguous + paged engines")
     args = ap.parse_args()
+
+    if args.spec:
+        model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                          max_cache_len=SPEC_CTX)
+        out = {
+            "bench": "serve-spec",
+            "ts": int(time.time()),
+            "config": {"ctx": SPEC_CTX, "spec_k": SPEC_K,
+                       "drafter": "ngram", "platform": "cpu-tiny"},
+            "spec": bench_spec(model),
+        }
+        path = args.out or f"BENCH_SERVE_{args.tag}.json"
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(json.dumps(out, indent=2))
+        print(f"\nwrote {path}", file=sys.stderr)
+        sp = out["spec"]
+        if not all(c["bit_identical"] for c in sp["contiguous"]) \
+                or not sp["paged"]["bit_identical"]:
+            print("FAIL: spec-on output differs from spec-off",
+                  file=sys.stderr)
+            return 1
+        if not sp["speculation_pays"]:
+            print(f"FAIL: best effective speedup "
+                  f"{sp['best_effective_speedup']} < 1.3x", file=sys.stderr)
+            return 1
+        return 0
 
     model = TextModel(tiny_config("llama"), dtype=jnp.float32,
                       max_cache_len=CTX)

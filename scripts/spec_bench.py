@@ -81,6 +81,52 @@ def bench_case(model, name, prompt):
     }
 
 
+def bench_engine(model):
+    """Batched-engine speculation at occupancy 1 and 2: acceptance x
+    occupancy x effective tok/s through the serve scheduler (the full
+    sweep, paged mode included, lives in `serve_bench.py --spec`)."""
+    from cake_tpu.serve import ServeEngine
+
+    def run(spec, occ):
+        eng = ServeEngine(model, slots=occ, max_queue=16, ctx_len=CTX,
+                          prefill_chunk=32, prefix_cache_mb=0,
+                          spec=spec, spec_k=SPEC_K)
+        try:
+            ps = [REPETITIVE[occ - 1:] + REPETITIVE[:occ - 1]
+                  for _ in range(occ)]
+            warm = [eng.submit(p, max_new_tokens=MAX_NEW, sampling=GREEDY)
+                    for p in ps]
+            assert all(r.wait(600) for r in warm)
+            t0 = time.monotonic()
+            rs = [eng.submit(p, max_new_tokens=MAX_NEW, sampling=GREEDY)
+                  for p in ps]
+            assert all(r.wait(600) for r in rs)
+            wall = time.monotonic() - t0
+            toks = sum(len(r.tokens) for r in rs)
+            return toks / wall, [list(r.tokens) for r in rs], \
+                eng.health().get("spec")
+        finally:
+            eng.close()
+
+    out = []
+    for occ in (1, 2):
+        off, off_out, _ = run(False, occ)
+        on, on_out, h = run("ngram", occ)
+        out.append({
+            "occupancy": occ,
+            "bit_identical": on_out == off_out,
+            "off_tok_per_s": round(off, 1),
+            "on_tok_per_s": round(on, 1),
+            "effective_speedup": round(on / off, 3),
+            "accept_rate": round(h["accepted"] / h["proposed"], 4)
+            if h["proposed"] else 0.0,
+            "tokens_per_step": round(
+                (h["accepted"] + h["steps"]) / h["steps"], 3)
+            if h["steps"] else 0.0,
+        })
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tag", default="local")
@@ -97,6 +143,7 @@ def main() -> int:
         "config": {"ctx": CTX, "max_new_tokens": MAX_NEW, "spec_k": SPEC_K,
                    "drafter": "ngram", "platform": "cpu-tiny"},
         "cases": cases,
+        "engine": bench_engine(model),
     }
     path = args.out or f"BENCH_SPEC_{args.tag}.json"
     with open(path, "w") as f:

@@ -845,6 +845,7 @@ def test_api_stream_queue_deadline_503(model):
     the blocking path, so balancers see the shed-load signal."""
     from aiohttp.test_utils import TestClient, TestServer
     from cake_tpu.api import create_app
+    from cake_tpu.serve import faults
 
     eng = ServeEngine(model, slots=1, max_queue=4, ctx_len=CTX,
                       queue_deadline_s=0.1)
@@ -855,10 +856,17 @@ def test_api_stream_queue_deadline_503(model):
         client = TestClient(TestServer(app))
         await client.start_server()
         try:
-            # occupy the single slot with a long decode...
+            # occupy the single slot with a long decode. delay_ms paces
+            # it deterministically: the ctx cap bounds the busy request
+            # at ~122 decode steps, which a WARM executable finishes in
+            # under the 0.1s deadline — the queued request then got
+            # ADMITTED instead of shed (the in-suite flake this pacing
+            # fixes); at 5 ms/iteration the slot is held for >0.5s no
+            # matter how warm the cache is
             r_busy = eng.submit(P_LONG, max_new_tokens=180, sampling=GREEDY)
             while not r_busy.tokens:
                 await asyncio.sleep(0.005)
+            faults.install("delay_ms=5")
             # ...then a streaming request that must expire while queued
             resp = await client.post("/v1/chat/completions", json={
                 "messages": [{"role": "user", "content": "will expire"}],
@@ -867,6 +875,7 @@ def test_api_stream_queue_deadline_503(model):
             assert int(resp.headers.get("Retry-After", "0")) >= 1
             r_busy.cancel()
         finally:
+            faults.clear()
             await client.close()
     _run(scenario())
     eng.close()

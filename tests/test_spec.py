@@ -367,20 +367,25 @@ def test_engine_spec_e2e_multi_token_accept(llama):
         eng.close()
 
 
-def test_engine_spec_stands_down_when_sampled_or_deep(llama):
-    """Sampled slots never speculate, and occupancy above spec_max_busy
-    falls back to the batched decode step — speculation must not slow a
-    saturated pool."""
+def test_engine_spec_sampled_slots_speculate(llama):
+    """Sampled slots ride the batched verify too (each slot verifies
+    with its own traced sampling params; spec_accept preserves the
+    target distribution). top_k=1 makes the sampled pipeline a point
+    mass, so the stochastic accept/resample path must reproduce the
+    greedy stream exactly while actually taking verify steps."""
     from cake_tpu.serve import ServeEngine
+    base, _ = llama.generate(REP_PROMPT, max_new_tokens=12, sampling=GREEDY,
+                             spec=False)
     eng = ServeEngine(llama, slots=2, max_queue=8, ctx_len=128,
-                      prefix_cache_mb=0, spec="ngram", spec_k=4,
-                      spec_max_busy=1)
+                      prefix_cache_mb=0, spec="ngram", spec_k=4)
     try:
-        scfg = SamplingConfig(temperature=0.8)
+        scfg = SamplingConfig(temperature=0.8, top_k=1)
         r1 = eng.submit(REP_PROMPT, max_new_tokens=12, sampling=scfg)
         r2 = eng.submit(REP_PROMPT, max_new_tokens=12, sampling=scfg)
         assert r1.wait(300) and r2.wait(300)
-        assert eng.spec_steps == 0          # sampled -> no speculation
+        assert "error" not in r1.result and "error" not in r2.result
+        assert r1.tokens == base and r2.tokens == base
+        assert eng.spec_steps > 0           # sampled slots speculate now
     finally:
         eng.close()
 

@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench serve-bench-longtail serve-bench-spec paged-smoke chaos-smoke serve-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -57,6 +57,21 @@ chaos-smoke:
 # cake_serve_engine_rebuilds_total in /metrics), /health back to 200
 serve-chaos-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/serve_chaos_smoke.py
+
+# fleet robustness gate: 3 real serve replicas behind the router, one
+# killed mid-traffic — zero failed non-streamed requests (transparent
+# failover), a visible eject -> readmit cycle in /fleet + /metrics, and
+# saturation shed as router-level 429s (shed_by=router), never replica
+# errors
+fleet-chaos-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/fleet_chaos_smoke.py
+
+# fleet affinity bench: 2 replicas + router, conversational follow-up
+# traffic with prefix-affinity routing vs round-robin — affinity must
+# beat round-robin on warm follow-up TTFT (the owning replica holds the
+# conversation's prefix KV blocks). Writes BENCH_FLEET_<tag>.json.
+serve-bench-fleet:
+	JAX_PLATFORMS=cpu python scripts/serve_bench.py --fleet --tag fleet
 
 # serve scheduler bench: TTFT p50/p99 + tok/s for a shared-system-prompt
 # workload cold (no prefix cache) vs warm (prefix cached), and the

@@ -40,6 +40,14 @@ HOT_PATHS = frozenset({
     "cake_tpu/cluster/proto.py",
     # request routing
     "cake_tpu/api/state.py",
+    # fleet router: membership recording + candidate ordering + the
+    # outbound attempt seam all run per proxied request (and the probe
+    # loop shares the same guarded state) — timings go through obs.now,
+    # registry fields carry guarded-by annotations
+    "cake_tpu/fleet/registry.py",
+    "cake_tpu/fleet/routing.py",
+    "cake_tpu/fleet/router.py",
+    "cake_tpu/fleet/faults.py",
 })
 
 

@@ -115,6 +115,12 @@ async def graceful_drain(app: web.Application):
     engine = getattr(state, "engine", None)
     if engine is None:
         return
+    # flip the engine's own draining flag BEFORE the blocking drain is
+    # handed to an executor thread: /health's engine block must say
+    # draining from the first instant, so a fleet router probing it
+    # stops routing here without waiting for a request to bounce (the
+    # gap used to last until engine.drain() ran inside the executor)
+    engine.begin_drain()
     timeout = knobs.get("CAKE_DRAIN_TIMEOUT_S")
     log.info("draining serve engine (up to %.0fs): %d busy, %d queued",
              timeout, engine.pool.busy_count, engine.queue.depth())

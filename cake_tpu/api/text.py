@@ -146,17 +146,43 @@ def _completion_id() -> str:
     return "chatcmpl-" + uuid.uuid4().hex[:24]
 
 
-def _typed_error_response(err: BaseException) -> web.Response | None:
+def _retry_after(state: ApiState, floor: int = 1) -> int:
+    """Derived Retry-After for 503s that used to ship constants: scale
+    with the engine's live congestion (queue depth per slot, or the
+    restore-probe interval while DOWN) so a router/client backs off
+    proportionally — an idle engine invites a near-immediate retry, a
+    deep backlog pushes the herd out. Engines expose the derivation as
+    retry_after_hint(); engineless (locked-path) servers fall back to
+    the restore interval, the knob that bounds how soon a degraded
+    cluster can possibly recover."""
+    engine = getattr(state, "engine", None)
+    if engine is not None:
+        try:
+            return max(floor, engine.retry_after_hint())
+        except Exception:
+            pass                    # engine racing shutdown: use floor
+    from .. import knobs
+    return max(floor, int(knobs.get("CAKE_RESTORE_INTERVAL_S")) + 1)
+
+
+def _typed_error_response(err: BaseException,
+                          state: ApiState | None = None
+                          ) -> web.Response | None:
     """Map a typed engine failure onto its documented status — shared by
     the blocking path and the SSE path's pre-commit refusal, so a
     degraded engine answers the SAME way everywhere: 503 + Retry-After
     for retry-elsewhere conditions (queue deadline, engine down), 504
     for a request that outlived its deadline, 500 for a poisoned
-    request. None means not a typed engine error (caller decides)."""
+    request. None means not a typed engine error (caller decides).
+    Retry-After prefers the hint the error carries (computed where the
+    failure happened); errors without one derive from live state."""
     if isinstance(err, (QueueDeadlineExceeded, EngineDown)):
+        ra = getattr(err, "retry_after_s", None)
+        if ra is None:
+            ra = _retry_after(state) if state is not None else 5
         return web.json_response(
             {"error": str(err)}, status=503,
-            headers={"Retry-After": str(getattr(err, "retry_after_s", 5))})
+            headers={"Retry-After": str(int(ra))})
     if isinstance(err, RequestDeadlineExceeded):
         return web.json_response({"error": str(err)}, status=504)
     if isinstance(err, PoisonedRequest):
@@ -171,10 +197,12 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     if state.draining:
         # graceful shutdown in progress: requests arriving on kept-alive
         # connections are shed so the balancer fails them over while
-        # in-flight generations finish their final chunks
+        # in-flight generations finish their final chunks. Retry-After
+        # scales with the engine backlog being drained — an idle drain
+        # finishes (and the replacement process starts) almost at once
         return web.json_response(
-            {"error": "server draining for shutdown"},
-            status=503, headers={"Retry-After": "5"})
+            {"error": "server draining for shutdown"}, status=503,
+            headers={"Retry-After": str(_retry_after(state, floor=2))})
     degraded = getattr(state.model, "degraded", None)
     if degraded:
         # quarantined worker with the recovery retry budget exhausted:
@@ -182,11 +210,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         # would otherwise have committed to a 200 SSE response before
         # generate() could raise, hiding the reroute signal from the
         # balancer (the restore loop clears the flag when the worker
-        # comes back)
+        # comes back). Retry-After = the restore-probe interval: the
+        # soonest the flag can possibly clear
         return web.json_response(
             {"error": f"cluster degraded: worker {degraded['worker']} "
                       "down; recovery in progress"},
-            status=503, headers={"Retry-After": "10"})
+            status=503,
+            headers={"Retry-After": str(_retry_after(state, floor=2))})
     try:
         body = await request.json()
     except Exception:
@@ -321,9 +351,13 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
             from ..cluster.master import ClusterDegradedError
             if isinstance(e, ClusterDegradedError):
                 # typed fast-fail: a worker is quarantined with its retry
-                # budget spent — 503 (retryable elsewhere), not a 500
-                return web.json_response({"error": str(e)}, status=503,
-                                         headers={"Retry-After": "10"})
+                # budget spent — 503 (retryable elsewhere), not a 500;
+                # Retry-After = the restore-probe interval (the soonest
+                # the quarantined worker can revive)
+                return web.json_response(
+                    {"error": str(e)}, status=503,
+                    headers={"Retry-After":
+                             str(_retry_after(state, floor=2))})
             return web.json_response({"error": f"generation failed: {e}"},
                                      status=500)
     GENERATIONS.inc(kind="text", status="ok")
@@ -366,7 +400,7 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         # typed refusals share the terminal-error mapping: 503 +
         # Retry-After for a down engine (the balancer reroutes, the
         # restore loop revives), 500 for a quarantined poison prompt
-        return _typed_error_response(e)
+        return _typed_error_response(e, state)
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
     except RuntimeError as e:               # engine dead (legacy path)
@@ -386,7 +420,7 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
             req.cancel()            # client gone while queued
             raise
         if req.done.is_set() and "error" in req.result:
-            resp = _typed_error_response(req.result["error"])
+            resp = _typed_error_response(req.result["error"], state)
             if resp is not None:
                 GENERATIONS.inc(kind="text", status="error")
                 return resp
@@ -434,7 +468,7 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         # typed engine failures answer their documented status (503 +
         # Retry-After for retryable-elsewhere, 504 past the request
         # deadline, 500 for poison) — only untyped bugs fall to bare 500
-        resp = _typed_error_response(err)
+        resp = _typed_error_response(err, state)
         if resp is not None:
             return resp
         return web.json_response(

@@ -215,7 +215,51 @@ def cmd_serve(args) -> int:
         print(f"[serve engine: {state.engine.slots} slots x "
               f"{state.engine.ctx} ctx, queue {state.engine.queue.maxsize}]",
               file=sys.stderr)
-    serve(state, host=args.host, port=args.port, basic_auth=args.basic_auth)
+    advertiser = None
+    if args.announce:
+        # announce this replica over the cluster discovery/PSK plumbing
+        # so a fleet router (`cake route --cluster-key K`) finds it: same
+        # UDP protocol as workers, caps tagged role=serve so routers and
+        # masters never confuse the two populations
+        key = args.cluster_key or knobs.get("CAKE_CLUSTER_KEY")
+        if not key:
+            print("error: --announce needs --cluster-key "
+                  "(or CAKE_CLUSTER_KEY)", file=sys.stderr)
+            return 2
+        from .cluster.discovery import WorkerAdvertiser, detect_capabilities
+        caps = {**detect_capabilities(), "role": "serve"}
+        advertiser = WorkerAdvertiser(args.announce_name or os.uname().nodename,
+                                      key, args.port, caps=caps).start()
+        print(f"[announcing replica {advertiser.name} on UDP discovery]",
+              file=sys.stderr)
+    try:
+        serve(state, host=args.host, port=args.port,
+              basic_auth=args.basic_auth)
+    finally:
+        if advertiser is not None:
+            advertiser.stop()
+    return 0
+
+
+def cmd_route(args) -> int:
+    """Fleet router: front N `cake serve` replicas with health-driven
+    membership, prefix-affinity failover and router-level 429s."""
+    replicas = []
+    for spec in args.replica or []:
+        name, sep, url = spec.partition("=")
+        if not sep:
+            url = spec
+            name = spec.split("//")[-1].replace(":", "-").replace("/", "")
+        if "://" not in url:
+            url = "http://" + url
+        replicas.append((name, url))
+    key = args.cluster_key or knobs.get("CAKE_CLUSTER_KEY")
+    if not replicas and not key:
+        print("error: need --replica host:port entries or --cluster-key "
+              "for UDP discovery of announced replicas", file=sys.stderr)
+        return 2
+    from .fleet import serve_router
+    serve_router(replicas, host=args.host, port=args.port, cluster_key=key)
     return 0
 
 
@@ -375,7 +419,24 @@ def main(argv=None) -> int:
     p.add_argument("--sd-trace-dir", default=None,
                    help="write a JAX profiler trace of SD generation here "
                         "(ref: --sd-tracing)")
+    p.add_argument("--announce", action="store_true",
+                   help="advertise this replica on UDP discovery so a "
+                        "fleet router (`cake-tpu route`) can find it "
+                        "(needs --cluster-key / CAKE_CLUSTER_KEY)")
+    p.add_argument("--announce-name", default=None,
+                   help="replica name for discovery (default: hostname)")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("route", help="fleet router over N serve replicas")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--replica", action="append", default=[],
+                   help="replica as NAME=URL or host:port "
+                        "(repeatable; e.g. r0=http://10.0.0.5:8000)")
+    p.add_argument("--cluster-key", default=None,
+                   help="PSK for UDP discovery of `cake serve --announce` "
+                        "replicas (CAKE_CLUSTER_KEY also works)")
+    p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser("worker", help="run as a cluster worker")
     p.add_argument("--name", default=os.uname().nodename)

@@ -1,0 +1,22 @@
+"""Fleet serving: a router tier over N `cake serve` replicas.
+
+One engine survives crashes (serve/supervisor.py) and worker death
+(cluster/master.py); this package makes N of them survive each other —
+health-driven membership with a gray-failure eject -> half-open ->
+readmit machine (registry.py), prefix-affinity routing with
+deterministic failover (routing.py), router-level overload control and
+the `cake route` process itself (router.py), and the chaos drill seam
+(faults.py). docs/fleet.md is the operator guide.
+"""
+from .registry import (EJECTED, HALF_OPEN, HEALTHY, MembershipPolicy,
+                       Replica, ReplicaRegistry, discover_replicas)
+from .router import FleetRouter, create_router_app, serve_router
+from .routing import (AFFINITY_BLOCK, affinity_key, conversation_head,
+                      rank_replicas)
+
+__all__ = [
+    "Replica", "ReplicaRegistry", "MembershipPolicy", "discover_replicas",
+    "HEALTHY", "EJECTED", "HALF_OPEN",
+    "FleetRouter", "create_router_app", "serve_router",
+    "affinity_key", "conversation_head", "rank_replicas", "AFFINITY_BLOCK",
+]

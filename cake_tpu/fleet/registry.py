@@ -1,0 +1,463 @@
+"""Replica registry: health-driven membership for the fleet router.
+
+One `cake serve` replica survives crashes (serve/supervisor.py) and
+worker death (cluster/master.py) — but a fleet of N replicas needs a
+tier that stops ROUTING to a sick one. This module owns that decision:
+every replica the router fronts is a :class:`Replica` whose membership
+state machine is driven by two signal streams,
+
+  * the router's own request outcomes (transport failures, replica 5xx,
+    time-to-first-byte), fed through :meth:`Replica.record_result`; and
+  * the replica's /health engine block (down / wedged / draining, queue
+    depth, kv_pool occupancy), fed through :meth:`Replica.observe_health`
+    by the router's probe loop.
+
+The gray-failure detector is the cluster hop detector's shape
+(cluster/client.py: rolling window, p95 vs threshold, minimum samples
+before it may trip) applied to routing: a replica whose rolling error
+rate or TTFB p95 crosses its threshold is EJECTED even though TCP still
+connects — slow-but-alive is the failure mode that burns tail latency
+("The Tail at Scale", Dean & Barroso).
+
+State machine (docs/fleet.md has the diagram):
+
+    HEALTHY --(consecutive transport fails >= eject_fails,
+               error rate >= err_rate over the window,
+               TTFB p95 > degraded_ttft_ms,
+               or /health says down/wedged)--> EJECTED
+    EJECTED --(hold expires AND a probe succeeds)--> HALF_OPEN
+    HALF_OPEN --(one successful trial request,
+                 or two consecutive healthy probes)--> HEALTHY
+    HALF_OPEN --(any failure)--> EJECTED (hold doubles, capped 8x)
+
+DRAINING is orthogonal: a replica whose engine block says draining keeps
+its machine state but stops taking NEW requests (in-flight ones finish)
+— mirroring how the engine itself drains.
+
+Thread model: the probe loop and the request path touch the same fields,
+so every mutable field is `# guarded-by:` its owner's lock and the
+lock-discipline lint (cake_tpu/analysis) enforces the annotation.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from .. import knobs
+from ..obs import (FLEET_EJECTS, FLEET_READMITS, FLEET_REPLICAS,
+                   FLEET_REPLICA_INFLIGHT, FLEET_REPLICA_OCCUPANCY,
+                   FLEET_REPLICA_QUEUE_DEPTH, now)
+
+__all__ = ["Replica", "ReplicaRegistry", "MembershipPolicy",
+           "discover_replicas", "HEALTHY", "EJECTED", "HALF_OPEN"]
+
+HEALTHY = "healthy"
+EJECTED = "ejected"
+HALF_OPEN = "half_open"
+
+# minimum rolling-window samples before the error-rate / TTFB detectors
+# may trip (one bad response is noise, not gray failure) — same guard as
+# the cluster hop detector's GRAY_MIN_SAMPLES
+GRAY_MIN_SAMPLES = 8
+
+# ejection hold multiplier cap: repeated re-ejects back off the half-open
+# probe exponentially, but a replica is never held out longer than 8x the
+# base hold (a flapping replica should still get probed, just less often)
+MAX_EJECT_BACKOFF = 8
+
+# per-replica in-flight fallback before the first health probe reports a
+# slot count (auto cap = 2x slots once known)
+DEFAULT_INFLIGHT_CAP = 8
+
+
+@dataclass(frozen=True)
+class MembershipPolicy:
+    """Ejection thresholds, snapshotted from knobs at registry build time
+    (tests construct their own)."""
+
+    eject_fails: int = 3        # consecutive transport failures
+    err_window: int = 32        # rolling result window length
+    err_rate: float = 0.5       # error fraction over the window
+    degraded_ttft_ms: float = 0.0   # TTFB p95 gray threshold (0 = off)
+    eject_s: float = 5.0        # base ejection hold before half-open
+    replica_inflight: int = 0   # per-replica cap (0 = auto from health)
+
+    @classmethod
+    def from_knobs(cls) -> "MembershipPolicy":
+        return cls(
+            eject_fails=max(knobs.get("CAKE_FLEET_EJECT_FAILS"), 1),
+            err_window=max(knobs.get("CAKE_FLEET_ERR_WINDOW"), 4),
+            err_rate=knobs.get("CAKE_FLEET_ERR_RATE"),
+            degraded_ttft_ms=knobs.get("CAKE_FLEET_DEGRADED_TTFT_MS"),
+            eject_s=knobs.get("CAKE_FLEET_EJECT_S"),
+            replica_inflight=knobs.get("CAKE_FLEET_REPLICA_INFLIGHT"))
+
+
+class Replica:
+    """One `cake serve` replica: identity + membership state + the live
+    load view the router routes on. All mutable state is guarded by
+    `self._lock` — the probe loop and every concurrent request handler
+    share these fields."""
+
+    def __init__(self, name: str, base_url: str,
+                 policy: MembershipPolicy | None = None):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.policy = policy or MembershipPolicy()
+        # reentrant: the state-machine helpers (_eject/_readmit/_cap/...)
+        # re-acquire under their callers so the lock-discipline lint can
+        # verify every guarded access lexically, in helpers included
+        self._lock = threading.RLock()
+        # membership state machine (probe loop + request path)
+        self.state = HEALTHY            # guarded-by: self._lock
+        self.state_since = now()        # guarded-by: self._lock
+        self.consec_fails = 0           # guarded-by: self._lock
+        self.results: list = []         # guarded-by: self._lock
+        self.eject_until = 0.0          # guarded-by: self._lock
+        self.eject_streak = 0           # guarded-by: self._lock
+        self.probe_ok_streak = 0        # guarded-by: self._lock
+        self.trial_inflight = False     # guarded-by: self._lock
+        # live load view, mirrored from /health by the probe loop
+        self.inflight = 0               # guarded-by: self._lock
+        self.draining = False           # guarded-by: self._lock
+        self.slots_hint = 0             # guarded-by: self._lock
+        self.queue_depth = 0            # guarded-by: self._lock
+        self.occupancy = 0.0            # guarded-by: self._lock
+        self.last_probe_ok = None       # guarded-by: self._lock
+        self.ejects = 0                 # guarded-by: self._lock
+        self.readmits = 0               # guarded-by: self._lock
+
+    # -- capacity -----------------------------------------------------------
+
+    def _cap(self) -> int:
+        """Per-replica in-flight cap: the knob when set, else 2x the slot
+        count the last health probe reported, else the pre-probe
+        fallback."""
+        with self._lock:
+            if self.policy.replica_inflight > 0:
+                return self.policy.replica_inflight
+            if self.slots_hint > 0:
+                return 2 * self.slots_hint
+            return DEFAULT_INFLIGHT_CAP
+
+    def cap(self) -> int:
+        with self._lock:
+            return self._cap()
+
+    def try_acquire(self) -> str | None:
+        """Reserve one routing slot on this replica. Returns a truthy
+        lease token — "slot" for a normal reservation, "trial" for THE
+        one half-open probation request — or None when the replica
+        refuses (draining, ejected, at cap, trial already in flight).
+        The caller passes the token back to release(), which is what
+        keeps a pre-eject request's release from clearing the trial
+        flag of a probation request still running."""
+        with self._lock:
+            if self.draining:
+                return None
+            if self.state == HEALTHY:
+                if self.inflight >= self._cap():
+                    return None
+                self.inflight += 1
+                FLEET_REPLICA_INFLIGHT.set(self.inflight,
+                                           replica=self.name)
+                return "slot"
+            if self.state == HALF_OPEN and not self.trial_inflight:
+                self.trial_inflight = True
+                self.inflight += 1
+                FLEET_REPLICA_INFLIGHT.set(self.inflight,
+                                           replica=self.name)
+                return "trial"
+            return None
+
+    def release(self, lease: str = "slot") -> None:
+        with self._lock:
+            self.inflight = max(self.inflight - 1, 0)
+            if lease == "trial":
+                self.trial_inflight = False
+            FLEET_REPLICA_INFLIGHT.set(self.inflight, replica=self.name)
+
+    # -- outcome stream (request path) --------------------------------------
+
+    def record_result(self, ok: bool, ttfb_ms: float | None = None,
+                      transport: bool = False,
+                      lease: str = "slot") -> str | None:
+        """Feed one routed-request outcome into the detector. `transport`
+        marks connect/read failures (the replica never answered) —
+        these drive the consecutive-failure eject; HTTP-level errors
+        (replica 5xx) ride the rolling error rate instead. `lease` is
+        the token try_acquire issued for this request: only the TRIAL
+        request's outcome may move a HALF_OPEN replica (readmit or
+        re-eject) — a request that started before the ejection and
+        finished during probation is STALE evidence (its failure is the
+        old incident, not the probe), and an EJECTED replica ignores
+        outcomes entirely. Returns the eject reason when this result
+        ejected the replica, else None."""
+        with self._lock:
+            if self.state == EJECTED:
+                return None                 # stale pre-eject outcome
+            if ok:
+                self.consec_fails = 0
+                self.results.append((True, ttfb_ms))
+                del self.results[:-self.policy.err_window]
+                if self.state == HALF_OPEN:
+                    if lease == "trial":
+                        self._readmit()
+                    return None
+                return self._check_gray()
+            # failure
+            if self.state == HALF_OPEN:
+                if lease == "trial":
+                    return self._eject("fails")
+                return None                 # stale pre-eject failure
+            self.results.append((False, None))
+            del self.results[:-self.policy.err_window]
+            if transport:
+                self.consec_fails += 1
+            if transport and self.consec_fails >= self.policy.eject_fails:
+                return self._eject("fails")
+            return self._check_gray()
+
+    def _check_gray(self) -> str | None:
+        """Rolling-window detectors: error rate, then TTFB p95 — the hop
+        detector's shape, pointed at routing outcomes."""
+        with self._lock:
+            if (self.state != HEALTHY
+                    or len(self.results) < GRAY_MIN_SAMPLES):
+                return None
+            errs = sum(1 for ok, _ in self.results if not ok)
+            if errs / len(self.results) >= self.policy.err_rate:
+                return self._eject("error_rate")
+            if self.policy.degraded_ttft_ms > 0:
+                ms = sorted(t for ok, t in self.results
+                            if ok and t is not None)
+                if len(ms) >= GRAY_MIN_SAMPLES:
+                    p95 = ms[min(int(len(ms) * 0.95), len(ms) - 1)]
+                    if p95 > self.policy.degraded_ttft_ms:
+                        return self._eject("ttft_p95")
+            return None
+
+    # -- health stream (probe loop) ------------------------------------------
+
+    def observe_health(self, status: int | None,
+                       body: dict | None) -> str | None:
+        """Consume one /health probe. `status` None = unreachable (counts
+        like a transport failure). A 503 whose engine block says down or
+        wedged ejects immediately — the replica itself is reporting it
+        cannot serve. Healthy probes drive the ejected -> half_open ->
+        readmit side of the machine, so an idle fleet still readmits
+        without waiting for live traffic to gamble on the replica.
+        Returns an eject reason when the probe ejected, else None."""
+        with self._lock:
+            if status is None:
+                self.last_probe_ok = False
+                self.probe_ok_streak = 0
+                self.consec_fails += 1
+                if self.state == HALF_OPEN:
+                    return self._eject("health")
+                if (self.state == HEALTHY
+                        and self.consec_fails >= self.policy.eject_fails):
+                    return self._eject("health")
+                return None
+            engine = (body or {}).get("engine") or {}
+            self.draining = bool((body or {}).get("draining")
+                                 or engine.get("draining"))
+            if engine.get("slots"):
+                self.slots_hint = int(engine["slots"])
+            self.queue_depth = int(engine.get("queue_depth") or 0)
+            self.occupancy = self._occupancy_of(engine)
+            FLEET_REPLICA_QUEUE_DEPTH.set(self.queue_depth,
+                                          replica=self.name)
+            FLEET_REPLICA_OCCUPANCY.set(self.occupancy, replica=self.name)
+            sick = bool(engine.get("down") or engine.get("wedged")
+                        or engine.get("alive") is False)
+            self.last_probe_ok = not sick
+            if sick:
+                self.probe_ok_streak = 0
+                if self.state in (HEALTHY, HALF_OPEN):
+                    return self._eject("health")
+                return None
+            # healthy probe
+            self.consec_fails = 0
+            if self.state == EJECTED and now() >= self.eject_until:
+                self._transition(HALF_OPEN)
+                self.probe_ok_streak = 1
+            elif self.state == HALF_OPEN:
+                self.probe_ok_streak += 1
+                if self.probe_ok_streak >= 2:
+                    self._readmit()
+            return None
+
+    @staticmethod
+    def _occupancy_of(engine: dict) -> float:
+        """KV occupancy in [0, 1]: used/total physical blocks for paged
+        pools (the kv_pool health block carries `used` and `blocks`),
+        else busy-slot fraction — the autoscaling signal. Block
+        occupancy matters: a paged replica can have 95% of its KV spoken
+        for with only half its slots busy."""
+        kv = engine.get("kv_pool") or {}
+        if kv.get("blocks"):
+            return round((kv.get("used") or 0) / kv["blocks"], 4)
+        if "occupancy" in kv:               # forward-compat: ready-made
+            return round(float(kv["occupancy"]), 4)
+        slots = engine.get("slots") or 0
+        if slots:
+            return round((engine.get("slots_busy") or 0) / slots, 4)
+        return 0.0
+
+    # -- transitions (lock held) --------------------------------------------
+
+    def _transition(self, state: str) -> None:
+        with self._lock:
+            self.state = state
+            self.state_since = now()
+
+    def _eject(self, reason: str) -> str:
+        with self._lock:
+            self.eject_streak += 1
+            hold = self.policy.eject_s * min(2 ** (self.eject_streak - 1),
+                                             MAX_EJECT_BACKOFF)
+            self.eject_until = now() + hold
+            self.probe_ok_streak = 0
+            self.trial_inflight = False
+            self.results.clear()
+            self.ejects += 1
+            self._transition(EJECTED)
+        FLEET_EJECTS.inc(replica=self.name, reason=reason)
+        return reason
+
+    def _readmit(self) -> None:
+        with self._lock:
+            self.eject_streak = 0
+            self.consec_fails = 0
+            self.probe_ok_streak = 0
+            self.trial_inflight = False
+            self.readmits += 1
+            self._transition(HEALTHY)
+        FLEET_READMITS.inc(replica=self.name)
+
+    # -- views ---------------------------------------------------------------
+
+    def routable(self) -> bool:
+        """Eligible for NEW requests right now (half-open counts — the
+        acquire path limits it to one trial)."""
+        with self._lock:
+            return (not self.draining
+                    and self.state in (HEALTHY, HALF_OPEN))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            state = "draining" if (self.draining
+                                   and self.state == HEALTHY) else self.state
+            return {
+                "name": self.name,
+                "base_url": self.base_url,
+                "state": state,
+                "state_age_s": round(now() - self.state_since, 3),
+                "inflight": self.inflight,
+                "cap": self._cap(),
+                "queue_depth": self.queue_depth,
+                "occupancy": self.occupancy,
+                "consec_fails": self.consec_fails,
+                "eject_streak": self.eject_streak,
+                "ejects": self.ejects,
+                "readmits": self.readmits,
+                "last_probe_ok": self.last_probe_ok,
+            }
+
+
+class ReplicaRegistry:
+    """Thread-safe membership set. Join/leave mutate the map under the
+    registry lock; per-replica state lives in each Replica under its own
+    lock, so the probe loop and request handlers never serialize on one
+    global lock for outcome recording."""
+
+    def __init__(self, policy: MembershipPolicy | None = None):
+        self.policy = policy or MembershipPolicy.from_knobs()
+        self._lock = threading.Lock()
+        self._replicas: dict = {}       # guarded-by: self._lock
+        self._rr = 0                    # guarded-by: self._lock
+
+    # -- membership ----------------------------------------------------------
+
+    def add(self, name: str, base_url: str) -> Replica:
+        """Join (idempotent on name: re-announcement refreshes the URL
+        but keeps membership state — a re-registered replica does not
+        launder its ejection history)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.base_url = base_url.rstrip("/")
+                return rep
+            rep = Replica(name, base_url, self.policy)
+            self._replicas[name] = rep
+        self.publish()
+        return rep
+
+    def remove(self, name: str) -> bool:
+        """Leave: drop the replica from routing entirely."""
+        with self._lock:
+            gone = self._replicas.pop(name, None) is not None
+        self.publish()
+        return gone
+
+    def get(self, name: str) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def replicas(self) -> list:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def names(self) -> list:
+        with self._lock:
+            return list(self._replicas.keys())
+
+    def next_rr(self) -> int:
+        with self._lock:
+            self._rr += 1
+            return self._rr - 1
+
+    # -- fleet views ---------------------------------------------------------
+
+    def routable_count(self) -> int:
+        return sum(1 for r in self.replicas() if r.routable())
+
+    def total_capacity(self) -> int:
+        return sum(r.cap() for r in self.replicas())
+
+    def total_queue_depth(self) -> int:
+        return sum(r.snapshot()["queue_depth"] for r in self.replicas())
+
+    def snapshot(self) -> dict:
+        reps = [r.snapshot() for r in self.replicas()]
+        by_state: dict = {}
+        for r in reps:
+            by_state[r["state"]] = by_state.get(r["state"], 0) + 1
+        return {"replicas": reps, "by_state": by_state,
+                "routable": sum(1 for r in reps
+                                if r["state"] in (HEALTHY, HALF_OPEN))}
+
+    def publish(self) -> None:
+        """Mirror membership into the cake_fleet_replicas{state=} gauge —
+        the primary autoscaling signal."""
+        counts = {HEALTHY: 0, EJECTED: 0, HALF_OPEN: 0, "draining": 0}
+        for r in self.replicas():
+            counts[r.snapshot()["state"]] += 1
+        for state, n in counts.items():
+            FLEET_REPLICAS.set(n, state=state)
+
+
+def discover_replicas(cluster_key: str, timeout: float = 2.0) -> list:
+    """Find announced serve replicas over the existing cluster discovery
+    plumbing (UDP broadcast filtered by the PSK-derived cluster hash —
+    cluster/discovery.py): `cake serve --announce` runs a
+    WorkerAdvertiser whose caps carry role="serve", and this filters the
+    replies down to those. Returns [(name, base_url), ...]."""
+    from ..cluster.discovery import discover_workers
+    out = []
+    for w in discover_workers(cluster_key, timeout=timeout):
+        if (w.get("caps") or {}).get("role") != "serve":
+            continue
+        out.append((w["name"], f"http://{w['host']}:{w['port']}"))
+    return out

@@ -1,0 +1,664 @@
+"""Fleet router: one HTTP front for N `cake serve` replicas.
+
+`cake route` runs this aiohttp app. It owns three jobs, layered on the
+registry's membership machine (fleet/registry.py) and the affinity hash
+(fleet/routing.py):
+
+  1. ROUTE — each chat request's conversation head is chain-hashed and
+     rendezvous-placed so follow-ups land on the replica already holding
+     their prefix KV blocks (warm TTFT); CAKE_FLEET_AFFINITY=0 degrades
+     to round-robin for A/B benching.
+
+  2. FAIL OVER — a transport failure or replica 5xx retries on the
+     deterministic next-best replica under a per-request budget
+     (CAKE_FLEET_RETRIES) with capped-exponential backoff +/-25% jitter.
+     Streamed requests retry only BEFORE the first byte reaches the
+     client; a mid-stream break emits a typed SSE error event with
+     resume hints instead of a silent hang. Non-streamed requests can
+     optionally hedge (CAKE_FLEET_HEDGE_MS): no reply after the
+     threshold fires a duplicate at the next-best replica and the first
+     response wins ("The Tail at Scale").
+
+  3. SHED — a per-replica in-flight cap and a global admission bound
+     turn overload into typed 429s AT THE ROUTER (body carries
+     shed_by=router), before any replica queues the request; Retry-After
+     scales with the fleet backlog. Router drain mirrors engine drain:
+     SIGTERM stops admission (503) while in-flight proxies finish.
+
+The router deliberately does NOT load a tokenizer or model: it is a thin
+tier that can run many-per-region, restart in milliseconds, and scale
+separately from the replicas."""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+
+from aiohttp import web
+
+from .. import knobs
+from ..obs import (FLEET_HEDGES, FLEET_PROXIED, FLEET_RETRIES, FLEET_SHEDS,
+                   now)
+from . import faults
+from .registry import ReplicaRegistry, discover_replicas
+from .routing import affinity_key, conversation_head, rank_replicas
+
+log = logging.getLogger("cake_tpu.fleet")
+
+__all__ = ["FleetRouter", "create_router_app", "serve_router"]
+
+# transport-level failure classes: the replica never (fully) answered.
+# InjectedFleetFault subclasses ConnectionError, so drills ride this too.
+_TRANSPORT_ERRORS = (ConnectionError, asyncio.TimeoutError, OSError)
+
+
+def _transport_errors():
+    """aiohttp's client errors join the transport set lazily (the module
+    must stay importable for unit tests even if aiohttp changes)."""
+    try:
+        import aiohttp
+        return _TRANSPORT_ERRORS + (aiohttp.ClientError,)
+    except ImportError:                     # pragma: no cover
+        return _TRANSPORT_ERRORS
+
+
+class _ClientGone(Exception):
+    """Our DOWNSTREAM client vanished mid-relay. Distinct from upstream
+    transport failures so a disconnecting client is never recorded as a
+    replica failure (repeat disconnects would otherwise feed the gray
+    detector and eject a healthy replica)."""
+
+
+class FleetRouter:
+    """Router state + handlers. One instance per router process; all
+    handler state is event-loop-confined (single asyncio thread), while
+    the registry it routes over is thread-safe."""
+
+    def __init__(self, registry: ReplicaRegistry, *,
+                 retries: int | None = None,
+                 backoff_s: float | None = None,
+                 hedge_ms: float | None = None,
+                 max_inflight: int | None = None,
+                 affinity: bool | None = None,
+                 affinity_blocks: int | None = None,
+                 attempt_timeout_s: float | None = None,
+                 probe_s: float | None = None,
+                 cluster_key: str | None = None,
+                 discover_s: float | None = None):
+        self.registry = registry
+        self.retries = retries if retries is not None \
+            else knobs.get("CAKE_FLEET_RETRIES")
+        self.backoff_s = backoff_s if backoff_s is not None \
+            else knobs.get("CAKE_FLEET_BACKOFF_S")
+        self.hedge_ms = hedge_ms if hedge_ms is not None \
+            else knobs.get("CAKE_FLEET_HEDGE_MS")
+        self.max_inflight = max_inflight if max_inflight is not None \
+            else knobs.get("CAKE_FLEET_MAX_INFLIGHT")
+        self.affinity = affinity if affinity is not None \
+            else knobs.get("CAKE_FLEET_AFFINITY")
+        self.affinity_blocks = affinity_blocks if affinity_blocks is not None \
+            else knobs.get("CAKE_FLEET_AFFINITY_BLOCKS")
+        self.attempt_timeout_s = attempt_timeout_s \
+            if attempt_timeout_s is not None \
+            else knobs.get("CAKE_FLEET_ATTEMPT_TIMEOUT_S")
+        self.probe_s = probe_s if probe_s is not None \
+            else knobs.get("CAKE_FLEET_PROBE_S")
+        self.cluster_key = cluster_key
+        self.discover_s = discover_s if discover_s is not None \
+            else knobs.get("CAKE_FLEET_DISCOVER_S")
+        self.session = None                 # aiohttp.ClientSession
+        self.inflight = 0                   # event-loop-confined
+        self.draining = False
+        self._tasks: list = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self, app=None):
+        import aiohttp
+        self.session = aiohttp.ClientSession()
+        await self._probe_once()
+        self._tasks.append(asyncio.create_task(self._probe_loop()))
+        if self.cluster_key and self.discover_s > 0:
+            self._tasks.append(asyncio.create_task(self._discover_loop()))
+        self.registry.publish()
+
+    async def stop(self, app=None):
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        if self.session is not None:
+            await self.session.close()
+            self.session = None
+
+    async def drain(self, app=None):
+        """SIGTERM mirror of the engine drain: stop admission (new chats
+        answer 503 + Retry-After) and wait for in-flight proxied
+        requests to finish their final chunks, up to the same
+        CAKE_DRAIN_TIMEOUT_S budget the replicas use."""
+        self.draining = True
+        deadline = now() + knobs.get("CAKE_DRAIN_TIMEOUT_S")
+        while self.inflight > 0 and now() < deadline:
+            await asyncio.sleep(0.05)
+        if self.inflight:
+            log.warning("router drain timed out with %d in flight",
+                        self.inflight)
+
+    # -- probe / discovery loops ---------------------------------------------
+
+    async def _probe_once(self):
+        async def probe(rep):
+            try:
+                import aiohttp
+                tmo = aiohttp.ClientTimeout(total=max(
+                    min(self.probe_s, 2.0), 0.2))
+                async with self.session.get(rep.base_url + "/health",
+                                            timeout=tmo) as r:
+                    body = await r.json(content_type=None)
+                    rep.observe_health(r.status, body)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                rep.observe_health(None, None)
+        # concurrent: one unreachable replica must not stall health
+        # detection for the whole fleet (each dead probe burns its full
+        # timeout; serially that would multiply the effective cadence)
+        await asyncio.gather(*(probe(r)
+                               for r in self.registry.replicas()))
+        self.registry.publish()
+
+    async def _probe_loop(self):
+        """Health-driven membership: every tick consumes each replica's
+        /health engine block into its state machine — ejects on
+        down/wedged, readmits ejected replicas whose hold expired and
+        whose probes came back healthy, mirrors queue depth / occupancy
+        into the autoscaling gauges."""
+        while True:
+            await asyncio.sleep(self.probe_s)
+            await self._probe_once()
+
+    async def _discover_loop(self):
+        """Periodic UDP re-discovery over the cluster PSK plumbing: new
+        `cake serve --announce` replicas join without a router restart."""
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(self.discover_s)
+            try:
+                found = await loop.run_in_executor(
+                    None, lambda: discover_replicas(self.cluster_key))
+            except Exception:
+                continue
+            for name, base_url in found:
+                self.registry.add(name, base_url)
+
+    # -- admission / shedding ------------------------------------------------
+
+    def _global_cap(self) -> int:
+        if self.max_inflight > 0:
+            return self.max_inflight
+        return max(self.registry.total_capacity(), 1)
+
+    def _retry_after(self) -> int:
+        """Backlog-proportional Retry-After, the router-level analog of
+        the engine's retry_after_hint: the fleet queue depth per
+        routable replica."""
+        routable = max(self.registry.routable_count(), 1)
+        depth = self.registry.total_queue_depth() + self.inflight
+        return max(1, min(30, 1 + depth // routable))
+
+    def _shed(self, reason: str) -> web.Response:
+        FLEET_SHEDS.inc(reason=reason)
+        FLEET_PROXIED.inc(outcome="shed")
+        return web.json_response(
+            {"error": f"fleet overloaded: {reason}", "shed_by": "router"},
+            status=429,
+            headers={"Retry-After": str(self._retry_after())})
+
+    def _no_replica(self) -> web.Response:
+        FLEET_PROXIED.inc(outcome="failed")
+        return web.json_response(
+            {"error": "no routable replica (all ejected, draining, or "
+                      "none registered)", "shed_by": "router"},
+            status=503,
+            headers={"Retry-After": str(self._retry_after())})
+
+    # -- candidate ordering --------------------------------------------------
+
+    def _order(self, messages: list) -> list:
+        """Replica objects in attempt order: rendezvous over the
+        conversation head's chain key (owner first, deterministic
+        next-best after), or round-robin rotation when affinity is
+        off."""
+        names = self.registry.names()
+        if not names:
+            return []
+        if self.affinity and messages:
+            key = affinity_key(conversation_head(messages),
+                               self.affinity_blocks)
+            ranked = rank_replicas(key, names)
+        else:
+            start = self.registry.next_rr() % len(names)
+            ranked = sorted(names)
+            ranked = ranked[start:] + ranked[:start]
+        by_name = {r.name: r for r in self.registry.replicas()}
+        return [by_name[n] for n in ranked if n in by_name]
+
+    async def _sleep_backoff(self, attempt: int):
+        """Capped exponential +/-25% jitter between failover attempts —
+        the cluster recovery scheme, scaled for a request path."""
+        base = min(self.backoff_s * (2 ** max(attempt - 1, 0)),
+                   max(self.backoff_s * 8, 1.0))
+        await asyncio.sleep(base * (0.75 + 0.5 * random.random()))
+
+    # -- one outbound attempt ------------------------------------------------
+
+    async def _one_json(self, rep, body: dict):
+        """One non-streamed attempt against `rep`. Returns
+        ("skip", None)       — replica at cap / not acquirable,
+        ("retryable", str)   — transport failure, replica 5xx or 429,
+        ("final", Response)  — relay this (200 or non-retryable 4xx).
+        Acquires and releases the replica's routing slot itself so a
+        hedge winner can cancel the loser without leaking the slot."""
+        lease = rep.try_acquire()
+        if not lease:
+            return ("skip", None)
+        try:
+            hook = faults.FAULT_HOOK
+            if hook is not None:
+                stall = hook.on_attempt(rep.name)
+                if stall:
+                    await asyncio.sleep(stall)
+            import aiohttp
+            tmo = aiohttp.ClientTimeout(
+                total=self.attempt_timeout_s or None)
+            t0 = now()
+            async with self.session.post(
+                    rep.base_url + "/v1/chat/completions",
+                    json=body, timeout=tmo) as r:
+                ttfb_ms = (now() - t0) * 1e3
+                data = await r.read()
+                if r.status in (500, 502, 503):
+                    rep.record_result(False, lease=lease)
+                    return ("retryable",
+                            f"{rep.name}: upstream {r.status}")
+                if r.status == 429:
+                    # replica backpressure is load, not sickness: do not
+                    # feed the failure detector, just go elsewhere
+                    return ("retryable",
+                            f"{rep.name}: replica saturated (429)")
+                rep.record_result(True, ttfb_ms, lease=lease)
+                return ("final", web.Response(
+                    body=data, status=r.status,
+                    content_type=r.content_type or "application/json"))
+        except _transport_errors() as e:
+            rep.record_result(False, transport=True, lease=lease)
+            return ("retryable",
+                    f"{rep.name}: {type(e).__name__}: {e}")
+        finally:
+            rep.release(lease)
+
+    # -- request paths -------------------------------------------------------
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        if self.draining:
+            return web.json_response(
+                {"error": "router draining for shutdown"}, status=503,
+                headers={"Retry-After": str(self._retry_after())})
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON body"},
+                                     status=400)
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return web.json_response({"error": "messages[] required"},
+                                     status=400)
+        # router-level admission: shed BEFORE any replica queues it
+        if self.inflight >= self._global_cap():
+            return self._shed("global admission bound")
+        order = self._order(messages)
+        if not any(r.routable() for r in order):
+            return self._no_replica()
+        self.inflight += 1
+        try:
+            if body.get("stream"):
+                return await self._route_stream(request, body, order)
+            if self.hedge_ms > 0:
+                return await self._route_json_hedged(body, order)
+            return await self._route_json(body, order, 1 + self.retries)
+        finally:
+            self.inflight -= 1
+
+    async def _route_json(self, body: dict, order: list, budget: int,
+                          prior_attempts: int = 0) -> web.Response:
+        """Sequential failover over `order` under an attempt budget.
+        `prior_attempts`: attempts already spent by a caller (the hedged
+        path) — they count against the budget and keep the exhausted-503
+        honest about how many replicas were really tried."""
+        attempts = prior_attempts
+        cap_skipped = False
+        detail = None
+        for i, rep in enumerate(order):
+            if attempts >= budget:
+                break
+            if not rep.routable():
+                continue
+            kind, val = await self._one_json(rep, body)
+            if kind == "skip":
+                cap_skipped = True
+                continue
+            attempts += 1
+            if kind == "final":
+                FLEET_PROXIED.inc(
+                    outcome="ok" if val.status < 400 else "failed")
+                return val
+            detail = val
+            # back off only when another attempt can actually happen —
+            # sleeping after the last candidate just delays the 503
+            if attempts < budget \
+                    and any(r.routable() for r in order[i + 1:]):
+                FLEET_RETRIES.inc()
+                await self._sleep_backoff(attempts)
+        if attempts == 0:
+            return self._shed("replica in-flight caps") if cap_skipped \
+                else self._no_replica()
+        FLEET_PROXIED.inc(outcome="failed")
+        return web.json_response(
+            {"error": "fleet failover budget exhausted",
+             "attempts": attempts, "last": detail, "shed_by": "router"},
+            status=503,
+            headers={"Retry-After": str(self._retry_after())})
+
+    async def _route_json_hedged(self, body: dict,
+                                 order: list) -> web.Response:
+        """Tail-hedged non-streamed path: if the owner has not answered
+        within CAKE_FLEET_HEDGE_MS, fire a duplicate at the next-best
+        replica and take whichever finishes first (the loser is
+        cancelled and its routing slot released by _one_json's
+        finally). Falls back to the sequential path when fewer than two
+        replicas are routable, or for the remaining budget after both
+        hedge legs fail."""
+        reps = [r for r in order if r.routable()]
+        if len(reps) < 2:
+            return await self._route_json(body, order, 1 + self.retries)
+        primary = asyncio.create_task(self._one_json(reps[0], body))
+        done, _ = await asyncio.wait({primary},
+                                     timeout=self.hedge_ms / 1e3)
+        tasks = {primary}
+        tried = 1
+        if not done:
+            FLEET_HEDGES.inc()
+            tasks.add(asyncio.create_task(self._one_json(reps[1], body)))
+            tried = 2
+        pending = tasks
+        non_final = 0
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    kind, val = t.result()
+                    if kind == "final":
+                        FLEET_PROXIED.inc(
+                            outcome="ok" if val.status < 400
+                            else "failed")
+                        return val
+                    if kind != "skip":      # at-cap skips spend no budget
+                        non_final += 1
+        finally:
+            for t in pending:
+                t.cancel()
+        # every fired leg failed/skipped: sequential over the replicas
+        # not yet tried (when the primary failed fast the hedge never
+        # fired, so reps[1] — the deterministic next-best — must still
+        # get its attempt). Hedge attempts count against the budget via
+        # prior_attempts, which also keeps the terminal 503 reporting
+        # "budget exhausted after N attempts" rather than the misleading
+        # no-replica message when reps[tried:] is empty.
+        rest = reps[tried:]
+        if non_final and any(r.routable() for r in rest):
+            FLEET_RETRIES.inc()             # hedge -> sequential handoff
+        return await self._route_json(body, rest, 1 + self.retries,
+                                      prior_attempts=non_final)
+
+    async def _route_stream(self, request: web.Request, body: dict,
+                            order: list) -> web.StreamResponse:
+        """SSE relay with pre-commit failover: attempts rotate replicas
+        until one starts streaming; once the first byte has been
+        relayed the request is COMMITTED to that replica, and a break
+        after commit emits a typed error event + resume hints (the
+        client re-issues; affinity routes the retry warm)."""
+        budget = 1 + self.retries
+        attempts = 0
+        cap_skipped = False
+        for i, rep in enumerate(order):
+            if attempts >= budget:
+                break
+            if not rep.routable():
+                continue
+            lease = rep.try_acquire()
+            if not lease:
+                cap_skipped = True
+                continue
+            committed = False
+            try:
+                resp, retryable = await self._relay_stream(
+                    request, rep, body, lease)
+                committed = resp is not None
+                if committed:
+                    return resp
+                attempts += 1
+                if retryable and attempts < budget \
+                        and any(r.routable() for r in order[i + 1:]):
+                    FLEET_RETRIES.inc()
+                    await self._sleep_backoff(attempts)
+            finally:
+                rep.release(lease)
+        if attempts == 0:
+            return self._shed("replica in-flight caps") if cap_skipped \
+                else self._no_replica()
+        FLEET_PROXIED.inc(outcome="failed")
+        return web.json_response(
+            {"error": "fleet failover budget exhausted (stream never "
+                      "started)", "attempts": attempts,
+             "shed_by": "router"},
+            status=503,
+            headers={"Retry-After": str(self._retry_after())})
+
+    async def _relay_stream(self, request, rep, body,
+                            lease: str = "slot"):
+        """One streamed attempt. Returns (response, retryable):
+        response None = nothing was relayed, caller may retry
+        elsewhere; a non-None response is terminal (clean EOF or typed
+        mid-stream error)."""
+        hook = faults.FAULT_HOOK
+        t0 = now()
+        chunks = 0
+        resp = None
+        try:
+            if hook is not None:
+                stall = hook.on_attempt(rep.name)
+                if stall:
+                    await asyncio.sleep(stall)
+            import aiohttp
+            tmo = aiohttp.ClientTimeout(total=None)
+            async with self.session.post(
+                    rep.base_url + "/v1/chat/completions",
+                    json=body, timeout=tmo) as r:
+                if r.status != 200:
+                    data = await r.read()
+                    if r.status in (500, 502, 503):
+                        rep.record_result(False, lease=lease)
+                        return None, True
+                    if r.status == 429:
+                        return None, True
+                    # non-retryable refusal (400 etc.): relay verbatim
+                    rep.record_result(True, (now() - t0) * 1e3,
+                                      lease=lease)
+                    FLEET_PROXIED.inc(
+                        outcome="ok" if r.status < 400 else "failed")
+                    return web.Response(
+                        body=data, status=r.status,
+                        content_type=r.content_type
+                        or "application/json"), False
+                ttfb_ms = None
+                buf = b""
+                async for piece in r.content.iter_any():
+                    if not piece:
+                        continue
+                    buf += piece
+                    # relay whole SSE events, not TCP pieces: the break
+                    # drill (and the chunks_relayed resume hint) count
+                    # EVENTS, which TCP coalescing would otherwise blur
+                    while b"\n\n" in buf:
+                        event, buf = buf.split(b"\n\n", 1)
+                        event += b"\n\n"
+                        if hook is not None and hook.break_stream(
+                                rep.name, chunks):
+                            raise faults.InjectedFleetFault(
+                                f"fault injected: stream to {rep.name} "
+                                f"severed after {chunks} chunks")
+                        if resp is None:
+                            ttfb_ms = (now() - t0) * 1e3
+                            resp = web.StreamResponse(headers={
+                                "Content-Type": "text/event-stream",
+                                "Cache-Control": "no-cache",
+                                "Connection": "keep-alive",
+                            })
+                            try:
+                                await resp.prepare(request)
+                            except _transport_errors() as we:
+                                raise _ClientGone() from we
+                        try:
+                            await resp.write(event)
+                        except _transport_errors() as we:
+                            raise _ClientGone() from we
+                        chunks += 1
+                if resp is not None and buf:
+                    try:
+                        await resp.write(buf)    # non-event tail
+                    except _transport_errors() as we:
+                        raise _ClientGone() from we
+                if resp is None:
+                    # upstream 200 with an empty body: broken replica
+                    rep.record_result(False, lease=lease)
+                    return None, True
+                rep.record_result(True, ttfb_ms, lease=lease)
+                FLEET_PROXIED.inc(outcome="ok")
+                await resp.write_eof()
+                return resp, False
+        except _ClientGone:
+            # the CLIENT went away, the replica was fine: closing the
+            # upstream context cancels the replica-side generation (its
+            # disconnect sweep frees the slot) and no failure is
+            # recorded against it
+            rep.record_result(True, (now() - t0) * 1e3,
+                              lease=lease)
+            FLEET_PROXIED.inc(outcome="ok")
+            return (resp if resp is not None and resp.prepared
+                    else web.Response(status=200)), False
+        except _transport_errors() as e:
+            rep.record_result(False, transport=True, lease=lease)
+            if resp is None:
+                return None, True           # pre-commit: retry elsewhere
+            # mid-stream break AFTER bytes reached the client: typed
+            # error event + resume hints — never a silent dead socket
+            FLEET_PROXIED.inc(outcome="broken_stream")
+            payload = {"error": {
+                "type": "replica_stream_broken",
+                "replica": rep.name,
+                "message": f"{type(e).__name__}: {e}",
+                "resume": {
+                    "chunks_relayed": chunks,
+                    "hint": "re-issue the request with the partial "
+                            "assistant content appended to messages; "
+                            "prefix-affinity routes the retry onto a "
+                            "replica holding the shared prefix",
+                },
+            }}
+            try:
+                await resp.write(b"data: "
+                                 + json.dumps(payload).encode() + b"\n\n")
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+            except _transport_errors():
+                pass                        # client also gone
+            return resp, False
+
+    # -- passthrough + introspection ----------------------------------------
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        for rep in self.registry.replicas():
+            if not rep.routable():
+                continue
+            try:
+                import aiohttp
+                tmo = aiohttp.ClientTimeout(total=5.0)
+                async with self.session.get(
+                        rep.base_url + "/v1/models", timeout=tmo) as r:
+                    return web.Response(body=await r.read(),
+                                        status=r.status,
+                                        content_type=r.content_type
+                                        or "application/json")
+            except _transport_errors():
+                continue
+        return self._no_replica()
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        snap = self.registry.snapshot()
+        ok = snap["routable"] > 0 and not self.draining
+        body = {"status": "ok" if ok else "degraded",
+                "fleet": snap, "inflight": self.inflight,
+                "global_cap": self._global_cap()}
+        if self.draining:
+            body["draining"] = True
+        return web.json_response(body, status=200 if ok else 503)
+
+    async def handle_fleet(self, request: web.Request) -> web.Response:
+        return web.json_response(self.registry.snapshot())
+
+
+async def _metrics(request: web.Request) -> web.Response:
+    from ..obs import REGISTRY
+    return web.Response(
+        body=REGISTRY.render().encode(),
+        headers={"Content-Type":
+                 "text/plain; version=0.0.4; charset=utf-8"})
+
+
+def create_router_app(router: FleetRouter) -> web.Application:
+    app = web.Application()
+    app["router"] = router
+    app.router.add_post("/v1/chat/completions", router.handle_chat)
+    app.router.add_get("/v1/models", router.handle_models)
+    app.router.add_get("/health", router.handle_health)
+    app.router.add_get("/fleet", router.handle_fleet)
+    app.router.add_get("/metrics", _metrics)
+    app.on_startup.append(router.start)
+    app.on_shutdown.append(router.drain)
+    app.on_cleanup.append(router.stop)
+    return app
+
+
+def serve_router(replicas: list, host: str = "0.0.0.0", port: int = 8100,
+                 cluster_key: str | None = None):
+    """Blocking router entry (ref: `cake route`). `replicas` is
+    [(name, base_url), ...] from --replica flags; when a cluster key is
+    given, announced replicas discovered over UDP join too (and keep
+    joining every CAKE_FLEET_DISCOVER_S)."""
+    registry = ReplicaRegistry()
+    for name, base_url in replicas:
+        registry.add(name, base_url)
+    if cluster_key:
+        for name, base_url in discover_replicas(cluster_key):
+            registry.add(name, base_url)
+    router = FleetRouter(registry, cluster_key=cluster_key)
+    app = create_router_app(router)
+    log.info("fleet router on http://%s:%d fronting %d replicas",
+             host, port, len(registry.names()))
+    web.run_app(app, host=host, port=port, print=None)

@@ -146,6 +146,68 @@ _knob("CAKE_SPEC_RESERVE", int, 0, "spec",
       "unwritten frontier is backed by blocks ahead of the dispatch "
       "(rolled back on rejection/preemption); 0 = the full draft window")
 
+# -- fleet (router tier over N serve replicas) ----------------------------
+_knob("CAKE_FLEET_PROBE_S", float, 2.0, "fleet",
+      "router health-probe interval per replica: each tick GETs /health "
+      "and consumes the engine block (down/wedged/draining, queue depth, "
+      "kv_pool occupancy) into the membership state machine")
+_knob("CAKE_FLEET_EJECT_FAILS", int, 3, "fleet",
+      "consecutive transport failures (connect refused/reset/timeout) "
+      "that eject a replica from routing")
+_knob("CAKE_FLEET_ERR_WINDOW", int, 32, "fleet",
+      "rolling per-replica result window the gray-failure detector "
+      "computes its error rate and TTFT p95 over")
+_knob("CAKE_FLEET_ERR_RATE", float, 0.5, "fleet",
+      "gray-failure eject threshold: error rate over the rolling window "
+      "(needs >= 8 samples) at or above this ejects the replica")
+_knob("CAKE_FLEET_DEGRADED_TTFT_MS", float, 0.0, "fleet",
+      "gray-failure eject threshold on rolling TTFT p95 — a slow-but-"
+      "alive replica is ejected before clients notice; 0 disables "
+      "(same shape as the cluster hop detector's CAKE_HOP_DEGRADED_MS)")
+_knob("CAKE_FLEET_EJECT_S", float, 5.0, "fleet",
+      "ejection hold before the half-open probe (doubles per consecutive "
+      "re-eject, capped at 8x); a half-open replica readmits on one "
+      "successful trial request or two consecutive healthy probes")
+_knob("CAKE_FLEET_RETRIES", int, 2, "fleet",
+      "per-request failover budget: how many ADDITIONAL replicas a "
+      "non-streamed (or pre-first-token streamed) request may retry on "
+      "after its first attempt fails; exhaustion answers a typed 503")
+_knob("CAKE_FLEET_BACKOFF_S", float, 0.05, "fleet",
+      "retry backoff base between failover attempts (capped exponential "
+      "+/-25% jitter, same scheme as cluster recovery)")
+_knob("CAKE_FLEET_HEDGE_MS", float, 0.0, "fleet",
+      "tail-hedging threshold for non-streamed requests: no reply after "
+      "this long fires a duplicate at the next-best replica and the "
+      "first response wins (Dean & Barroso hedged requests); 0 disables")
+_knob("CAKE_FLEET_MAX_INFLIGHT", int, 0, "fleet",
+      "global router admission bound: in-flight proxied requests at or "
+      "past this shed typed 429s AT THE ROUTER before any replica "
+      "admits; 0 = auto (sum of per-replica caps)")
+_knob("CAKE_FLEET_REPLICA_INFLIGHT", int, 0, "fleet",
+      "per-replica in-flight cap; 0 = auto (2x the replica's slot count "
+      "from its last health probe, 8 before the first probe lands)")
+_knob("CAKE_FLEET_AFFINITY", bool, True, "fleet",
+      "prefix-affinity routing (blake2b chain over the rendered prompt, "
+      "rendezvous-hashed onto replicas so conversational follow-ups land "
+      "on the replica holding their KV blocks); off = round-robin")
+_knob("CAKE_FLEET_AFFINITY_BLOCKS", int, 64, "fleet",
+      "affinity chain depth cap in 256-byte blocks over the conversation "
+      "head (leading system message + first user message) — a cost "
+      "backstop against pathological first messages, NOT a spreading "
+      "window: it must comfortably cover the system prompt, or every "
+      "conversation hashes to the same key and one replica goes hot")
+_knob("CAKE_FLEET_ATTEMPT_TIMEOUT_S", float, 0.0, "fleet",
+      "per-attempt deadline on one replica try (connect + response); an "
+      "overrun counts as a transport failure and the request fails over; "
+      "0 disables (generation time is unbounded by default)")
+_knob("CAKE_FLEET_DISCOVER_S", float, 0.0, "fleet",
+      "periodic UDP re-discovery interval: newly announced `cake serve "
+      "--announce` replicas join the registry without a router restart; "
+      "0 = discover once at startup only")
+_knob("CAKE_FLEET_FAULT_PLAN", str, None, "fleet",
+      'deterministic router fault injection (tests/drills only), e.g. '
+      '"replica=r1;refuse_after_ops=3" — see fleet/faults.py')
+
 # -- cluster --------------------------------------------------------------
 _knob("CAKE_CLUSTER_KEY", str, None, "cluster",
       "pre-shared key enabling distributed mode (mutual auth between "
@@ -194,6 +256,7 @@ _knob("CAKE_TPU_CACHE", str, "~/.cache/cake-tpu", "paths",
 _AREA_TITLES = (
     ("serve", "Serving (continuous-batching engine)"),
     ("spec", "Speculative decoding"),
+    ("fleet", "Fleet (router tier over N serve replicas)"),
     ("cluster", "Cluster (distributed pipeline + fault tolerance)"),
     ("obs", "Observability"),
     ("ops", "Ops / kernels"),

@@ -181,6 +181,64 @@ SERVE_PREEMPTIONS = REGISTRY.counter(
     "Slots evicted because the paged KV pool was exhausted",
     labelnames=("mode",))           # swap | recompute
 
+FLEET_REPLICAS = REGISTRY.gauge(
+    "cake_fleet_replicas",
+    "Registered replicas by membership state — the primary autoscaling "
+    "signal (healthy shrinking or ejected growing means capacity loss)",
+    labelnames=("state",))          # healthy | ejected | half_open |
+                                    # draining
+
+FLEET_REPLICA_QUEUE_DEPTH = REGISTRY.gauge(
+    "cake_fleet_replica_queue_depth",
+    "Per-replica admission-queue depth mirrored from the last /health "
+    "probe (router-side autoscaling signal: sum across replicas is the "
+    "fleet backlog)",
+    labelnames=("replica",))
+
+FLEET_REPLICA_OCCUPANCY = REGISTRY.gauge(
+    "cake_fleet_replica_occupancy",
+    "Per-replica KV occupancy [0, 1] mirrored from the last /health "
+    "probe (paged pools report block occupancy, contiguous pools "
+    "busy-slot fraction)",
+    labelnames=("replica",))
+
+FLEET_REPLICA_INFLIGHT = REGISTRY.gauge(
+    "cake_fleet_replica_inflight",
+    "Requests the router currently has proxied onto the replica "
+    "(bounded by the per-replica in-flight cap)",
+    labelnames=("replica",))
+
+FLEET_SHEDS = REGISTRY.counter(
+    "cake_fleet_sheds_total",
+    "Requests shed 429 AT THE ROUTER before any replica admitted them",
+    labelnames=("reason",))         # global | replica_cap | no_replica
+
+FLEET_EJECTS = REGISTRY.counter(
+    "cake_fleet_ejects_total",
+    "Replica ejections from routing membership",
+    labelnames=("replica", "reason"))   # fails | error_rate | ttft_p95 |
+                                        # health
+
+FLEET_READMITS = REGISTRY.counter(
+    "cake_fleet_readmits_total",
+    "Replicas readmitted to routing after a half-open trial succeeded",
+    labelnames=("replica",))
+
+FLEET_RETRIES = REGISTRY.counter(
+    "cake_fleet_retries_total",
+    "Failover retries: attempts re-routed to another replica after a "
+    "retryable failure (transport error, replica 5xx/429)")
+
+FLEET_HEDGES = REGISTRY.counter(
+    "cake_fleet_hedges_total",
+    "Tail-hedged duplicates fired at a second replica after "
+    "CAKE_FLEET_HEDGE_MS without a reply")
+
+FLEET_PROXIED = REGISTRY.counter(
+    "cake_fleet_requests_total",
+    "Chat requests proxied through the fleet router",
+    labelnames=("outcome",))        # ok | failed | shed | broken_stream
+
 CLUSTER_STAGE_FAILURES = REGISTRY.counter(
     "cake_cluster_stage_failures_total",
     "Classified remote-hop failures observed by the master",
@@ -234,4 +292,8 @@ __all__ = [
     "CLUSTER_REPLAYS", "CLUSTER_DEGRADED", "CLUSTER_HOP_DEGRADED",
     "SPEC_PROPOSED", "SPEC_ACCEPTED", "SPEC_ACCEPTED_LEN",
     "SPEC_BUCKET_ACCEPTED",
+    "FLEET_REPLICAS", "FLEET_REPLICA_QUEUE_DEPTH",
+    "FLEET_REPLICA_OCCUPANCY", "FLEET_REPLICA_INFLIGHT", "FLEET_SHEDS",
+    "FLEET_EJECTS", "FLEET_READMITS", "FLEET_RETRIES", "FLEET_HEDGES",
+    "FLEET_PROXIED",
 ]

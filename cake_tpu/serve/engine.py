@@ -445,7 +445,7 @@ class ServeEngine:
                 retry_after_s=max(
                     int(self.supervisor.restore_interval_s) + 1, 5))
         if self._draining.is_set():
-            raise EngineDraining()
+            raise EngineDraining(retry_after_s=self.retry_after_hint())
         if self.supervisor.is_quarantined(prompt_ids):
             raise PoisonedRequest(
                 "request fingerprint quarantined: identical prompt was "
@@ -579,6 +579,30 @@ class ServeEngine:
             }
         return h
 
+    def begin_drain(self) -> None:
+        """Flip the draining flag WITHOUT waiting: new submits raise
+        EngineDraining and /health's engine block reports draining
+        immediately — a fleet router probing /health stops routing here
+        before the first bounced request, instead of discovering the
+        drain from 503s. drain() calls this; the API's graceful shutdown
+        calls it up front, before handing the blocking wait to an
+        executor thread."""
+        self._draining.set()
+        self._wake.set()
+
+    def retry_after_hint(self) -> int:
+        """Seconds a shed/refused client should wait before retrying,
+        derived from live state instead of a constant: a DOWN engine
+        says the restore-probe interval (the soonest revival can
+        happen), a backlogged engine scales with queue depth per slot —
+        so routers and clients back off proportionally to the actual
+        congestion."""
+        down = self.supervisor.down_info()
+        if down is not None:
+            return max(int(self.supervisor.restore_interval_s) + 1, 5)
+        depth = self.queue.depth()
+        return max(1, min(30, 1 + (2 * depth) // max(self.slots, 1)))
+
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful-shutdown phase 1: stop admission (new submits raise
         EngineDraining -> 503 + Retry-After) and wait for in-flight work —
@@ -586,8 +610,7 @@ class ServeEngine:
         seconds. Returns True when the engine went idle; False means the
         timeout hit and close() will fail whatever is left. Safe to call
         from any thread; blocks the caller, not the scheduler."""
-        self._draining.set()
-        self._wake.set()
+        self.begin_drain()
         deadline = None if timeout is None else now() + timeout
         while self.pool.busy_count or self.queue.depth() or self._preempted:
             if self.dead is not None or not self._thread.is_alive():

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Fleet chaos smoke: 3 real serve replicas behind the fleet router, one
+killed mid-traffic — the fleet must absorb it invisibly.
+
+Asserts, in order:
+  1. transparent failover: concurrent NON-STREAMED chat traffic across
+     the kill completes with ZERO failed requests (the router retries
+     dropped attempts on the next-best replica);
+  2. the kill is visible as an eject -> (restart) -> readmit cycle in
+     the router's /fleet view AND /metrics (cake_fleet_ejects_total,
+     cake_fleet_readmits_total);
+  3. saturation sheds at the ROUTER: with a small global admission bound
+     and slowed decode, overflow answers 429 with shed_by=router (and
+     zero replica-originated 5xx/429s leak through).
+
+Every phase polls WITH A DEADLINE (the serve-chaos lesson: fixed sleeps
+flake on this container's slow CPU). Exits non-zero on any missing
+signal. Run via `make fleet-chaos-smoke`.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                    # noqa: E402
+from aiohttp import web                                    # noqa: E402
+from aiohttp.test_utils import TestClient, TestServer      # noqa: E402
+
+from cake_tpu.api import ApiState, create_app              # noqa: E402
+from cake_tpu.fleet import (FleetRouter, MembershipPolicy,  # noqa: E402
+                            ReplicaRegistry, create_router_app)
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+from cake_tpu.serve import faults as serve_faults          # noqa: E402
+
+CTX = 128
+N_REPLICAS = 3
+MAX_NEW = 8
+
+
+class SmokeTok:
+    def encode(self, text):
+        return [3 + (sum(w.encode()) % 200) for w in text.split()][:48] or [3]
+
+    def decode(self, ids):
+        return "".join(f"<{i}>" for i in ids)
+
+
+class ReplicaProc:
+    """One in-process serve replica: real engine, real HTTP socket on a
+    stable port so a restart is indistinguishable from a process coming
+    back."""
+
+    def __init__(self, name: str, model):
+        self.name = name
+        self.engine = ServeEngine(model, slots=2, max_queue=16, ctx_len=CTX)
+        self.state = ApiState(model=model, tokenizer=SmokeTok(),
+                              model_id=f"tiny-{name}")
+        self.state.engine = self.engine
+        self.runner = None
+        self.port = None
+
+    async def start(self) -> str:
+        self.runner = web.AppRunner(create_app(self.state))
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", self.port or 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def kill(self):
+        """Sever the HTTP surface (the engine thread stays, like a
+        network partition / crashed frontend)."""
+        await self.runner.cleanup()
+        self.runner = None
+
+    def close(self):
+        self.engine.close()
+
+
+async def _chat(client, convo: int, turn: int):
+    return await client.post("/v1/chat/completions", json={
+        "messages": [
+            {"role": "system", "content": "fleet smoke system prompt "
+                                          "shared by every conversation"},
+            {"role": "user", "content": f"conversation {convo} says "
+                                        f"hello at turn {turn}"}],
+        "max_tokens": MAX_NEW, "temperature": 0.0})
+
+
+async def _poll_fleet(client, pred, deadline_s: float, what: str):
+    deadline = time.monotonic() + deadline_s
+    snap = None
+    while time.monotonic() < deadline:
+        snap = await (await client.get("/fleet")).json()
+        if pred(snap):
+            return snap
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}: {snap}")
+
+
+async def main_async() -> dict:
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=CTX)
+    out: dict = {}
+    replicas = [ReplicaProc(f"r{i}", model) for i in range(N_REPLICAS)]
+    registry = ReplicaRegistry(MembershipPolicy(
+        eject_fails=2, err_window=16, err_rate=0.5,
+        degraded_ttft_ms=0.0, eject_s=0.3))
+    router = FleetRouter(registry, retries=2, backoff_s=0.01,
+                         probe_s=0.15, hedge_ms=0.0, max_inflight=0)
+    client = None
+    try:
+        for rep in replicas:
+            registry.add(rep.name, await rep.start())
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()
+
+        # -- phase 1: concurrent traffic across a replica kill ------------
+        statuses: list[int] = []
+        victim = replicas[1]
+
+        async def worker(convo: int):
+            for turn in range(6):
+                r = await _chat(client, convo, turn)
+                statuses.append(r.status)
+                await r.read()
+
+        tasks = [asyncio.create_task(worker(c)) for c in range(6)]
+        await asyncio.sleep(0.25)       # let traffic + compiles start
+        await victim.kill()
+        out["killed"] = victim.name
+        await asyncio.gather(*tasks)
+        failed = [s for s in statuses if s != 200]
+        assert not failed, f"non-streamed requests failed across the " \
+                           f"kill: {failed} of {len(statuses)}"
+        out["requests_across_kill"] = len(statuses)
+        out["failed_across_kill"] = 0
+
+        # the kill shows up as an ejection in the membership view
+        snap = await _poll_fleet(
+            client, lambda s: any(r["name"] == victim.name
+                                  and r["state"] == "ejected"
+                                  for r in s["replicas"]),
+            10.0, f"{victim.name} ejected")
+        out["ejected_visible"] = True
+
+        # -- phase 2: restart the replica -> readmission ------------------
+        await victim.start()            # same port, same name
+        snap = await _poll_fleet(
+            client, lambda s: any(r["name"] == victim.name
+                                  and r["state"] == "healthy"
+                                  for r in s["replicas"]),
+            15.0, f"{victim.name} readmitted")
+        out["readmitted_visible"] = True
+        assert snap["routable"] == N_REPLICAS
+
+        # eject + readmit cycle is in /metrics
+        mtext = await (await client.get("/metrics")).text()
+        for metric in ("cake_fleet_ejects_total", "cake_fleet_readmits_total"):
+            m = re.search(rf'^{metric}{{[^}}]*replica="{victim.name}"'
+                          rf'[^}}]*}}\s+(\d+)', mtext, re.M)
+            assert m and int(m.group(1)) >= 1, f"{metric} missing: " \
+                f"{[l for l in mtext.splitlines() if metric in l]}"
+        out["metrics_cycle"] = True
+
+        # -- phase 3: saturation sheds 429 AT THE ROUTER ------------------
+        router.max_inflight = 3
+        serve_faults.install("delay_ms=40")     # slow every decode step
+        try:
+            results = await asyncio.gather(
+                *[_chat(client, 100 + i, 0) for i in range(16)])
+            sat = [(r.status, await r.json()) for r in results]
+        finally:
+            serve_faults.clear()
+            router.max_inflight = 0
+        shed = [b for s, b in sat if s == 429]
+        ok = [b for s, b in sat if s == 200]
+        bad = [(s, b) for s, b in sat if s not in (200, 429)]
+        assert not bad, f"unexpected statuses under saturation: {bad}"
+        assert shed, "saturation produced no 429s"
+        assert all(b.get("shed_by") == "router" for b in shed), \
+            f"429s not shed by the router: {shed[:2]}"
+        out["saturation"] = {"ok": len(ok), "shed_by_router": len(shed)}
+        mtext = await (await client.get("/metrics")).text()
+        m = re.search(r"^cake_fleet_sheds_total{[^}]*}\s+(\d+)", mtext,
+                      re.M)
+        assert m and int(m.group(1)) >= 1, "cake_fleet_sheds_total missing"
+
+        # fleet health is clean again
+        h = await client.get("/health")
+        assert h.status == 200, await h.text()
+        out["health"] = 200
+        return out
+    finally:
+        if client is not None:
+            await client.close()
+        for rep in replicas:
+            if rep.runner is not None:
+                await rep.kill()
+            rep.close()
+
+
+def main() -> int:
+    out = asyncio.new_event_loop().run_until_complete(main_async())
+    print("fleet-chaos-smoke OK:")
+    for k, v in out.items():
+        print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

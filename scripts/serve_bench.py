@@ -323,6 +323,181 @@ def bench_spec(model):
             "speculation_pays": best >= 1.3}
 
 
+# -- fleet affinity bench ---------------------------------------------------
+
+FLEET_CONVOS = 8
+FLEET_TURNS = 3
+FLEET_MAX_NEW = 6
+FLEET_CTX = 256     # conversations grow ~2 blocks per turn; the affinity
+                    # win is the convo-SPECIFIC prefix, so prompts must
+                    # outgrow the small shared system block
+
+
+class FleetTok:
+    """Word-hash tokenizer (no length cap): conversation prompts grow a
+    shared token prefix turn over turn, which is what the replica prefix
+    caches (and therefore affinity routing) exist for."""
+
+    def encode(self, text):
+        return [3 + (sum(w.encode()) % 200) for w in text.split()] or [3]
+
+    def decode(self, ids):
+        return " ".join(f"t{i}" for i in ids)
+
+
+def _fleet_messages(convo: int, turn: int) -> list:
+    """Realistic multi-turn shape: a SMALL shared system prompt (one
+    block — both replicas cache it immediately, it is not what affinity
+    is for) and a LARGE conversation-specific history (~3 blocks of
+    opening + ~2 blocks per turn) that only the owning replica holds."""
+    msgs = [{"role": "system",
+             "content": "fleet bench shared system prompt please answer "
+                        "helpfully and briefly at all times ok"}]
+    msgs.append({"role": "user",
+                 "content": f"conversation {convo} opening question: "
+                 + " ".join(f"ctx{convo}word{i}" for i in range(44))})
+    for t in range(turn):
+        msgs.append({"role": "assistant", "content": " ".join(
+            f"answer{convo}t{t}w{i}" for i in range(14))})
+        msgs.append({"role": "user", "content": " ".join(
+            f"follow{convo}t{t}w{i}" for i in range(14))})
+    return msgs
+
+
+def bench_fleet(model):
+    """Prefix-affinity routing vs round-robin through the REAL router
+    over 2 real replicas: conversational follow-up traffic, per-turn
+    time-to-first-content-token. Affinity keeps every turn of a
+    conversation on its owning replica, whose prefix cache then serves
+    the shared head warm; round-robin alternates replicas per request,
+    so roughly half the follow-ups prefill cold. Fresh replicas per
+    mode (no cache pollution across modes); untimed warmup pass
+    compiles every chunk/slot bucket first."""
+    import asyncio
+
+    from aiohttp import web as aioweb
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from cake_tpu.api import ApiState, create_app
+    from cake_tpu.fleet import (FleetRouter, MembershipPolicy,
+                                ReplicaRegistry, create_router_app)
+
+    async def run_mode(affinity: bool) -> dict:
+        engines, runners = [], []
+        registry = ReplicaRegistry(MembershipPolicy())
+        for i in range(2):
+            eng = ServeEngine(model, slots=2, max_queue=32,
+                              ctx_len=FLEET_CTX,
+                              prefill_chunk=CHUNK, prefix_cache_mb=64)
+            engines.append(eng)
+            state = ApiState(model=model, tokenizer=FleetTok(),
+                             model_id=f"bench-r{i}")
+            state.engine = eng
+            runner = aioweb.AppRunner(create_app(state))
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            port = site._server.sockets[0].getsockname()[1]
+            registry.add(f"r{i}", f"http://127.0.0.1:{port}")
+        router = FleetRouter(registry, retries=1, backoff_s=0.01,
+                             probe_s=5.0, hedge_ms=0.0,
+                             affinity=affinity)
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()
+
+        import aiohttp
+        stats_session = aiohttp.ClientSession()
+
+        async def first_token_s(messages) -> dict:
+            """Route one streamed turn through the ROUTER, then read the
+            serving replica's engine-reported stats (/api/v1/stats,
+            matched by completion id): engine ttft_s covers queue +
+            prefill + first decode without the HTTP/poll quantization
+            (~20ms on this box) that would otherwise drown the
+            chunk-level prefill cost the bench compares, and
+            prefix_hit_tokens shows the warm-admission mechanism
+            directly."""
+            cid = None
+            buf = b""
+            async with client.post("/v1/chat/completions", json={
+                    "messages": messages, "stream": True,
+                    "max_tokens": FLEET_MAX_NEW,
+                    "temperature": 0.0}) as r:
+                assert r.status == 200, await r.text()
+                async for piece in r.content.iter_any():
+                    buf += piece
+                    # parse only once a COMPLETE event arrived — a TCP
+                    # piece can end mid-JSON
+                    if cid is None and b"\n\n" in buf:
+                        first = buf.split(b"\n\n", 1)[0]
+                        cid = json.loads(
+                            first.split(b"data: ", 1)[1])["id"]
+            assert cid is not None, "stream carried no completion id"
+            for rep in registry.replicas():
+                async with stats_session.get(
+                        rep.base_url + "/api/v1/stats") as sr:
+                    stats = (await sr.json()).get("stats") or {}
+                if stats.get("request_id") == cid:
+                    return {"ttft_s": stats["ttft_s"],
+                            "prefix_hit_tokens":
+                                stats.get("prefix_hit_tokens", 0)}
+            raise AssertionError(f"no replica reported stats for {cid}")
+
+        try:
+            for c in range(3):                  # untimed compile warmup
+                for t in range(3):
+                    await first_token_s(_fleet_messages(90 + c, t))
+            # fixed-seed shuffled arrival order: real users interleave
+            # arbitrarily. (Turn-major order would stride requests by
+            # convo count — an even stride over 2 replicas makes plain
+            # round-robin accidentally convo-sticky, hiding exactly the
+            # effect this bench measures. Out-of-order turns still warm
+            # correctly: a later turn's prompt CONTAINS every earlier
+            # turn's prompt as a prefix, so whichever lands first
+            # inserts the blocks the other hits.)
+            import random as _random
+            order = [(c, t) for t in range(FLEET_TURNS)
+                     for c in range(FLEET_CONVOS)]
+            _random.Random(7).shuffle(order)
+            opening, followup = [], []
+            for c, t in order:
+                s = await first_token_s(_fleet_messages(c, t))
+                (opening if t == 0 else followup).append(s)
+            hits = sum((e.health().get("prefix_cache") or {})
+                       .get("hits", 0) for e in engines)
+            fu = [s["ttft_s"] for s in followup]
+            return {
+                "mode": "affinity" if affinity else "round_robin",
+                "opening_ttft_p50_s": round(
+                    _pctl([s["ttft_s"] for s in opening], 0.5), 5),
+                "followup_ttft_p50_s": round(_pctl(fu, 0.5), 5),
+                "followup_ttft_p99_s": round(_pctl(fu, 0.99), 5),
+                "followup_ttft_mean_s": round(statistics.mean(fu), 5),
+                "followup_prefix_hit_tokens": sum(
+                    s["prefix_hit_tokens"] for s in followup),
+                "prefix_cache_hits": hits,
+            }
+        finally:
+            await stats_session.close()
+            await client.close()
+            for runner in runners:
+                await runner.cleanup()
+            for eng in engines:
+                eng.close()
+
+    aff = asyncio.new_event_loop().run_until_complete(run_mode(True))
+    rr = asyncio.new_event_loop().run_until_complete(run_mode(False))
+    return {
+        "affinity": aff,
+        "round_robin": rr,
+        "followup_speedup_p50": round(
+            rr["followup_ttft_p50_s"] / aff["followup_ttft_p50_s"], 3),
+        "affinity_wins": aff["followup_ttft_p50_s"]
+        < rr["followup_ttft_p50_s"],
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tag", default="local")
@@ -335,7 +510,41 @@ def main() -> int:
                     help="batched-speculation mode: acceptance x "
                     "occupancy x effective tok/s, spec on vs off, "
                     "contiguous + paged engines")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode: 2 replicas + router, follow-up "
+                    "TTFT under prefix-affinity routing vs round-robin")
     args = ap.parse_args()
+
+    if args.fleet:
+        model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                          max_cache_len=FLEET_CTX)
+        out = {
+            "bench": "serve-fleet",
+            "ts": int(time.time()),
+            "config": {"ctx": FLEET_CTX, "prefill_chunk": CHUNK,
+                       "replicas": 2, "convos": FLEET_CONVOS,
+                       "turns": FLEET_TURNS, "platform": "cpu-tiny"},
+            "fleet": bench_fleet(model),
+        }
+        path = args.out or f"BENCH_FLEET_{args.tag}.json"
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(json.dumps(out, indent=2))
+        print(f"\nwrote {path}", file=sys.stderr)
+        fl = out["fleet"]
+        # hard gate on the DETERMINISTIC signal (routing is a pure
+        # function of the shuffled workload, so hit tokens cannot
+        # flake); wall-clock TTFT is advisory on a noisy CPU box
+        if not (fl["affinity"]["followup_prefix_hit_tokens"]
+                > fl["round_robin"]["followup_prefix_hit_tokens"]):
+            print("FAIL: affinity routing reused no more prefix tokens "
+                  "than round-robin", file=sys.stderr)
+            return 1
+        if not fl["affinity_wins"]:
+            print("warning: affinity follow-up TTFT p50 did not beat "
+                  "round-robin this run (wall-clock noise)",
+                  file=sys.stderr)
+        return 0
 
     if args.spec:
         model = TextModel(tiny_config("llama"), dtype=jnp.float32,

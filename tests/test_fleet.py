@@ -1,0 +1,716 @@
+"""Fleet router: membership + failover (ISSUE 12 acceptance pins).
+
+Units cover the registry state machine (join/leave, gray-eject /
+half-open / readmit, draining, health-driven ejection) and the affinity
+chain (same conversation -> same replica; ejected owner -> deterministic
+next-best). HTTP-level tests drive a real router app over FAKE replica
+servers (canned JSON/SSE — no model, no engine) and pin the failure
+semantics: transparent failover, retry-budget exhaustion as a typed 503,
+router-level 429 before any replica admits, and the typed mid-stream
+error event with resume hints.
+"""
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from cake_tpu.fleet import (EJECTED, HALF_OPEN, HEALTHY, FleetRouter,
+                            MembershipPolicy, Replica, ReplicaRegistry,
+                            affinity_key, conversation_head,
+                            create_router_app, rank_replicas)
+from cake_tpu.fleet import faults as fleet_faults
+
+
+def _policy(**kw):
+    base = dict(eject_fails=3, err_window=16, err_rate=0.5,
+                degraded_ttft_ms=0.0, eject_s=0.05, replica_inflight=0)
+    base.update(kw)
+    return MembershipPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# registry units
+# ---------------------------------------------------------------------------
+
+
+def test_registry_join_leave():
+    reg = ReplicaRegistry(_policy())
+    r0 = reg.add("r0", "http://h:1/")
+    assert r0.base_url == "http://h:1"          # trailing slash normalized
+    reg.add("r1", "http://h:2")
+    assert sorted(reg.names()) == ["r0", "r1"]
+    # re-join refreshes the URL but keeps state (no eject laundering)
+    r0.record_result(False, transport=True)
+    again = reg.add("r0", "http://h:9")
+    assert again is r0 and r0.base_url == "http://h:9"
+    assert r0.snapshot()["consec_fails"] == 1
+    assert reg.remove("r1") and not reg.remove("r1")
+    assert reg.names() == ["r0"]
+
+
+def test_eject_on_consecutive_transport_fails():
+    rep = Replica("r0", "http://h:1", _policy(eject_fails=3))
+    assert rep.record_result(False, transport=True) is None
+    assert rep.record_result(False, transport=True) is None
+    assert rep.routable()
+    assert rep.record_result(False, transport=True) == "fails"
+    assert rep.snapshot()["state"] == EJECTED and not rep.routable()
+    # a success resets the consecutive counter
+    rep2 = Replica("r1", "http://h:2", _policy(eject_fails=3))
+    rep2.record_result(False, transport=True)
+    rep2.record_result(False, transport=True)
+    rep2.record_result(True, 5.0)
+    assert rep2.record_result(False, transport=True) is None
+    assert rep2.routable()
+
+
+def test_eject_on_error_rate_window():
+    rep = Replica("r0", "http://h:1",
+                  _policy(err_rate=0.5, err_window=16))
+    # HTTP 5xx (transport=False) never trips the consecutive-fail eject,
+    # only the rolling error rate — and only past GRAY_MIN_SAMPLES
+    for _ in range(3):
+        assert rep.record_result(False) is None
+    for _ in range(4):
+        rep.record_result(True, 5.0)
+    reason = rep.record_result(False)            # 8th sample, 50% errors
+    assert reason == "error_rate"
+    assert rep.snapshot()["state"] == EJECTED
+
+
+def test_eject_on_ttfb_p95_gray():
+    rep = Replica("r0", "http://h:1",
+                  _policy(degraded_ttft_ms=50.0))
+    reason = None
+    for _ in range(10):
+        reason = rep.record_result(True, 120.0) or reason
+    assert reason == "ttft_p95"                  # slow-but-alive ejects
+    # under the threshold: never ejected
+    rep2 = Replica("r1", "http://h:2", _policy(degraded_ttft_ms=50.0))
+    for _ in range(10):
+        assert rep2.record_result(True, 10.0) is None
+
+
+def test_half_open_trial_and_readmit_cycle():
+    rep = Replica("r0", "http://h:1", _policy(eject_s=0.01))
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    assert rep.snapshot()["state"] == EJECTED
+    healthy = {"engine": {"alive": True, "slots": 4, "queue_depth": 0}}
+    # probe before the hold expires: stays ejected
+    rep.observe_health(200, healthy)
+    assert rep.snapshot()["state"] == EJECTED
+    import time
+    time.sleep(0.02)
+    rep.observe_health(200, healthy)
+    assert rep.snapshot()["state"] == HALF_OPEN
+    # exactly ONE trial request at a time
+    lease = rep.try_acquire()
+    assert lease == "trial"
+    assert not rep.try_acquire()
+    rep.record_result(True, 5.0, lease=lease)    # trial succeeded
+    rep.release(lease)
+    assert rep.snapshot()["state"] == HEALTHY
+    assert rep.snapshot()["eject_streak"] == 0
+
+
+def test_half_open_failure_re_ejects_with_backoff():
+    rep = Replica("r0", "http://h:1", _policy(eject_s=0.01))
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    first_until = rep.eject_until
+    import time
+    time.sleep(0.02)
+    rep.observe_health(200, {"engine": {"alive": True}})
+    assert rep.snapshot()["state"] == HALF_OPEN
+    lease = rep.try_acquire()
+    assert lease == "trial"
+    assert rep.record_result(False, transport=True,
+                             lease=lease) == "fails"
+    rep.release(lease)
+    snap = rep.snapshot()
+    assert snap["state"] == EJECTED and snap["eject_streak"] == 2
+    assert rep.eject_until > first_until         # hold doubled
+
+
+def test_stale_outcomes_do_not_move_half_open_or_ejected():
+    """Outcomes of requests that STARTED before an ejection are stale
+    evidence: a pre-eject failure landing during probation must not
+    re-eject (it is the old incident, not the trial), a pre-eject
+    success must not readmit without a trial, and an EJECTED replica
+    ignores outcomes entirely."""
+    rep = Replica("r0", "http://h:1", _policy(eject_s=0.0))
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    assert rep.snapshot()["state"] == EJECTED
+    assert rep.record_result(False, transport=True) is None   # ignored
+    assert rep.snapshot()["eject_streak"] == 1                # no re-eject
+    rep.observe_health(200, {"engine": {"alive": True}})
+    assert rep.snapshot()["state"] == HALF_OPEN
+    # stale pre-eject outcomes carry the default "slot" lease
+    assert rep.record_result(False, transport=True) is None
+    assert rep.snapshot()["state"] == HALF_OPEN               # survived
+    rep.record_result(True, 5.0)                              # stale ok
+    assert rep.snapshot()["state"] == HALF_OPEN               # no readmit
+    trial = rep.try_acquire()
+    rep.record_result(True, 5.0, lease=trial)                 # real trial
+    rep.release(trial)
+    assert rep.snapshot()["state"] == HEALTHY
+
+
+def test_stale_release_cannot_clear_trial_lease():
+    """A request acquired while HEALTHY and released after the replica
+    went HALF_OPEN must not clear the trial flag of a probation request
+    still in flight (the lease token carries who was the trial)."""
+    rep = Replica("r0", "http://h:1", _policy(eject_s=0.0))
+    old = rep.try_acquire()
+    assert old == "slot"                         # in flight pre-eject
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    rep.observe_health(200, {"engine": {"alive": True}})
+    assert rep.snapshot()["state"] == HALF_OPEN
+    trial = rep.try_acquire()
+    assert trial == "trial"
+    rep.release(old)                             # stale release lands
+    assert not rep.try_acquire()                 # trial still exclusive
+    rep.release(trial)
+
+
+def test_half_open_probe_only_readmit():
+    """An idle fleet still readmits: two consecutive healthy probes."""
+    rep = Replica("r0", "http://h:1", _policy(eject_s=0.0))
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    healthy = {"engine": {"alive": True}}
+    rep.observe_health(200, healthy)             # -> half_open
+    assert rep.snapshot()["state"] == HALF_OPEN
+    rep.observe_health(200, healthy)             # second in a row
+    assert rep.snapshot()["state"] == HEALTHY
+
+
+def test_health_down_and_wedged_eject():
+    for block in ({"down": {"down_for_s": 3}}, {"wedged": True},
+                  {"alive": False}):
+        rep = Replica("r0", "http://h:1", _policy())
+        rep.observe_health(503, {"engine": {**block, "slots": 4}})
+        assert rep.snapshot()["state"] == EJECTED, block
+
+
+def test_health_draining_stops_routing_without_eject():
+    rep = Replica("r0", "http://h:1", _policy())
+    rep.observe_health(200, {"engine": {"alive": True, "draining": True,
+                                        "slots": 4}})
+    snap = rep.snapshot()
+    assert snap["state"] == "draining" and not rep.routable()
+    assert rep.ejects == 0
+    # drain ends (e.g. rolling restart came back): routable again
+    rep.observe_health(200, {"engine": {"alive": True, "slots": 4}})
+    assert rep.routable()
+
+
+def test_health_mirrors_load_signals():
+    rep = Replica("r0", "http://h:1", _policy())
+    rep.observe_health(200, {"engine": {
+        "alive": True, "slots": 4, "queue_depth": 7,
+        "kv_pool": {"occupancy": 0.625}}})
+    snap = rep.snapshot()
+    assert snap["queue_depth"] == 7
+    assert snap["occupancy"] == 0.625
+    assert snap["cap"] == 8                      # auto: 2x slots
+    # the REAL paged kv_pool block has used/blocks, no 'occupancy' key
+    # (serve/paged/pool.py occupancy()) — block occupancy is derived:
+    # 95% of blocks spoken for with half the slots busy must report
+    # 0.95, not 0.5, or the autoscaling signal under-drives
+    rep.observe_health(200, {"engine": {
+        "alive": True, "slots": 4, "slots_busy": 2,
+        "kv_pool": {"blocks": 64, "used": 61, "free": 3, "shared": 0}}})
+    assert rep.snapshot()["occupancy"] == round(61 / 64, 4)
+    # no kv_pool at all: busy-slot fraction
+    rep.observe_health(200, {"engine": {
+        "alive": True, "slots": 4, "slots_busy": 2}})
+    assert rep.snapshot()["occupancy"] == 0.5
+
+
+def test_unreachable_probes_eject():
+    rep = Replica("r0", "http://h:1", _policy(eject_fails=2))
+    rep.observe_health(None, None)
+    assert rep.snapshot()["state"] == HEALTHY
+    rep.observe_health(None, None)
+    assert rep.snapshot()["state"] == EJECTED
+
+
+# ---------------------------------------------------------------------------
+# affinity units
+# ---------------------------------------------------------------------------
+
+
+SYSTEM = {"role": "system", "content": "You are a helpful assistant. " * 20}
+
+
+def _convo(first_user: str, turns: int = 1) -> list:
+    msgs = [SYSTEM, {"role": "user", "content": first_user}]
+    for t in range(turns - 1):
+        msgs.append({"role": "assistant", "content": f"answer {t}"})
+        msgs.append({"role": "user", "content": f"follow-up {t}"})
+    return msgs
+
+
+def test_affinity_key_stable_across_turns():
+    k1 = affinity_key(conversation_head(_convo("plan a trip", 1)), 4)
+    k3 = affinity_key(conversation_head(_convo("plan a trip", 3)), 4)
+    assert k1 == k3                              # follow-ups keep the key
+    other = affinity_key(conversation_head(_convo("write a poem", 1)), 4)
+    assert other != k1                           # conversations spread
+
+
+def test_affinity_same_chain_same_replica_and_next_best():
+    names = [f"r{i}" for i in range(5)]
+    key = affinity_key(conversation_head(_convo("plan a trip")), 4)
+    rank1 = rank_replicas(key, names)
+    rank2 = rank_replicas(key, list(reversed(names)))
+    assert rank1 == rank2                        # order-independent
+    # ejecting the owner: every router agrees on the same next-best
+    survivors = [n for n in names if n != rank1[0]]
+    assert rank_replicas(key, survivors)[0] == rank1[1]
+
+
+def test_affinity_spreads_conversations():
+    names = [f"r{i}" for i in range(4)]
+    owners = set()
+    for i in range(32):
+        key = affinity_key(conversation_head(_convo(f"topic {i} " * 10)),
+                           64)
+        owners.add(rank_replicas(key, names)[0])
+    assert len(owners) >= 3                      # no single hotspot
+
+
+def test_affinity_spreads_despite_long_system_prompt():
+    """A fleet-wide system prompt longer than a small cap must not
+    collapse every conversation onto one key: the default cap (64
+    blocks = 16KB) covers system + first message, so conversations
+    still diverge."""
+    big_sys = {"role": "system", "content": "corporate policy text " * 150}
+    names = [f"r{i}" for i in range(4)]
+    keys, owners = set(), set()
+    for i in range(16):
+        msgs = [big_sys, {"role": "user", "content": f"question {i}"}]
+        key = affinity_key(conversation_head(msgs), 64)
+        keys.add(key)
+        owners.add(rank_replicas(key, names)[0])
+    assert len(keys) == 16                       # every convo distinct
+    assert len(owners) >= 2                      # and they spread
+
+
+# ---------------------------------------------------------------------------
+# fault-plan units
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_fault_plan_parse_and_refuse():
+    inj = fleet_faults.parse_plan("replica=r1;refuse_after_ops=2")
+    assert inj.on_attempt("r0") == 0.0           # other replicas untouched
+    assert inj.on_attempt("r1") == 0.0           # op 1 passes
+    with pytest.raises(ConnectionError):
+        inj.on_attempt("r1")                     # op 2+ refuse
+    with pytest.raises(ConnectionError):
+        inj.on_attempt("r1")
+    inj2 = fleet_faults.parse_plan(
+        "replica=r0;refuse_after_ops=1;refuse_times=1")
+    with pytest.raises(ConnectionError):
+        inj2.on_attempt("r0")
+    assert inj2.on_attempt("r0") == 0.0          # window passed
+    with pytest.raises(ValueError):
+        fleet_faults.parse_plan("refuse=1")      # replica= required
+    assert fleet_faults.parse_plan(
+        "replica=r2;break_stream_after=3").break_stream("r2", 3)
+
+
+# ---------------------------------------------------------------------------
+# HTTP-level: router over fake replicas
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """Canned `cake serve` stand-in: JSON + SSE chat, /health with an
+    engine block, a mutable behavior switch, and a request log."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.mode = "ok"        # ok | http500 | http429 | hang
+        self.served = []        # prompts this replica actually admitted
+        self.server = None
+        self.release = asyncio.Event()
+
+    def app(self) -> web.Application:
+        async def chat(request):
+            body = await request.json()
+            if self.mode == "http500":
+                return web.json_response({"error": "boom"}, status=500)
+            if self.mode == "http429":
+                return web.json_response({"error": "queue full"},
+                                         status=429,
+                                         headers={"Retry-After": "3"})
+            if self.mode == "hang":
+                await self.release.wait()
+            self.served.append(body["messages"][-1]["content"])
+            if body.get("stream"):
+                resp = web.StreamResponse(headers={
+                    "Content-Type": "text/event-stream"})
+                await resp.prepare(request)
+                n = 12 if self.mode == "slow_stream" else 4
+                for i in range(n):
+                    if self.mode == "slow_stream":
+                        await asyncio.sleep(0.05)
+                    try:
+                        await resp.write(
+                            b'data: {"choices":[{"delta":{"content":"tok'
+                            + str(i).encode() + b'"}}]}\n\n')
+                    except ConnectionError:
+                        return resp          # router/client went away
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            return web.json_response({
+                "id": "x", "object": "chat.completion",
+                "served_by": self.name,
+                "choices": [{"index": 0, "message":
+                             {"role": "assistant", "content": "hi"},
+                             "finish_reason": "stop"}]})
+
+        async def health(request):
+            return web.json_response({"engine": {
+                "alive": True, "slots": 2, "queue_depth": 0}})
+
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", chat)
+        app.router.add_get("/health", health)
+        return app
+
+    async def start(self):
+        self.server = TestServer(self.app())
+        await self.server.start_server()
+        return str(self.server.make_url(""))
+
+    async def stop(self):
+        if self.server is not None:
+            await self.server.close()
+
+
+def _fleet_client(n_replicas=2, **router_kw):
+    """(replicas, registry, router, mk) where mk() builds the started
+    TestClient — run inside asyncio.run."""
+    replicas = [FakeReplica(f"r{i}") for i in range(n_replicas)]
+    registry = ReplicaRegistry(_policy())
+
+    async def mk():
+        for rep in replicas:
+            url = await rep.start()
+            registry.add(rep.name, url)
+        kw = dict(retries=2, backoff_s=0.001, probe_s=30.0, hedge_ms=0.0)
+        kw.update(router_kw)
+        router = FleetRouter(registry, **kw)
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()
+        return client, router
+    return replicas, registry, mk
+
+
+def _chat_body(content="hello", stream=False):
+    return {"messages": [SYSTEM, {"role": "user", "content": content}],
+            "max_tokens": 8, "temperature": 0.0, "stream": stream}
+
+
+def test_router_proxies_and_affinity_stickiness():
+    replicas, registry, mk = _fleet_client(3)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            for turn in range(4):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat_body("same convo"))
+                assert r.status == 200, await r.text()
+            served = [len(rep.served) for rep in replicas]
+            # all four turns of one conversation land on ONE replica
+            assert sorted(served) == [0, 0, 4], served
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_router_failover_transparent_and_ejects():
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            # find the owner of this conversation, then break it
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("convo A"))
+            assert r.status == 200
+            owner = next(rep for rep in replicas if rep.served)
+            owner.mode = "http500"
+            # every later request fails over transparently: zero errors
+            # (8 requests so the owner's rolling window crosses
+            # GRAY_MIN_SAMPLES and the error-rate detector may trip)
+            for _ in range(8):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat_body("convo A"))
+                assert r.status == 200, await r.text()
+            other = next(rep for rep in replicas if rep is not owner)
+            assert len(other.served) >= 8
+            # the rolling error rate ejected the broken owner
+            assert registry.get(owner.name).snapshot()["state"] == EJECTED
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_retry_budget_exhaustion_is_typed_503():
+    replicas, registry, mk = _fleet_client(3)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            for rep in replicas:
+                rep.mode = "http500"
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body())
+            assert r.status == 503
+            body = await r.json()
+            assert "failover budget exhausted" in body["error"]
+            assert body["shed_by"] == "router"
+            assert int(r.headers["Retry-After"]) >= 1
+            assert body["attempts"] == 3         # 1 + retries(2)
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_router_sheds_429_before_replica_admission():
+    replicas, registry, mk = _fleet_client(1, max_inflight=1)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            replicas[0].mode = "hang"
+            t1 = asyncio.ensure_future(client.post(
+                "/v1/chat/completions", json=_chat_body("first")))
+            await asyncio.sleep(0.05)            # t1 occupies the bound
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("second"))
+            assert r.status == 429
+            body = await r.json()
+            assert body["shed_by"] == "router"   # router, not replica
+            assert "Retry-After" in r.headers
+            # the shed request NEVER reached the replica
+            assert len(replicas[0].served) == 0
+            replicas[0].mode = "ok"
+            replicas[0].release.set()
+            assert (await t1).status == 200
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_no_routable_replica_is_typed_503():
+    replicas, registry, mk = _fleet_client(1)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            for _ in range(3):                   # eject the only replica
+                registry.get("r0").record_result(False, transport=True)
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body())
+            assert r.status == 503
+            assert "no routable replica" in (await r.json())["error"]
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_replica_429_fails_over_without_eject():
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            replicas[0].mode = "http429"
+            replicas[1].mode = "http429"
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body())
+            assert r.status == 503               # budget exhausted
+            # backpressure is not sickness: nobody got ejected
+            for rep in replicas:
+                assert registry.get(rep.name).snapshot()["state"] \
+                    == HEALTHY
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_stream_pre_token_failover_and_mid_stream_typed_error():
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            # pre-first-token failover: owner 500s, stream succeeds
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("s convo", stream=True))
+            assert r.status == 200
+            owner = next(rep for rep in replicas if rep.served)
+            text = await r.text()
+            assert "tok0" in text and "[DONE]" in text
+            owner.mode = "http500"
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("s convo", stream=True))
+            assert r.status == 200               # failed over pre-commit
+            assert "tok0" in await r.text()
+            owner.mode = "ok"
+
+            # mid-stream break: typed error event + resume hints
+            victim = next(rep for rep in replicas if rep is not owner)
+            target = owner if owner.served else victim
+            fleet_faults.install(
+                f"replica={target.name};break_stream_after=2")
+            try:
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json=_chat_body("s convo", stream=True))
+                assert r.status == 200
+                text = await r.text()
+                assert "replica_stream_broken" in text
+                assert "chunks_relayed" in text
+                assert text.rstrip().endswith("data: [DONE]")
+            finally:
+                fleet_faults.clear()
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_router_health_and_fleet_views():
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            h = await client.get("/health")
+            assert h.status == 200
+            body = await h.json()
+            assert body["fleet"]["routable"] == 2
+            f = await client.get("/fleet")
+            snap = await f.json()
+            assert {r["name"] for r in snap["replicas"]} == {"r0", "r1"}
+            m = await client.get("/metrics")
+            assert "cake_fleet_replicas" in await m.text()
+            # every replica down -> router health degrades to 503
+            for name in ("r0", "r1"):
+                for _ in range(3):
+                    registry.get(name).record_result(False, transport=True)
+            h = await client.get("/health")
+            assert h.status == 503
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_client_disconnect_not_recorded_as_replica_failure():
+    """A client that vanishes mid-stream must not feed the replica's
+    failure detector — repeat disconnects would gray-eject a healthy
+    replica (found driving the real router with `curl | head`)."""
+    replicas, registry, mk = _fleet_client(1)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            replicas[0].mode = "slow_stream"
+            resp = await client.post("/v1/chat/completions",
+                                     json=_chat_body("bye", stream=True))
+            assert resp.status == 200
+            await resp.content.read(16)          # first bytes flowed
+            resp.close()                         # client walks away
+            await asyncio.sleep(0.8)             # relay notices + unwinds
+            snap = registry.get("r0").snapshot()
+            assert snap["state"] == HEALTHY, snap
+            assert snap["consec_fails"] == 0
+            assert snap["ejects"] == 0
+            assert snap["inflight"] == 0         # slot released
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_tail_hedge_duplicates_to_next_best():
+    """With hedging on, a stalled owner does not own the tail: the
+    duplicate fired at the next-best replica answers first."""
+    replicas, registry, mk = _fleet_client(2, hedge_ms=30.0)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            from cake_tpu.obs import FLEET_HEDGES
+            # find the owner, then make every attempt against it stall
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("hedge convo"))
+            assert r.status == 200
+            owner = next(rep for rep in replicas if rep.served)
+            other = next(rep for rep in replicas if rep is not owner)
+            pre = FLEET_HEDGES.value()
+            fleet_faults.install(f"replica={owner.name};stall_ms=1500")
+            try:
+                t0 = asyncio.get_event_loop().time()
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat_body("hedge convo"))
+                wall = asyncio.get_event_loop().time() - t0
+                assert r.status == 200
+                assert wall < 1.0, wall      # did not wait out the stall
+                assert FLEET_HEDGES.value() == pre + 1
+                assert other.served          # duplicate served the win
+            finally:
+                fleet_faults.clear()
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_round_robin_mode_spreads():
+    replicas, registry, mk = _fleet_client(2, affinity=False)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            for i in range(6):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat_body("same convo"))
+                assert r.status == 200
+            assert all(rep.served for rep in replicas)   # both took load
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())

@@ -839,6 +839,71 @@ def test_api_graceful_drain_on_shutdown(model):
         eng.submit(P_A, max_new_tokens=2, sampling=GREEDY)
 
 
+def test_graceful_drain_flips_health_before_engine_drains(model):
+    """graceful_drain flips the engine's draining flag SYNCHRONOUSLY —
+    /health's engine block says draining while in-flight work is still
+    finishing, so a fleet router probing it stops routing here before
+    the first request bounces (ISSUE 12 satellite: the router could not
+    previously distinguish draining from healthy until 503s flew)."""
+    from cake_tpu.api import create_app
+    from cake_tpu.api.server import graceful_drain
+    from cake_tpu.serve import EngineDraining, faults
+
+    eng = ServeEngine(model, slots=2, max_queue=4, ctx_len=CTX)
+    state = _api_state(model, eng)
+    app = create_app(state)
+
+    async def scenario():
+        # keep the engine busy so the drain cannot finish instantly —
+        # the assertion below must observe draining=True mid-drain
+        faults.install("delay_ms=20")
+        busy = eng.submit(P_LONG, max_new_tokens=60, sampling=GREEDY)
+        while not busy.tokens:
+            await asyncio.sleep(0.005)
+        drain_task = asyncio.ensure_future(graceful_drain(app))
+        try:
+            deadline = time.monotonic() + 5
+            while not eng.health()["draining"]:
+                assert time.monotonic() < deadline, \
+                    "engine block never reported draining"
+                await asyncio.sleep(0.002)
+            assert not drain_task.done()      # flag flipped mid-drain
+            assert eng.pool.busy_count        # work still in flight
+            # new submits are refused with a DERIVED Retry-After hint
+            with pytest.raises(EngineDraining) as ei:
+                eng.submit(P_A, max_new_tokens=2, sampling=GREEDY)
+            assert ei.value.retry_after_s >= 1
+        finally:
+            faults.clear()
+            busy.cancel()
+            await drain_task
+    _run(scenario())
+    eng.close()
+
+
+def test_retry_after_hint_scales_with_backlog(model):
+    """Derived Retry-After (ISSUE 12 satellite): idle engine invites a
+    near-immediate retry; a deep queue pushes clients out
+    proportionally."""
+    eng = ServeEngine(model, slots=2, max_queue=64, ctx_len=CTX)
+    try:
+        assert eng.retry_after_hint() == 1           # idle
+        from cake_tpu.serve import faults
+        faults.install("delay_ms=50")
+        try:
+            reqs = [eng.submit(P_A, max_new_tokens=4, sampling=GREEDY)
+                    for _ in range(20)]
+            deep = eng.retry_after_hint()
+            assert deep > 1                           # backlog-derived
+            assert deep <= 30                         # capped
+            for r in reqs:
+                r.cancel()
+        finally:
+            faults.clear()
+    finally:
+        eng.close()
+
+
 def test_api_stream_queue_deadline_503(model):
     """A stream:true request shed by the queue deadline answers 503 +
     Retry-After BEFORE any SSE commits to a 200 — the same contract as

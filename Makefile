@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc bench bench-micro obs-smoke serve-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -18,6 +18,12 @@ lint:
 knobs-doc:
 	python -m cake_tpu.knobs > docs/knobs.md
 
+# regenerate docs/observability.md — the metric/span/timeline catalog —
+# from cake_tpu/obs (catalog.py); tests/test_analysis.py pins the file,
+# and the metric-registry lint checks instrument names against it
+metrics-doc:
+	python -m cake_tpu.obs > docs/observability.md
+
 native:
 	$(MAKE) -C csrc
 
@@ -30,11 +36,18 @@ bench:
 bench-micro:
 	python benches/bench_micro.py
 
+# request-tracing gate: one chat driven through a REAL router + replica
+# (tiny CPU model) must yield a stitched timeline with events from BOTH
+# tiers retrievable by its trace id from the router, and non-zero
+# TTFT/ITL/e2e SLO histograms (with exemplars) in the replica's /metrics
+trace-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
 # observability gate: the static-analysis pass (hot-timing absorbed
-# check_hot_timing.py; the other five rules ride along) + a tiny traced
-# CPU generation asserting /metrics histograms and the Chrome-trace
-# export are live
-obs-smoke: lint
+# check_hot_timing.py; the other six rules ride along), the cross-tier
+# trace-smoke above, and a tiny traced CPU generation asserting /metrics
+# histograms and the Chrome-trace export are live
+obs-smoke: lint trace-smoke
 	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 # continuous-batching gate: concurrent chats 200 through the engine, a 429

@@ -12,6 +12,9 @@ them are invisible to the test suite until they regress in production:
     dead; the name must be rebound before the next read;
   * `knob-registry` — every CAKE_* env read goes through cake_tpu.knobs
     (typed default, generated docs);
+  * `metric-registry` — every Counter/Gauge/Histogram name constructed
+    under cake_tpu/ appears in the generated metric catalog
+    (docs/observability.md);
   * `lock-discipline` — `# guarded-by:` annotated fields are only
     touched under their lock;
 
@@ -32,7 +35,7 @@ from .hot_paths import HOT_PATHS, is_hot
 
 # importing the check_* modules registers the rules
 from . import (check_donation, check_host_sync, check_hot_timing,  # noqa: F401,E402
-               check_knobs, check_locks, check_recompile)
+               check_knobs, check_locks, check_metrics, check_recompile)
 
 __all__ = ["RULES", "Checker", "SourceFile", "Violation", "check_file",
            "iter_py_files", "register", "run_paths", "HOT_PATHS", "is_hot"]

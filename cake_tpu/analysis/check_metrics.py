@@ -1,0 +1,80 @@
+"""Rule `metric-registry`: every Counter/Gauge/Histogram name constructed
+under cake_tpu/ must appear in the generated metric catalog
+(docs/observability.md).
+
+The knob-registry rule's shape, pointed at instruments: the catalog is
+generated from the canonical declarations in cake_tpu/obs/__init__.py
+(`make metrics-doc`) and pinned to them by test, so a metric registered
+anywhere else — or added to obs/__init__.py without regenerating the doc
+— is a silently-undocumented instrument, exactly the drift that left the
+hand-written observability page three subsystems stale. Registration is
+idempotent by design, so nothing STOPS a module minting its own series;
+this rule is what makes that visible.
+
+Only literal `cake_*` first arguments to `.counter(` / `.gauge(` /
+`.histogram(` calls are checked: dynamic names cannot be verified
+statically and nothing in the tree builds one (keeping it that way is
+the point).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Checker, SourceFile, Violation, register, repo_root
+
+_CATALOG_REL = os.path.join("docs", "observability.md")
+_NAME_RE = re.compile(r"`(cake_[a-z0-9_]+)`")
+_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+
+
+def catalog_names() -> frozenset:
+    """Metric names the generated catalog documents (backticked
+    `cake_*` tokens in docs/observability.md); empty when the catalog
+    is missing — every instrument then fires, which is the right
+    failure for a deleted catalog."""
+    path = os.path.join(repo_root(), _CATALOG_REL)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return frozenset(_NAME_RE.findall(f.read()))
+    except OSError:
+        return frozenset()
+
+
+class MetricRegistryChecker(Checker):
+    name = "metric-registry"
+    doc = ("Counter/Gauge/Histogram names constructed under cake_tpu/ "
+           "must appear in the generated metric catalog "
+           "(docs/observability.md; regenerate with `make metrics-doc`)")
+
+    def __init__(self):
+        self._catalog: frozenset | None = None
+
+    def applies(self, sf: SourceFile) -> bool:
+        return sf.rel.startswith("cake_tpu/")
+
+    def check(self, sf: SourceFile):
+        if self._catalog is None:
+            self._catalog = catalog_names()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in _REGISTRY_METHODS):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("cake_")):
+                continue
+            if arg.value not in self._catalog:
+                yield Violation(
+                    self.name, sf.rel, node.lineno,
+                    f"metric {arg.value!r} is not in the generated "
+                    "catalog — declare it in cake_tpu/obs/__init__.py "
+                    "and run `make metrics-doc`")
+
+
+register(MetricRegistryChecker)

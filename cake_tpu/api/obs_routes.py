@@ -17,7 +17,8 @@ import time
 
 from aiohttp import web
 
-from ..obs import RECORDER, REGISTRY, now
+from ..obs import (RECORDER, REGISTRY, SERVE_E2E_SECONDS,
+                   SERVE_ITL_SECONDS, SERVE_TTFT_SECONDS, TIMELINES, now)
 from .state import ApiState
 
 # a worker is reported degraded when forwards keep being ATTEMPTED without
@@ -118,6 +119,51 @@ async def trace(request: web.Request) -> web.Response:
     if request.query.get("clear") in ("1", "true"):
         RECORDER.clear()
     return web.json_response(body)
+
+
+async def request_index(request: web.Request) -> web.Response:
+    """Recent request ids with retrievable timelines (oldest first;
+    the ring keeps the last CAKE_TRACE_REQUESTS requests)."""
+    return web.json_response({"requests": TIMELINES.ids()})
+
+
+async def request_timeline(request: web.Request) -> web.Response:
+    """One request's typed lifecycle timeline (by trace id or completion
+    id). `?format=perfetto` returns the same events as Chrome-trace
+    instant events on the span recorder's clock, mergeable with
+    /api/v1/trace in Perfetto."""
+    rid = request.match_info["rid"]
+    if request.query.get("format") == "perfetto":
+        body = TIMELINES.to_chrome(rid)
+    else:
+        body = TIMELINES.get(rid)
+    if body is None:
+        return web.json_response(
+            {"error": f"no timeline for request {rid!r} (evicted from "
+                      "the ring, or never traced by this process)"},
+            status=404)
+    return web.json_response(body)
+
+
+async def slo(request: web.Request) -> web.Response:
+    """Serve-engine SLO decomposition as JSON: the TTFT / inter-token /
+    e2e histograms by outcome, each bucket carrying its sampled exemplar
+    request id — the link from a bad percentile to the concrete
+    /api/v1/requests/<id> timeline that explains it."""
+    out = {}
+    for h in (SERVE_TTFT_SECONDS, SERVE_ITL_SECONDS, SERVE_E2E_SECONDS):
+        series = []
+        for labels in h.labelsets():
+            n = h.count(**labels)
+            series.append({
+                "labels": labels,
+                "count": n,
+                "sum_s": round(h.sum(**labels), 6),
+                "mean_s": round(h.sum(**labels) / n, 6) if n else 0.0,
+                "exemplars": h.exemplars(**labels),
+            })
+        out[h.name] = {"help": h.help, "series": series}
+    return web.json_response(out)
 
 
 async def health(request: web.Request) -> web.Response:

@@ -13,6 +13,10 @@ Routes:
   GET  /metrics                 Prometheus text exposition
   GET  /health                  liveness: workers' last-seen age, HBM usage
   GET  /api/v1/trace            Chrome-trace JSON of recorded spans
+  GET  /api/v1/requests         recent traced request ids
+  GET  /api/v1/requests/{rid}   one request's lifecycle timeline
+                                (?format=perfetto for Chrome-trace)
+  GET  /api/v1/slo              TTFT/ITL/e2e histograms + exemplar ids
   GET  /                        embedded web UI
 """
 from __future__ import annotations
@@ -99,6 +103,10 @@ def create_app(state: ApiState, basic_auth: str | None = None) -> web.Applicatio
     app.router.add_get("/metrics", obs_routes.metrics)
     app.router.add_get("/health", obs_routes.health)
     app.router.add_get("/api/v1/trace", obs_routes.trace)
+    app.router.add_get("/api/v1/requests", obs_routes.request_index)
+    app.router.add_get("/api/v1/requests/{rid}",
+                       obs_routes.request_timeline)
+    app.router.add_get("/api/v1/slo", obs_routes.slo)
     app.router.add_get("/", ui_routes.index)
     return app
 

@@ -18,7 +18,8 @@ import uuid
 
 from aiohttp import web
 
-from ..obs import GENERATIONS, current_request_id, set_request_id
+from ..obs import (GENERATIONS, TIMELINES, TRACE_HEADER,
+                   current_request_id, set_request_id)
 from ..ops.sampling import SamplingConfig
 from ..serve import (EngineDown, EngineDraining, PoisonedRequest,
                      QueueDeadlineExceeded, QueueFull,
@@ -144,6 +145,25 @@ class StopMatcher:
 
 def _completion_id() -> str:
     return "chatcmpl-" + uuid.uuid4().hex[:24]
+
+
+def _adopt_request_id(request: web.Request, cid: str) -> str:
+    """Cross-tier trace adoption: when the fleet router (or any client)
+    sent an X-Cake-Request-Id header, that id becomes THE request id for
+    this generation — the contextvar every span carries, the id the
+    serve engine stamps timeline events against, and the key
+    /api/v1/requests/<id> answers to — so one id names the request end
+    to end (router retry events stitch onto the same timeline the
+    engine's admit/decode events land on). Without the header the
+    completion id serves as the request id, as before. The completion
+    id is always registered as an alias, so either id resolves the
+    timeline."""
+    rid = request.headers.get(TRACE_HEADER) or cid
+    set_request_id(rid)
+    TIMELINES.begin(rid)
+    TIMELINES.event(rid, "received")
+    TIMELINES.alias(cid, rid)
+    return rid
 
 
 def _retry_after(state: ApiState, floor: int = 1) -> int:
@@ -277,15 +297,20 @@ def _decode_text(tokenizer, ids: list[int]) -> str:
         return "".join(parts)
 
 
-def _stats_snapshot(stats: dict) -> dict:
+def _stats_snapshot(stats: dict, cid: str | None = None) -> dict:
     """JSON-safe snapshot of a generation's stats for /api/v1/stats:
     timings, per-hop RTT wire/fwd split and prefill pipelining info (the
     reference surfaces topology only; the wire/compute attribution is
-    what actually localizes a slow cluster)."""
+    what actually localizes a slow cluster). `request_id` is the
+    cross-tier trace id (may be router-injected); `completion_id` the
+    OpenAI response id — distinct when a router fronted the request, so
+    consumers matching on either keep working."""
     out = {"ts": int(time.time())}
     rid = current_request_id()
     if rid:
         out["request_id"] = rid
+    if cid:
+        out["completion_id"] = cid
     for k in ("ttft_s", "decode_tokens", "decode_s", "tok_per_s",
               "stage_rtts", "prefill", "queue_wait_s", "prefill_chunks",
               "prefix_hit_tokens"):
@@ -334,15 +359,16 @@ def _completion_json(state: ApiState, cid: str, toks: list[int],
 async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
                          stops: list[str] | None = None):
     cid = _completion_id()
-    # the completion id doubles as the request id: spans recorded during
-    # this request's generation (model phases, cluster hops) carry it, so
-    # a trace export is joinable with API logs/responses
-    set_request_id(cid)
+    # the request id (router-injected trace id, or the completion id)
+    # rides the contextvar: spans recorded during this generation (model
+    # phases, cluster hops) carry it, so a trace export is joinable with
+    # API logs/responses — and with the fleet router's timeline
+    rid = _adopt_request_id(request, cid)
     async with state.lock:                  # one inference at a time
         try:
             toks, stats = await run_generation_blocking(state.model, messages,
                                                         gen_kwargs)
-            state.last_stats = _stats_snapshot(stats)
+            state.last_stats = _stats_snapshot(stats, cid)
         except Exception as e:
             GENERATIONS.inc(kind="text", status="error")
             # lazy import, error path only: the API layer must not drag
@@ -361,8 +387,10 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
             return web.json_response({"error": f"generation failed: {e}"},
                                      status=500)
     GENERATIONS.inc(kind="text", status="ok")
-    return _completion_json(state, cid, toks, stats,
+    resp = _completion_json(state, cid, toks, stats,
                             _prompt_token_count(state, messages), stops)
+    resp.headers[TRACE_HEADER] = rid
+    return resp
 
 
 # -- continuous-batching path (state.engine) ---------------------------------
@@ -373,7 +401,7 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
     """Submit to the serve engine: concurrent decode, bounded queue."""
     from ..models.common.text_model import chat_prompt_ids
     cid = _completion_id()
-    set_request_id(cid)
+    rid = _adopt_request_id(request, cid)
     tokenizer = state.tokenizer or getattr(state.model, "tokenizer", None)
     try:
         prompt_ids = await run_blocking(
@@ -385,7 +413,7 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         req = state.engine.submit(prompt_ids,
                                   max_new_tokens=gen_kwargs["max_new_tokens"],
                                   sampling=gen_kwargs["sampling"],
-                                  request_id=cid)
+                                  request_id=rid)
     except QueueFull as e:
         # backpressure is a first-class answer: shed load instead of
         # queueing unboundedly behind a bounded slot pool
@@ -475,9 +503,11 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
             {"error": f"generation failed: {err}"}, status=500)
     GENERATIONS.inc(kind="text", status="ok")
     stats = req.result.get("stats", {})
-    state.last_stats = _stats_snapshot(stats)
-    return _completion_json(state, cid, req.result.get("tokens", []), stats,
+    state.last_stats = _stats_snapshot(stats, cid)
+    resp = _completion_json(state, cid, req.result.get("tokens", []), stats,
                             len(prompt_ids), stops)
+    resp.headers[TRACE_HEADER] = rid
+    return resp
 
 
 async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
@@ -495,6 +525,9 @@ async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
         "Connection": "keep-alive",
+        # the cross-tier trace id rides the SSE headers too, so a
+        # streaming client can pull /api/v1/requests/<id> afterwards
+        TRACE_HEADER: current_request_id() or cid,
     })
     try:
         return await _sse_drain_inner(request, state, cid, aiter, result,
@@ -575,7 +608,7 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
     GENERATIONS.inc(kind="text",
                     status="error" if finish == "error" else "ok")
     if "stats" in result:
-        state.last_stats = _stats_snapshot(result["stats"])
+        state.last_stats = _stats_snapshot(result["stats"], cid)
     await write_safe(chunk({}, finish=finish))
     await write_safe(b"data: [DONE]\n\n")
     if not client_gone:
@@ -586,7 +619,7 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
 async def _chat_stream(request, state: ApiState, messages, gen_kwargs,
                        stops: list[str] | None = None):
     cid = _completion_id()
-    set_request_id(cid)         # spans from this generation carry the cid
+    _adopt_request_id(request, cid)     # spans carry the trace id / cid
     async with state.lock:      # locked fallback: one inference at a time
         aiter, result, cancel = run_generation_streamed(state.model, messages,
                                                         gen_kwargs)

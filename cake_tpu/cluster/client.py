@@ -16,7 +16,8 @@ import time
 import numpy as np
 
 from .. import knobs
-from ..obs import CLUSTER_HOP_DEGRADED, CLUSTER_STAGE_FAILURES, HOP_SECONDS, now
+from ..obs import (CLUSTER_HOP_DEGRADED, CLUSTER_STAGE_FAILURES,
+                   HOP_SECONDS, TIMELINES, now)
 from . import faults, proto
 from .auth import AuthError, _mac, CHALLENGE_LEN, MAC_LEN
 
@@ -262,6 +263,11 @@ class RemoteStage:
         self.last_ok = now()
         self.total_ops += 1
         self._observe_hop(rtt, tm)
+        # per-request timeline: attribute this hop to the generation in
+        # flight (request-id contextvar). A no-op dict lookup when no
+        # tier opened a timeline for the id (bench scripts, tests)
+        TIMELINES.event(None, "cluster_hop", worker=self.name,
+                        ms=round(rtt * 1e3, 3))
         if self.degraded_ms > 0:
             CLUSTER_HOP_DEGRADED.set(1.0 if self.gray_degraded else 0.0,
                                      worker=self.name)

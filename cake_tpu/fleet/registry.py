@@ -290,16 +290,18 @@ class Replica:
 
     @staticmethod
     def _occupancy_of(engine: dict) -> float:
-        """KV occupancy in [0, 1]: used/total physical blocks for paged
-        pools (the kv_pool health block carries `used` and `blocks`),
-        else busy-slot fraction — the autoscaling signal. Block
-        occupancy matters: a paged replica can have 95% of its KV spoken
-        for with only half its slots busy."""
+        """KV occupancy in [0, 1]: the kv_pool block's first-class
+        `occupancy` field for paged pools (the producer computes
+        used/blocks — block occupancy matters: a paged replica can have
+        95% of its KV spoken for with only half its slots busy), with
+        the hand-derivation kept only for pre-occupancy replicas mid
+        rolling upgrade; contiguous pools fall back to busy-slot
+        fraction — the autoscaling signal either way."""
         kv = engine.get("kv_pool") or {}
-        if kv.get("blocks"):
-            return round((kv.get("used") or 0) / kv["blocks"], 4)
-        if "occupancy" in kv:               # forward-compat: ready-made
+        if "occupancy" in kv:
             return round(float(kv["occupancy"]), 4)
+        if kv.get("blocks"):                # pre-occupancy replica
+            return round((kv.get("used") or 0) / kv["blocks"], 4)
         slots = engine.get("slots") or 0
         if slots:
             return round((engine.get("slots_busy") or 0) / slots, 4)

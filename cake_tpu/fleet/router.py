@@ -34,12 +34,13 @@ import asyncio
 import json
 import logging
 import random
+import uuid
 
 from aiohttp import web
 
 from .. import knobs
 from ..obs import (FLEET_HEDGES, FLEET_PROXIED, FLEET_RETRIES, FLEET_SHEDS,
-                   now)
+                   TRACE_HEADER, TimelineStore, now)
 from . import faults
 from .registry import ReplicaRegistry, discover_replicas
 from .routing import affinity_key, conversation_head, rank_replicas
@@ -110,6 +111,12 @@ class FleetRouter:
         self.session = None                 # aiohttp.ClientSession
         self.inflight = 0                   # event-loop-confined
         self.draining = False
+        # router-tier timeline ring, deliberately SEPARATE from the
+        # process-global obs.TIMELINES: the stitched /api/v1/requests
+        # view distinguishes tiers by store, and an in-process replica
+        # (tests, smokes, embedded topologies) must keep its
+        # replica-tier timeline distinct from the router's
+        self.timelines = TimelineStore()
         self._tasks: list = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -211,16 +218,20 @@ class FleetRouter:
         depth = self.registry.total_queue_depth() + self.inflight
         return max(1, min(30, 1 + depth // routable))
 
-    def _shed(self, reason: str) -> web.Response:
+    def _shed(self, reason: str, rid: str | None = None) -> web.Response:
         FLEET_SHEDS.inc(reason=reason)
         FLEET_PROXIED.inc(outcome="shed")
+        if rid:
+            self.timelines.event(rid, "shed", reason=reason)
         return web.json_response(
             {"error": f"fleet overloaded: {reason}", "shed_by": "router"},
             status=429,
             headers={"Retry-After": str(self._retry_after())})
 
-    def _no_replica(self) -> web.Response:
+    def _no_replica(self, rid: str | None = None) -> web.Response:
         FLEET_PROXIED.inc(outcome="failed")
+        if rid:
+            self.timelines.event(rid, "shed", reason="no_replica")
         return web.json_response(
             {"error": "no routable replica (all ejected, draining, or "
                       "none registered)", "shed_by": "router"},
@@ -257,7 +268,7 @@ class FleetRouter:
 
     # -- one outbound attempt ------------------------------------------------
 
-    async def _one_json(self, rep, body: dict):
+    async def _one_json(self, rep, body: dict, rid: str | None = None):
         """One non-streamed attempt against `rep`. Returns
         ("skip", None)       — replica at cap / not acquirable,
         ("retryable", str)   — transport failure, replica 5xx or 429,
@@ -279,28 +290,54 @@ class FleetRouter:
             t0 = now()
             async with self.session.post(
                     rep.base_url + "/v1/chat/completions",
-                    json=body, timeout=tmo) as r:
+                    json=body, timeout=tmo,
+                    headers=self._trace_headers(rid)) as r:
                 ttfb_ms = (now() - t0) * 1e3
                 data = await r.read()
                 if r.status in (500, 502, 503):
                     rep.record_result(False, lease=lease)
+                    if rid:
+                        self.timelines.event(rid, "attempt", replica=rep.name,
+                                        outcome="retryable",
+                                        status=r.status)
                     return ("retryable",
                             f"{rep.name}: upstream {r.status}")
                 if r.status == 429:
                     # replica backpressure is load, not sickness: do not
                     # feed the failure detector, just go elsewhere
+                    if rid:
+                        self.timelines.event(rid, "attempt", replica=rep.name,
+                                        outcome="saturated", status=429)
                     return ("retryable",
                             f"{rep.name}: replica saturated (429)")
                 rep.record_result(True, ttfb_ms, lease=lease)
-                return ("final", web.Response(
+                if rid:
+                    self.timelines.event(rid, "attempt", replica=rep.name,
+                                    outcome="final", status=r.status,
+                                    ttfb_ms=round(ttfb_ms, 3))
+                resp = web.Response(
                     body=data, status=r.status,
-                    content_type=r.content_type or "application/json"))
+                    content_type=r.content_type or "application/json")
+                if rid:
+                    resp.headers[TRACE_HEADER] = rid
+                return ("final", resp)
         except _transport_errors() as e:
             rep.record_result(False, transport=True, lease=lease)
+            if rid:
+                self.timelines.event(rid, "attempt", replica=rep.name,
+                                outcome="transport_error", status=0)
             return ("retryable",
                     f"{rep.name}: {type(e).__name__}: {e}")
         finally:
             rep.release(lease)
+
+    @staticmethod
+    def _trace_headers(rid: str | None) -> dict:
+        """The trace-propagation header for one outbound attempt: the
+        replica adopts the id into its request-id contextvar and its
+        serve engine keys timeline events by it, so the router's
+        /api/v1/requests/<id> can stitch both tiers."""
+        return {TRACE_HEADER: rid} if rid else {}
 
     # -- request paths -------------------------------------------------------
 
@@ -318,24 +355,36 @@ class FleetRouter:
         if not isinstance(messages, list) or not messages:
             return web.json_response({"error": "messages[] required"},
                                      status=400)
+        # cross-tier trace id: adopt the client's (a chained router, a
+        # test harness) or mint one; it is injected into every outbound
+        # attempt, adopted by the replica's API + serve engine, echoed
+        # on the response, and keys this tier's timeline — one id end
+        # to end
+        rid = request.headers.get(TRACE_HEADER) \
+            or "trace-" + uuid.uuid4().hex[:16]
+        self.timelines.begin(rid, tier="router")
         # router-level admission: shed BEFORE any replica queues it
         if self.inflight >= self._global_cap():
-            return self._shed("global admission bound")
+            return self._shed("global admission bound", rid)
         order = self._order(messages)
         if not any(r.routable() for r in order):
-            return self._no_replica()
+            return self._no_replica(rid)
+        self.timelines.event(rid, "route", candidates=[r.name for r in order],
+                        stream=bool(body.get("stream")))
         self.inflight += 1
         try:
             if body.get("stream"):
-                return await self._route_stream(request, body, order)
+                return await self._route_stream(request, body, order, rid)
             if self.hedge_ms > 0:
-                return await self._route_json_hedged(body, order)
-            return await self._route_json(body, order, 1 + self.retries)
+                return await self._route_json_hedged(body, order, rid)
+            return await self._route_json(body, order, 1 + self.retries,
+                                          rid=rid)
         finally:
             self.inflight -= 1
 
     async def _route_json(self, body: dict, order: list, budget: int,
-                          prior_attempts: int = 0) -> web.Response:
+                          prior_attempts: int = 0,
+                          rid: str | None = None) -> web.Response:
         """Sequential failover over `order` under an attempt budget.
         `prior_attempts`: attempts already spent by a caller (the hedged
         path) — they count against the budget and keep the exhausted-503
@@ -348,7 +397,7 @@ class FleetRouter:
                 break
             if not rep.routable():
                 continue
-            kind, val = await self._one_json(rep, body)
+            kind, val = await self._one_json(rep, body, rid)
             if kind == "skip":
                 cap_skipped = True
                 continue
@@ -356,6 +405,8 @@ class FleetRouter:
             if kind == "final":
                 FLEET_PROXIED.inc(
                     outcome="ok" if val.status < 400 else "failed")
+                if rid:
+                    self.timelines.event(rid, "done", status=val.status)
                 return val
             detail = val
             # back off only when another attempt can actually happen —
@@ -363,19 +414,23 @@ class FleetRouter:
             if attempts < budget \
                     and any(r.routable() for r in order[i + 1:]):
                 FLEET_RETRIES.inc()
+                if rid:
+                    self.timelines.event(rid, "retry")
                 await self._sleep_backoff(attempts)
         if attempts == 0:
-            return self._shed("replica in-flight caps") if cap_skipped \
-                else self._no_replica()
+            return self._shed("replica in-flight caps", rid) \
+                if cap_skipped else self._no_replica(rid)
         FLEET_PROXIED.inc(outcome="failed")
+        if rid:
+            self.timelines.event(rid, "done", status=503)
         return web.json_response(
             {"error": "fleet failover budget exhausted",
              "attempts": attempts, "last": detail, "shed_by": "router"},
             status=503,
             headers={"Retry-After": str(self._retry_after())})
 
-    async def _route_json_hedged(self, body: dict,
-                                 order: list) -> web.Response:
+    async def _route_json_hedged(self, body: dict, order: list,
+                                 rid: str | None = None) -> web.Response:
         """Tail-hedged non-streamed path: if the owner has not answered
         within CAKE_FLEET_HEDGE_MS, fire a duplicate at the next-best
         replica and take whichever finishes first (the loser is
@@ -385,15 +440,19 @@ class FleetRouter:
         hedge legs fail."""
         reps = [r for r in order if r.routable()]
         if len(reps) < 2:
-            return await self._route_json(body, order, 1 + self.retries)
-        primary = asyncio.create_task(self._one_json(reps[0], body))
+            return await self._route_json(body, order, 1 + self.retries,
+                                          rid=rid)
+        primary = asyncio.create_task(self._one_json(reps[0], body, rid))
         done, _ = await asyncio.wait({primary},
                                      timeout=self.hedge_ms / 1e3)
         tasks = {primary}
         tried = 1
         if not done:
             FLEET_HEDGES.inc()
-            tasks.add(asyncio.create_task(self._one_json(reps[1], body)))
+            if rid:
+                self.timelines.event(rid, "hedge", replica=reps[1].name)
+            tasks.add(asyncio.create_task(
+                self._one_json(reps[1], body, rid)))
             tried = 2
         pending = tasks
         non_final = 0
@@ -407,6 +466,9 @@ class FleetRouter:
                         FLEET_PROXIED.inc(
                             outcome="ok" if val.status < 400
                             else "failed")
+                        if rid:
+                            self.timelines.event(rid, "done",
+                                            status=val.status)
                         return val
                     if kind != "skip":      # at-cap skips spend no budget
                         non_final += 1
@@ -423,11 +485,14 @@ class FleetRouter:
         rest = reps[tried:]
         if non_final and any(r.routable() for r in rest):
             FLEET_RETRIES.inc()             # hedge -> sequential handoff
+            if rid:
+                self.timelines.event(rid, "retry")
         return await self._route_json(body, rest, 1 + self.retries,
-                                      prior_attempts=non_final)
+                                      prior_attempts=non_final, rid=rid)
 
     async def _route_stream(self, request: web.Request, body: dict,
-                            order: list) -> web.StreamResponse:
+                            order: list,
+                            rid: str | None = None) -> web.StreamResponse:
         """SSE relay with pre-commit failover: attempts rotate replicas
         until one starts streaming; once the first byte has been
         relayed the request is COMMITTED to that replica, and a break
@@ -448,21 +513,27 @@ class FleetRouter:
             committed = False
             try:
                 resp, retryable = await self._relay_stream(
-                    request, rep, body, lease)
+                    request, rep, body, lease, rid)
                 committed = resp is not None
                 if committed:
+                    if rid:
+                        self.timelines.event(rid, "done", status=resp.status)
                     return resp
                 attempts += 1
                 if retryable and attempts < budget \
                         and any(r.routable() for r in order[i + 1:]):
                     FLEET_RETRIES.inc()
+                    if rid:
+                        self.timelines.event(rid, "retry")
                     await self._sleep_backoff(attempts)
             finally:
                 rep.release(lease)
         if attempts == 0:
-            return self._shed("replica in-flight caps") if cap_skipped \
-                else self._no_replica()
+            return self._shed("replica in-flight caps", rid) \
+                if cap_skipped else self._no_replica(rid)
         FLEET_PROXIED.inc(outcome="failed")
+        if rid:
+            self.timelines.event(rid, "done", status=503)
         return web.json_response(
             {"error": "fleet failover budget exhausted (stream never "
                       "started)", "attempts": attempts,
@@ -471,7 +542,7 @@ class FleetRouter:
             headers={"Retry-After": str(self._retry_after())})
 
     async def _relay_stream(self, request, rep, body,
-                            lease: str = "slot"):
+                            lease: str = "slot", rid: str | None = None):
         """One streamed attempt. Returns (response, retryable):
         response None = nothing was relayed, caller may retry
         elsewhere; a non-None response is terminal (clean EOF or typed
@@ -489,7 +560,8 @@ class FleetRouter:
             tmo = aiohttp.ClientTimeout(total=None)
             async with self.session.post(
                     rep.base_url + "/v1/chat/completions",
-                    json=body, timeout=tmo) as r:
+                    json=body, timeout=tmo,
+                    headers=self._trace_headers(rid)) as r:
                 if r.status != 200:
                     data = await r.read()
                     if r.status in (500, 502, 503):
@@ -525,11 +597,18 @@ class FleetRouter:
                                 f"severed after {chunks} chunks")
                         if resp is None:
                             ttfb_ms = (now() - t0) * 1e3
-                            resp = web.StreamResponse(headers={
+                            if rid:
+                                self.timelines.event(
+                                    rid, "commit", replica=rep.name,
+                                    ttfb_ms=round(ttfb_ms, 3))
+                            hdrs = {
                                 "Content-Type": "text/event-stream",
                                 "Cache-Control": "no-cache",
                                 "Connection": "keep-alive",
-                            })
+                            }
+                            if rid:
+                                hdrs[TRACE_HEADER] = rid
+                            resp = web.StreamResponse(headers=hdrs)
                             try:
                                 await resp.prepare(request)
                             except _transport_errors() as we:
@@ -569,6 +648,9 @@ class FleetRouter:
             # mid-stream break AFTER bytes reached the client: typed
             # error event + resume hints — never a silent dead socket
             FLEET_PROXIED.inc(outcome="broken_stream")
+            if rid:
+                self.timelines.event(rid, "stream_broken", replica=rep.name,
+                                chunks=chunks)
             payload = {"error": {
                 "type": "replica_stream_broken",
                 "replica": rep.name,
@@ -622,6 +704,53 @@ class FleetRouter:
     async def handle_fleet(self, request: web.Request) -> web.Response:
         return web.json_response(self.registry.snapshot())
 
+    async def handle_request_index(self,
+                                   request: web.Request) -> web.Response:
+        return web.json_response({"requests": self.timelines.ids()})
+
+    async def handle_request_trace(self,
+                                   request: web.Request) -> web.Response:
+        """Fleet-wide stitched timeline: this tier's routing events
+        (route/attempt/retry/hedge/commit/done) plus the replica tier's
+        lifecycle events for the same id, fetched from the replica the
+        attempt events name (falling back to asking every registered
+        replica — the id may predate this router process). Each tier
+        carries its own start_unix anchor, so a consumer lays both on
+        one wall-clock axis."""
+        rid = request.match_info["rid"]
+        own = self.timelines.get(rid)
+        tiers = [own] if own is not None else []
+        names = {e.get("replica") for e in (own or {}).get("events", [])
+                 if e.get("replica")}
+        reps = self.registry.replicas()
+        candidates = [r for r in reps if r.name in names] or reps
+        import aiohttp
+        tmo = aiohttp.ClientTimeout(total=2.0)
+
+        # concurrent: the all-replicas fallback must not serialize one
+        # probe timeout per unreachable member (debugging happens
+        # exactly when some of the fleet is down)
+        async def fetch(rep):
+            try:
+                async with self.session.get(
+                        rep.base_url + "/api/v1/requests/" + rid,
+                        timeout=tmo) as r:
+                    if r.status != 200:
+                        return None
+                    body = await r.json(content_type=None)
+                    body["replica"] = rep.name
+                    return body
+            except _transport_errors():
+                return None
+        for body in await asyncio.gather(*(fetch(r) for r in candidates)):
+            if body is not None:
+                tiers.append(body)
+        if not tiers:
+            return web.json_response(
+                {"error": f"no timeline for request {rid!r} at the "
+                          "router or any replica"}, status=404)
+        return web.json_response({"request_id": rid, "tiers": tiers})
+
 
 async def _metrics(request: web.Request) -> web.Response:
     from ..obs import REGISTRY
@@ -638,6 +767,9 @@ def create_router_app(router: FleetRouter) -> web.Application:
     app.router.add_get("/v1/models", router.handle_models)
     app.router.add_get("/health", router.handle_health)
     app.router.add_get("/fleet", router.handle_fleet)
+    app.router.add_get("/api/v1/requests", router.handle_request_index)
+    app.router.add_get("/api/v1/requests/{rid}",
+                       router.handle_request_trace)
     app.router.add_get("/metrics", _metrics)
     app.on_startup.append(router.start)
     app.on_shutdown.append(router.drain)

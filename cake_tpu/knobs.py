@@ -239,6 +239,14 @@ _knob("CAKE_TRACE_DIR", str, None, "obs",
       "the span recorder at startup")
 _knob("CAKE_TRACE_EVENTS", int, 16384, "obs",
       "span recorder ring-buffer capacity (oldest events drop first)")
+_knob("CAKE_TRACE_REQUESTS", int, 256, "obs",
+      "per-request timeline ring: how many recent requests keep their "
+      "typed lifecycle timeline retrievable via /api/v1/requests/<id> "
+      "(oldest evicted first; recording is always on)")
+_knob("CAKE_FLIGHT_RECORDER", int, 256, "obs",
+      "serve-engine flight recorder: scheduler iterations kept in the "
+      "in-memory ring the supervisor dumps to CAKE_TRACE_DIR on a "
+      "wedge flag or DOWN classification")
 
 # -- ops / kernels --------------------------------------------------------
 _knob("CAKE_MOE_RAGGED", bool, True, "ops",

@@ -17,8 +17,10 @@ from __future__ import annotations
 
 from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS,
                       MetricsRegistry, REGISTRY)
-from .spans import (RECORDER, SpanRecorder, current_request_id, jax_trace,
-                    new_request_id, request_scope, set_request_id)
+from .spans import (RECORDER, SPAN_CATALOG, SpanRecorder,
+                    current_request_id, jax_trace, new_request_id,
+                    request_scope, set_request_id)
+from .timeline import (EVENT_KINDS, TIMELINES, TimelineStore, TRACE_HEADER)
 from .timing import PhaseTimer, now
 
 # -- canonical serving instruments -------------------------------------------
@@ -125,6 +127,34 @@ SPEC_BUCKET_ACCEPTED = REGISTRY.histogram(
     "the serve bench reports",
     labelnames=("bucket",),
     buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+
+# -- serve-engine SLO decomposition (batched path) ---------------------------
+# The sequential loops already observe cake_ttft_seconds /
+# cake_decode_token_seconds; these three cover the continuous-batching
+# engine with an outcome label (ok | cancelled | error) so a latency
+# regression is attributable to the population that suffered it, and each
+# observation carries the request id as a sampled exemplar — a bad
+# percentile links to a concrete /api/v1/requests/<id> timeline (the
+# /api/v1/slo endpoint renders buckets + exemplars as JSON).
+
+SERVE_TTFT_SECONDS = REGISTRY.histogram(
+    "cake_serve_ttft_seconds",
+    "Serve-engine time to first token (enqueue to the first token "
+    "FETCHED on the host), by request outcome",
+    labelnames=("outcome",))        # ok | cancelled | error
+
+SERVE_ITL_SECONDS = REGISTRY.histogram(
+    "cake_serve_itl_seconds",
+    "Serve-engine mean inter-token latency per request (decode wall "
+    "time / decoded tokens), by request outcome",
+    labelnames=("outcome",))
+
+SERVE_E2E_SECONDS = REGISTRY.histogram(
+    "cake_serve_e2e_seconds",
+    "Serve-engine end-to-end request latency (enqueue to terminal "
+    "delivery, including queue wait and any preemption/replay), by "
+    "request outcome",
+    labelnames=("outcome",))
 
 SERVE_QUEUE_TIMEOUTS = REGISTRY.counter(
     "cake_serve_queue_timeouts_total",
@@ -277,7 +307,9 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "LATENCY_BUCKETS", "RECORDER", "SpanRecorder", "PhaseTimer", "now",
     "jax_trace", "new_request_id", "set_request_id", "current_request_id",
-    "request_scope",
+    "request_scope", "SPAN_CATALOG", "EVENT_KINDS", "TIMELINES",
+    "TimelineStore", "TRACE_HEADER",
+    "SERVE_TTFT_SECONDS", "SERVE_ITL_SECONDS", "SERVE_E2E_SECONDS",
     "TTFT_SECONDS", "DECODE_TOKEN_SECONDS", "GENERATED_TOKENS",
     "GENERATIONS", "API_REQUESTS", "API_REQUEST_SECONDS",
     "WORKER_FWD_SECONDS", "HOP_SECONDS", "WORKER_HEARTBEAT",

@@ -1,0 +1,6 @@
+"""`python -m cake_tpu.obs` prints the generated observability catalog
+(docs/observability.md) — see catalog.py and `make metrics-doc`."""
+from .catalog import generate_doc
+
+if __name__ == "__main__":
+    print(generate_doc())

@@ -1,0 +1,143 @@
+"""Generator for docs/observability.md — the metric / span / timeline
+catalog plus the endpoint and tracing prose, all from one source.
+
+The hand-written observability page predated serve/paged/spec/fleet and
+went three subsystems stale; like docs/knobs.md it is now GENERATED
+(`make metrics-doc`, `python -m cake_tpu.obs`) and pinned to this module
+by test. The metric table renders the process-global REGISTRY after the
+canonical declarations in obs/__init__.py import, the span table renders
+spans.SPAN_CATALOG, and the timeline-event table renders
+timeline.EVENT_KINDS — so the `metric-registry` lint (which checks every
+constructed instrument name against the generated file) closes the loop:
+an instrument cannot ship undocumented, and the doc cannot drift from
+the code.
+"""
+from __future__ import annotations
+
+_HEADER = """\
+# Observability
+
+<!-- GENERATED FILE — do not edit. Source of truth is
+     cake_tpu/obs/catalog.py (metric table: the canonical declarations
+     in cake_tpu/obs/__init__.py; span table: obs/spans.py
+     SPAN_CATALOG; timeline events: obs/timeline.py EVENT_KINDS).
+     Regenerate with `make metrics-doc`; tests/test_analysis.py pins
+     this file, and the `metric-registry` lint checks every
+     constructed instrument name against it. -->
+
+`cake_tpu/obs/` is the measurement layer for the whole stack: a metrics
+registry (counters / gauges / histograms with Prometheus text
+exposition), a span recorder (Chrome-trace / Perfetto JSON export),
+request-id propagation, and per-request lifecycle timelines. Every
+serving tier records into the same process-global instruments, so one
+`/metrics` scrape, one trace export, or one timeline fetch shows the
+whole request path — fleet router → replica API → serve engine →
+cluster stages.
+
+## Endpoints
+
+| endpoint | serves |
+|---|---|
+| `GET /metrics` | Prometheus text exposition 0.0.4 of every instrument below (per process; worker-side series live in each worker process) |
+| `GET /health` | JSON liveness: worker last-seen ages, gray/hard cluster degradation, the serve-engine block (`alive` / `wedged` / `down` / `draining`, queue depth, `prefilling`, prefix-cache and `kv_pool` occupancy — the paged block carries a first-class `occupancy` field in [0, 1]); 503 while degraded |
+| `GET /api/v1/stats` | last generation's timing snapshot (TTFT, tok/s, per-hop RTT split), with its `request_id` (the cross-tier trace id) and `completion_id` |
+| `GET /api/v1/trace` | Chrome-trace JSON of the span ring buffer (`?clear=1` drains; 409 while the recorder is disabled) |
+| `GET /api/v1/requests` | recent request ids with retrievable timelines |
+| `GET /api/v1/requests/<id>` | one request's typed lifecycle timeline (`?format=perfetto` for Chrome-trace instant events); on the fleet router this view STITCHES the router tier's events onto the replica's |
+| `GET /api/v1/slo` | the serve TTFT / inter-token / e2e histograms by outcome as JSON, each bucket carrying its sampled exemplar request id |
+
+## Request-scoped tracing
+
+One id names a request end to end: `cake route` injects an
+`X-Cake-Request-Id` header (minting `trace-…` when the client sent
+none), the replica API adopts it into the request-id contextvar (spans
+and `/api/v1/stats` carry it), the serve engine keys its timeline events
+by it, and every response echoes the header back. The OpenAI completion
+id (`chatcmpl-…`) is registered as an alias, so either id resolves
+`/api/v1/requests/<id>`.
+
+Timelines are ALWAYS recorded (a dict lookup + list append per event):
+the last `CAKE_TRACE_REQUESTS` requests are kept, each bounded to 512
+events (newest dropped and counted, terminal events always land). The
+span recorder stays opt-in (`CAKE_TRACE_DIR` or `RECORDER.enable()`)
+and bounded by `CAKE_TRACE_EVENTS`; spans recorded while serving a
+request carry the request id in their args, and a timeline's Perfetto
+export uses the same perf_counter clock, so both merge on one axis at
+<https://ui.perfetto.dev>.
+
+## Engine flight recorder
+
+The serve engine appends one record per scheduler iteration (occupancy,
+dispatch bucket, dispatch+fetch wall ms, spec accepts, queue depth,
+paged-pool free/used) into a ring of the last `CAKE_FLIGHT_RECORDER`
+iterations. The supervisor dumps the ring to `CAKE_TRACE_DIR` as JSON
+when the wedge watchdog flags a stuck dispatch or the rebuild budget
+puts the engine DOWN — the post-mortem for the wedge failure mode where
+the process usually gets killed with the evidence in memory.
+
+## SLO accounting
+
+The batched engine path decomposes request latency into
+`cake_serve_ttft_seconds` / `cake_serve_itl_seconds` /
+`cake_serve_e2e_seconds`, labeled by outcome (`ok` / `cancelled` /
+`error`) and observed per terminal request. Every observation carries
+the request id as a per-bucket sampled exemplar (JSON via
+`/api/v1/slo` — the 0.0.4 text format has no exemplar syntax), so a bad
+percentile links to the concrete timeline that explains it. The
+sequential loops keep feeding `cake_ttft_seconds` /
+`cake_decode_token_seconds` as before.
+
+## Wire timing echo
+
+Workers echo `tm = {read_ms, deser_ms, fwd_ms, ser_ms}` in every
+`tensor_result`; the master subtracts the echoed phases from its
+observed RTT and the remainder is `wire` (TCP + response write +
+scheduling). `RemoteStage.rtt_stats()` reports p50/p95/mean/min per
+phase, and each successful hop also lands a `cluster_hop` timeline
+event against the request in flight.
+
+## Guardrails
+
+`make obs-smoke` runs `make lint` (the static-analysis pass — its
+`metric-registry` rule checks every constructed instrument name against
+this file, `hot-timing` keeps ad-hoc wall clocks off hot paths), the
+`make trace-smoke` cross-tier drive (one request through a real
+router + replica must yield a stitched two-tier timeline and non-zero
+SLO histograms), and `scripts/obs_smoke.py` (a traced CPU generation
+asserting `/metrics` histograms and the Chrome-trace export are live).
+The `CAKE_TRACE_*` / `CAKE_FLIGHT_RECORDER` knobs are registered in
+`cake_tpu/knobs.py` and listed in the generated [knobs.md](knobs.md).
+"""
+
+
+def generate_doc() -> str:
+    """The docs/observability.md body, fully generated."""
+    # the canonical instrument declarations live in obs/__init__.py;
+    # importing the package populates REGISTRY before we render it
+    from . import REGISTRY
+    from .spans import SPAN_CATALOG
+    from .timeline import EVENT_KINDS
+
+    out = [_HEADER]
+    out += ["## Metric catalog", "",
+            "Every instrument in the process-global registry, declared "
+            "once in", "`cake_tpu/obs/__init__.py`:", "",
+            "| metric | type | labels | meaning |", "|---|---|---|---|"]
+    for m in sorted(REGISTRY._metrics.values(), key=lambda m: m.name):
+        labels = ", ".join(m.labelnames) if m.labelnames else "—"
+        out.append(f"| `{m.name}` | {m.typ} | {labels} | {m.help} |")
+    out += ["", "## Span catalog", "",
+            "Names recorded into the span recorder (RECORDER), by the "
+            "layer that records them:", "",
+            "| span | recorded by |", "|---|---|"]
+    for name, where in SPAN_CATALOG:
+        out.append(f"| `{name}` | {where} |")
+    out += ["", "## Timeline event catalog", "",
+            "Typed per-request lifecycle events "
+            "(`/api/v1/requests/<id>`); the store rejects kinds missing "
+            "from this table:", "",
+            "| event | meaning |", "|---|---|"]
+    for kind, doc in EVENT_KINDS.items():
+        out.append(f"| `{kind}` | {doc} |")
+    out.append("")
+    return "\n".join(out)

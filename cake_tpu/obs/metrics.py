@@ -63,6 +63,13 @@ class _Metric:
         with self._lock:
             self._values.clear()
 
+    def labelsets(self) -> list[dict]:
+        """The label combinations observed so far (empty dict for an
+        unlabeled metric with samples) — lets JSON surfaces enumerate a
+        metric's series without reaching into _values."""
+        with self._lock:
+            return [dict(zip(self.labelnames, k)) for k in self._values]
+
     def samples(self) -> list[str]:
         raise NotImplementedError
 
@@ -123,8 +130,15 @@ class Histogram(_Metric):
         if not bs:
             raise ValueError(f"{name}: histogram needs at least one bucket")
         self.buckets = tuple(bs)
+        # per-labelset, per-bucket sampled exemplar: the LAST observation
+        # that landed in each bucket, as (exemplar_id, value) — a bad
+        # percentile in a scrape links to one concrete request id whose
+        # timeline (/api/v1/requests/<id>) explains it. Bounded by
+        # labelsets x buckets; exposed via exemplars(), not the
+        # Prometheus text format (0.0.4 has no exemplar syntax)
+        self._exemplars: dict[tuple, dict[int, tuple[str, float]]] = {}
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: str | None = None, **labels):
         key = self._key(labels)
         v = float(value)
         with self._lock:
@@ -134,14 +148,31 @@ class Histogram(_Metric):
                 slot = self._values[key] = [[0] * (len(self.buckets) + 1),
                                             0.0, 0]
             counts, _, _ = slot
+            idx = len(self.buckets)
             for i, b in enumerate(self.buckets):
                 if v <= b:
-                    counts[i] += 1
+                    idx = i
                     break
-            else:
-                counts[-1] += 1
+            counts[idx] += 1
             slot[1] += v
             slot[2] += 1
+            if exemplar is not None:
+                self._exemplars.setdefault(key, {})[idx] = (str(exemplar), v)
+
+    def exemplars(self, **labels) -> dict:
+        """{bucket_le: {"exemplar": id, "value": v}} for one labelset —
+        each bucket's most recent exemplar-carrying observation."""
+        key = self._key(labels)
+        edges = [*self.buckets, float("inf")]
+        with self._lock:
+            ex = dict(self._exemplars.get(key, {}))
+        return {_fmt_le(edges[i]): {"exemplar": rid, "value": v}
+                for i, (rid, v) in sorted(ex.items())}
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+            self._exemplars.clear()
 
     def count(self, **labels) -> int:
         slot = self._values.get(self._key(labels))

@@ -160,6 +160,40 @@ class SpanRecorder:
 # whole request path
 RECORDER = SpanRecorder()
 
+# span vocabulary: every name recorded into RECORDER, with the layer that
+# records it — the observability catalog (docs/observability.md) is
+# generated from this table, so a span cannot ship undocumented (the
+# metric-registry lint's span analog is this table plus the pinned doc)
+SPAN_CATALOG: tuple[tuple[str, str], ...] = (
+    ("prefill", "TextModel / offload / distributed generate: prompt "
+                "prefill (one device call)"),
+    ("decode_segment", "local TextModel: one fused decode segment"),
+    ("decode_dispatch", "local TextModel: decode program dispatch"),
+    ("decode_wait", "local TextModel: host wait on the fetched token"),
+    ("decode_token", "distributed/offload per-token decode loop "
+                     "(contains embed/layers/lm_head/sample)"),
+    ("embed", "per-token embedding phase (distributed/offload loops)"),
+    ("layers", "per-token transformer layers; remote hops carry "
+               "worker/start/end args"),
+    ("lm_head", "per-token lm_head phase (distributed/offload loops)"),
+    ("sample", "per-token sampling phase"),
+    ("recover", "cluster master: quarantine->reconnect->replay cycle "
+                "after a stage failure"),
+    ("replay_prefill", "cluster master: rebuild-by-replay prefill "
+                       "reconstructing lost worker KV"),
+    ("serve.step", "serve engine: one scheduler iteration (args: "
+                   "slots, queued)"),
+    ("serve.prefill_chunk", "serve engine: one chunked-admission "
+                            "prefill dispatch"),
+    ("serve.replay", "serve engine: one slot's crash/preemption replay"),
+    ("spec.verify", "speculative verify dispatch (generate path and "
+                    "batched serve path)"),
+    ("read", "worker wire phase: request frame read (PhaseTimer)"),
+    ("deser", "worker wire phase: payload deserialization (PhaseTimer)"),
+    ("fwd", "worker wire phase: stage forward compute (PhaseTimer)"),
+    ("ser", "worker wire phase: result serialization (PhaseTimer)"),
+)
+
 
 @contextlib.contextmanager
 def jax_trace(log_dir: str | None):

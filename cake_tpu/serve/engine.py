@@ -63,15 +63,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import knobs
-from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_PREFILL_CHUNKS,
-                   SERVE_POISONED, SERVE_PREEMPTIONS, SERVE_QUEUE_TIMEOUTS,
+from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_E2E_SECONDS,
+                   SERVE_ITL_SECONDS, SERVE_PREFILL_CHUNKS, SERVE_POISONED,
+                   SERVE_PREEMPTIONS, SERVE_QUEUE_TIMEOUTS,
                    SERVE_QUEUE_WAIT_SECONDS, SERVE_REQUEST_TIMEOUTS,
-                   SERVE_SLOTS_BUSY, now, set_request_id)
+                   SERVE_SLOTS_BUSY, SERVE_TTFT_SECONDS, TIMELINES, now,
+                   set_request_id)
 from ..ops.sampling import SamplingConfig, config_has_filters
 from ..spec import resolve_drafter
 from ..spec.verify import record_step
 from . import faults
 from .admission import AdmissionQueue, QueueFull
+from .flight import FlightRecorder
 from .paged import KVPoolExhausted, PagedKV, PreemptedSlot, choose_victim
 from .prefix_cache import PagedPrefixCache, PrefixCache
 from .slots import SlotPool, slot_bucket
@@ -359,6 +362,10 @@ class ServeEngine:
         self._stop = threading.Event()
         self.steps = 0                  # completed scheduler iterations
         self.last_step = now()
+        # flight recorder: ring of recent iteration records the
+        # supervisor dumps to CAKE_TRACE_DIR on wedge/DOWN — built
+        # before the supervisor so the watchdog can always reach it
+        self.flight = FlightRecorder()
         self.dead: BaseException | None = None
         # the supervisor needs _stop (watchdog lifetime) — build it after
         # the events, before the scheduler thread can possibly fail
@@ -470,6 +477,8 @@ class ServeEngine:
         # free slots extend the bound: a burst that fits the idle pool is
         # admitted even though the scheduler drains one per iteration
         self.queue.put(req, allow_extra=self.pool.free_count)
+        TIMELINES.begin(req.id)
+        TIMELINES.event(req.id, "enqueue", depth=self.queue.depth())
         self._wake.set()
         if self.dead is not None or self.supervisor.is_down():
             # the scheduler crashed (or went down) between the liveness
@@ -873,6 +882,9 @@ class ServeEngine:
                 # (which may shrink `active`) — see _ensure_decode_blocks
                 active = self._ensure_decode_blocks(active, spec_job)
             packed = None
+            nb = 0
+            td0 = now()                 # dispatch + fetch wall clock
+            spec_acc0 = self.spec_accepted
             active_ids = tuple(self._reqs[i].id for i in active)
             if active:
                 nb = slot_bucket(active[-1] + 1, self.slots)
@@ -958,7 +970,21 @@ class ServeEngine:
                     self._fanout_spec(active, arr, spec_job[0],
                                       spec_job[1], nb)
                 else:
-                    self._fanout(active, arr)
+                    self._fanout(active, arr, nb)
+            # flight record: one bounded dict per iteration — the black
+            # box the supervisor dumps on wedge/DOWN (see flight.py)
+            rec = {
+                "occupancy": len(active), "bucket": nb,
+                "dispatch_ms": round((now() - td0) * 1e3, 3)
+                if packed is not None else 0.0,
+                "queued": self.queue.depth(),
+                "prefilling": len(self._prefills),
+                "spec_accepted": self.spec_accepted - spec_acc0,
+            }
+            if self.paged is not None:
+                rec["kv_free"] = self.paged.alloc.free_count
+                rec["kv_used"] = self.paged.alloc.used_count
+            self.flight.record(**rec)
         return True
 
     # -- chunked admission --------------------------------------------------
@@ -984,6 +1010,9 @@ class ServeEngine:
         req.slot = slot
         req.admitted.set()
         req.stats = {"queue_wait_s": now() - req.t_enqueue}
+        TIMELINES.event(req.id, "admit", slot=slot,
+                        queue_wait_ms=round(
+                            req.stats["queue_wait_s"] * 1e3, 3))
         self._begin_prefill(_Prefill(req, slot))
         SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         return True
@@ -1004,6 +1033,8 @@ class ServeEngine:
                     pf.pos = matched * self.chunk
                     pf.next_block = matched
                     pf.hit_tokens = pf.pos
+                    TIMELINES.event(pf.req.id, "prefix_hit",
+                                    tokens=pf.hit_tokens)
         except Exception as e:
             self._abort_prefill(pf, e, register=False)
             return False
@@ -1035,6 +1066,8 @@ class ServeEngine:
                         pf.ids[pf.pos:pf.pos + take], pf.pos)
             pf.pos += take
             pf.chunks += 1
+            TIMELINES.event(pf.req.id, "prefill_chunk",
+                            pos0=pf.pos - take, tokens=take)
             pf.next_block = self._capture_blocks(pf.ids, pf.slot, pf.pos,
                                                  pf.n, pf.next_block,
                                                  pf.keys)
@@ -1084,6 +1117,8 @@ class ServeEngine:
         req.stats["prefill_chunks"] = pf.chunks
         req.stats["prefix_hit_tokens"] = pf.hit_tokens
         SERVE_PREFILL_CHUNKS.observe(max(pf.chunks, 1))
+        TIMELINES.event(req.id, "prefill_done", chunks=pf.chunks,
+                        hit_tokens=pf.hit_tokens)
 
     def _capture_blocks(self, ids, slot: int, pos: int, n: int,
                         next_block: int, keys: list) -> int:
@@ -1262,6 +1297,7 @@ class ServeEngine:
                 req._first_pending = False  # unfetched 1st token is lost
             entry = PreemptedSlot(req, "recompute", wp)
         SERVE_PREEMPTIONS.inc(mode=entry.mode)
+        TIMELINES.event(req.id, "preempt", mode=entry.mode, tokens=wp)
         self.pool.free(slot)
         self._reqs[slot] = None
         req.slot = None
@@ -1283,6 +1319,8 @@ class ServeEngine:
         self._reqs[pf.slot] = None
         pf.req.slot = None
         SERVE_PREEMPTIONS.inc(mode="recompute")
+        TIMELINES.event(pf.req.id, "preempt", mode="requeue",
+                        tokens=pf.pos)
         self._preempted.append(PreemptedSlot(pf.req, "recompute", 0))
         SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         log.warning("readmitting request %s: KV pool exhausted "
@@ -1315,6 +1353,7 @@ class ServeEngine:
                 self._act = self._act.at[slot].set(True)
                 self._reqs[slot] = req
                 req.slot = slot
+                TIMELINES.event(req.id, "resume", mode="swap", slot=slot)
             else:
                 need = self.paged.blocks_for(entry.tokens_at_preempt + 1)
                 # ensure_free counts cache pins as reclaimable: a parked
@@ -1328,6 +1367,10 @@ class ServeEngine:
                 self._preempted.pop(0)
                 self._reqs[slot] = req
                 req.slot = slot
+                # resume stamps BEFORE the replay it triggers, so the
+                # timeline reads preempt -> resume -> replay
+                TIMELINES.event(req.id, "resume", mode="recompute",
+                                slot=slot)
                 if req.tokens:
                     self._replay_slot(req, slot)
                 else:
@@ -1437,6 +1480,7 @@ class ServeEngine:
         rng after a rebuild."""
         ids = req.prompt_ids + req.tokens[:-1]
         n = len(ids)
+        TIMELINES.event(req.id, "replay", tokens=n)
         hook = faults.FAULT_HOOK
         set_request_id(req.id)
         try:
@@ -1612,6 +1656,7 @@ class ServeEngine:
                 req._first_pending = False
                 req.t_first = now()
                 req.stats["ttft_s"] = req.t_first - req.t_enqueue
+                TIMELINES.event(req.id, "first_token")
                 first = int(arr[0, i])
                 self._emit(req, first)
                 if self.model.cfg.is_eos(first) or req.budget <= 0:
@@ -1624,6 +1669,10 @@ class ServeEngine:
                 self.spec_proposed += n_prop
                 self.spec_accepted += n_acc
                 record_step(n_prop, n_acc, bucket=nb)
+                TIMELINES.event(req.id, "spec_verify", bucket=nb,
+                                proposed=n_prop, accepted=n_acc)
+            else:
+                TIMELINES.event(req.id, "decode", bucket=nb)
             for t in list(drafts[i, :n_acc]) + [nxt]:
                 req.budget -= 1
                 self._emit(req, int(t))
@@ -1633,16 +1682,18 @@ class ServeEngine:
 
     # -- batched decode -----------------------------------------------------
 
-    def _fanout(self, active: list[int], arr: np.ndarray):
+    def _fanout(self, active: list[int], arr: np.ndarray, nb: int):
         """Fan one decode iteration's packed ids out to the streams: row 0
         carries each slot's input token (a just-activated slot's unemitted
         FIRST token), row 1 the token this step sampled."""
         for i in active:
             req = self._reqs[i]
+            TIMELINES.event(req.id, "decode", bucket=nb)
             if req._first_pending:
                 req._first_pending = False
                 req.t_first = now()     # first token actually on host:
                 req.stats["ttft_s"] = req.t_first - req.t_enqueue
+                TIMELINES.event(req.id, "first_token")
                 first = int(arr[0, i])
                 self._emit(req, first)
                 if self.model.cfg.is_eos(first) or req.budget <= 0:
@@ -1686,9 +1737,37 @@ class ServeEngine:
         if not cancelled and req.tokens:
             from ..models.common.text_model import _observe_generation
             _observe_generation(req.stats, len(req.tokens), path="serve")
+        # SLO + terminal event only for a request not already finalized:
+        # _fail may have released this waiter earlier (close() timeout
+        # path), and a second terminal would double-count the histograms
+        # and leave two conflicting terminals on the timeline
+        if not req.done.is_set():
+            outcome = "cancelled" if cancelled and "error" not in req.result \
+                else ("error" if cancelled else "ok")
+            self._observe_slo(req, outcome)
+            TIMELINES.event(
+                req.id, "finish", outcome=outcome, tokens=len(req.tokens),
+                ttft_ms=round(req.stats.get("ttft_s", 0.0) * 1e3, 3),
+                e2e_ms=round((now() - req.t_enqueue) * 1e3, 3))
         SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         req._deliver(ServeRequest.DONE)
         req._fire_done()
+
+    def _observe_slo(self, req: ServeRequest, outcome: str):
+        """Batched-path SLO decomposition, per terminal request: TTFT /
+        mean ITL / e2e histograms labeled by outcome, each observation
+        carrying the request id as its exemplar so a bad percentile in a
+        scrape links to a concrete /api/v1/requests/<id> timeline."""
+        SERVE_E2E_SECONDS.observe(now() - req.t_enqueue, exemplar=req.id,
+                                  outcome=outcome)
+        if req.t_first:
+            SERVE_TTFT_SECONDS.observe(req.t_first - req.t_enqueue,
+                                       exemplar=req.id, outcome=outcome)
+            ndec = max(len(req.tokens) - 1, 0)
+            if ndec:
+                SERVE_ITL_SECONDS.observe(
+                    (now() - req.t_first) / ndec, exemplar=req.id,
+                    outcome=outcome)
 
     def _fail(self, req: ServeRequest, error: BaseException | None):
         if error is not None:
@@ -1697,6 +1776,13 @@ class ServeEngine:
         # keep whatever stats accrued (queue_wait_s, prefill progress) —
         # failed/cancelled requests are the ones worth diagnosing
         req.result.setdefault("stats", req.stats)
+        if not req.done.is_set():
+            err = req.result.get("error")
+            self._observe_slo(req, "error" if err is not None
+                              else "cancelled")
+            TIMELINES.event(req.id, "error",
+                            type=type(err).__name__ if err is not None
+                            else "cancelled")
         req._deliver(ServeRequest.DONE)
         req._fire_done()
 

@@ -325,6 +325,11 @@ class PagedKV:
             "block_tokens": self.bt,
             "free": self.alloc.free_count,
             "used": self.alloc.used_count,
+            # first-class occupancy in [0, 1]: consumers (the fleet
+            # router's probe loop, autoscalers) read this directly
+            # instead of re-deriving used/blocks by hand
+            "occupancy": round(self.alloc.used_count
+                               / max(self.num_blocks, 1), 4),
             "shared": self.alloc.shared_count,
             "cow_forks": self.alloc.cow_forks,
             "swaps": self.swaps,

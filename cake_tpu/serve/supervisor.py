@@ -66,7 +66,8 @@ import numpy as np
 
 from .. import knobs
 from ..obs import (SERVE_ENGINE_DOWN, SERVE_ENGINE_REBUILDS,
-                   SERVE_ENGINE_WEDGES, SERVE_STEP_FAILURES, now)
+                   SERVE_ENGINE_WEDGES, SERVE_STEP_FAILURES, TIMELINES,
+                   now)
 
 log = logging.getLogger("cake_tpu.serve.supervisor")
 
@@ -253,6 +254,10 @@ class Supervisor:
             SERVE_ENGINE_WEDGES.inc()
             log.error("serve watchdog: %s dispatch in flight %.1fs "
                       "(limit %.1fs) — engine wedged", phase, age, limit)
+            # black box out the door while the evidence is fresh: the
+            # wedged dispatch may never return, and a later process kill
+            # would take the in-memory ring with it
+            self._dump_flight("wedge")
 
     # -- failure handling (scheduler thread) --------------------------------
 
@@ -302,6 +307,9 @@ class Supervisor:
             kind = ("poison" if poisoned
                     else "wedge" if wedged else classify(exc))
             SERVE_STEP_FAILURES.inc(kind=kind)
+            for rid in implicated:
+                TIMELINES.event(rid, "step_failure", failure=kind,
+                                phase=phase)
             summary = (f"{kind} in {phase}: "
                        f"{type(exc).__name__}: {exc}")
             with self._lock:
@@ -339,6 +347,7 @@ class Supervisor:
                 eng._fail_all(EngineDown(
                     f"serve engine down: rebuild budget exhausted ({summary})",
                     retry_after_s=max(int(self.restore_interval_s) + 1, 5)))
+                self._dump_flight("down")
                 return True
             self._rebuilds.append(t)
             self.rebuild_count += 1
@@ -349,6 +358,14 @@ class Supervisor:
                 return True
             except BaseException as next_exc:  # recovery crashed: re-enter
                 exc = next_exc
+
+    def _dump_flight(self, reason: str) -> None:
+        """Write the engine's iteration ring to CAKE_TRACE_DIR (no-op
+        without a trace dir; never raises — see flight.py). Runs on the
+        watchdog thread (wedge) or the scheduler thread (DOWN)."""
+        fr = getattr(self.engine, "flight", None)
+        if fr is not None:
+            fr.dump(reason, extra={"last_failure": self.last_failure()})
 
     def note_replay_ok(self) -> None:
         """One slot's replay completed — the contrast that makes a later

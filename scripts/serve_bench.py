@@ -438,7 +438,12 @@ def bench_fleet(model):
                 async with stats_session.get(
                         rep.base_url + "/api/v1/stats") as sr:
                     stats = (await sr.json()).get("stats") or {}
-                if stats.get("request_id") == cid:
+                # the router injects a trace id that becomes the
+                # replica's request_id; the OpenAI completion id rides
+                # along as completion_id — match on either so the bench
+                # works with and without a fronting router
+                if cid in (stats.get("request_id"),
+                           stats.get("completion_id")):
                     return {"ttft_s": stats["ttft_s"],
                             "prefix_hit_tokens":
                                 stats.get("prefix_hit_tokens", 0)}

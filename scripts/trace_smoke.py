@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Request-tracing smoke: one chat driven through a REAL fleet router
+fronting a REAL engine-backed replica (tiny CPU model) must yield
+
+  (a) a trace id minted by the router, injected into the replica via the
+      X-Cake-Request-Id header, and echoed on the response;
+  (b) a STITCHED timeline retrievable by that id from the router's
+      /api/v1/requests/<id> — router-tier events (route/attempt/done)
+      AND replica-tier engine events (enqueue/admit/prefill/first_token/
+      finish) on the same id;
+  (c) non-zero TTFT / inter-token / e2e SLO histograms in the replica's
+      /metrics, and /api/v1/slo exemplars linking back to the traced id.
+
+Exits non-zero on any missing signal. Run via `make trace-smoke` (also a
+prerequisite of obs-smoke).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                    # noqa: E402
+
+from cake_tpu.api import ApiState, create_app              # noqa: E402
+from cake_tpu.fleet.registry import (MembershipPolicy,     # noqa: E402
+                                     ReplicaRegistry)
+from cake_tpu.fleet.router import (FleetRouter,            # noqa: E402
+                                   create_router_app)
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.obs import TRACE_HEADER                      # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+
+
+class SmokeTok:
+    def encode(self, text):
+        return [3 + (sum(w.encode()) % 200) for w in text.split()][:48] or [3]
+
+    def decode(self, ids):
+        return "".join(f"<{i}>" for i in ids)
+
+
+async def main_async() -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=128)
+    model.tokenizer = SmokeTok()
+    engine = ServeEngine(model, slots=2, max_queue=8, ctx_len=128,
+                         prefill_chunk=16)
+    state = ApiState(model=model, tokenizer=model.tokenizer,
+                     model_id="trace-smoke")
+    state.engine = engine
+    replica_server = TestServer(create_app(state))
+    await replica_server.start_server()
+    replica_url = str(replica_server.make_url("")).rstrip("/")
+
+    registry = ReplicaRegistry(MembershipPolicy())
+    registry.add("r0", replica_url)
+    router = FleetRouter(registry, retries=1, backoff_s=0.01,
+                         probe_s=30.0, hedge_ms=0.0)
+    client = TestClient(TestServer(create_router_app(router)))
+    await client.start_server()
+    try:
+        # -- (a) one request through router -> replica -> engine --------
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user",
+                          "content": "alpha bravo charlie delta"}],
+            "max_tokens": 6, "temperature": 0.0})
+        assert r.status == 200, await r.text()
+        rid = r.headers.get(TRACE_HEADER)
+        assert rid and rid.startswith("trace-"), \
+            f"router did not echo a trace id (got {rid!r})"
+        body = await r.json()
+        cid = body["id"]
+
+        # -- (b) stitched timeline from the router ----------------------
+        tr = await client.get(f"/api/v1/requests/{rid}")
+        assert tr.status == 200, await tr.text()
+        stitched = await tr.json()
+        tiers = {t["tier"]: t for t in stitched["tiers"]}
+        assert set(tiers) >= {"router", "replica"}, sorted(tiers)
+        router_kinds = [e["kind"] for e in tiers["router"]["events"]]
+        replica_kinds = [e["kind"] for e in tiers["replica"]["events"]]
+        for k in ("route", "attempt", "done"):
+            assert k in router_kinds, (k, router_kinds)
+        for k in ("received", "enqueue", "admit", "prefill_chunk",
+                  "prefill_done", "first_token", "decode", "finish"):
+            assert k in replica_kinds, (k, replica_kinds)
+        # the replica timeline resolves by the completion id alias too
+        import aiohttp
+        async with aiohttp.ClientSession() as s:
+            async with s.get(replica_url + f"/api/v1/requests/{cid}") as ar:
+                assert ar.status == 200, "completion-id alias missing"
+
+            # -- (c) SLO histograms + exemplars -------------------------
+            async with s.get(replica_url + "/metrics") as mr:
+                text = await mr.text()
+            for needle in ("cake_serve_ttft_seconds_count",
+                           "cake_serve_itl_seconds_count",
+                           "cake_serve_e2e_seconds_count"):
+                line = [ln for ln in text.splitlines()
+                        if ln.startswith(needle)
+                        and 'outcome="ok"' in ln]
+                assert line and not line[0].endswith(" 0"), \
+                    f"/metrics missing non-zero {needle}: {line}"
+            async with s.get(replica_url + "/api/v1/slo") as sr:
+                slo = await sr.json()
+            exemplars = [
+                ex["exemplar"]
+                for hist in slo.values() for series in hist["series"]
+                for ex in series["exemplars"].values()]
+            assert rid in exemplars, \
+                f"SLO exemplars do not link to the traced id: {exemplars}"
+        return {"trace_smoke": "ok", "request_id": rid,
+                "router_events": len(router_kinds),
+                "replica_events": len(replica_kinds)}
+    finally:
+        await client.close()
+        await replica_server.close()
+        engine.close()
+
+
+def main() -> int:
+    out = asyncio.run(main_async())
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

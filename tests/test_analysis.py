@@ -200,6 +200,64 @@ def test_knob_rule_exempts_registry_module():
     assert len(fire(src, "knob-registry", rel=COLD)) == 1
 
 
+# -- metric-registry ------------------------------------------------------
+
+def test_metric_rule_fires_on_uncataloged_name():
+    src = """
+from cake_tpu.obs import REGISTRY
+
+BOGUS = REGISTRY.counter("cake_fixture_bogus_total", "never documented")
+"""
+    got = fire(src, "metric-registry")
+    assert len(got) == 1 and "cake_fixture_bogus_total" in got[0].msg
+
+
+def test_metric_rule_clean_on_cataloged_and_foreign_names():
+    src = """
+from cake_tpu.obs import REGISTRY
+
+TTFT = REGISTRY.histogram("cake_ttft_seconds", "documented")
+OTHER = REGISTRY.counter("someone_elses_metric_total", "not ours")
+H = some.other.histogram([1, 2, 3])
+"""
+    assert fire(src, "metric-registry") == []
+
+
+def test_metric_rule_scoped_to_package_and_suppressible():
+    src = ('from cake_tpu.obs import REGISTRY\n'
+           'X = REGISTRY.gauge("cake_fixture_bogus")\n')
+    assert fire(src, "metric-registry", rel="scripts/foo.py") == []
+    sup = ('from cake_tpu.obs import REGISTRY\n'
+           'X = REGISTRY.gauge("cake_fixture_bogus")'
+           '  # lint: disable=metric-registry — fixture\n')
+    got = fire(sup, "metric-registry")
+    assert len(got) == 1 and got[0].suppressed
+
+
+def test_observability_doc_generated_and_in_sync():
+    """docs/observability.md is GENERATED (metric table from the obs
+    registry, span table from SPAN_CATALOG, event table from
+    EVENT_KINDS); regenerate with `make metrics-doc` if this fails —
+    the metric-registry lint checks instrument names against it."""
+    from cake_tpu.obs.catalog import generate_doc
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "observability.md")
+    with open(path, encoding="utf-8") as f:
+        assert f.read().rstrip() == generate_doc().rstrip(), \
+            "docs/observability.md is stale — run `make metrics-doc`"
+
+
+def test_catalog_covers_every_registered_instrument():
+    """Every instrument in the live registry appears in the catalog the
+    lint checks against — the invariant that makes 'lint passes' mean
+    'nothing undocumented'."""
+    from cake_tpu import obs
+    from cake_tpu.analysis.check_metrics import catalog_names
+    names = catalog_names()
+    missing = [m for m in obs.REGISTRY._metrics if m not in names]
+    assert not missing, f"catalog missing {missing} — run `make metrics-doc`"
+
+
 # -- lock-discipline ------------------------------------------------------
 
 LOCKS_SRC = """
@@ -283,7 +341,8 @@ def test_suppression_without_reason_is_a_violation():
 def test_all_rules_registered():
     assert set(RULES) == {"host-sync", "recompile-hazard",
                           "use-after-donate", "knob-registry",
-                          "lock-discipline", "hot-timing"}
+                          "lock-discipline", "hot-timing",
+                          "metric-registry"}
 
 
 def test_repo_is_lint_clean():

@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -56,6 +56,18 @@ obs-smoke: lint trace-smoke
 # prompts (tiny CPU model, in-process aiohttp)
 serve-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# QoS admission-plane gate: batch-image saturation (tiny diffusion stub
+# through the job executor) with interleaved interactive chat — chat
+# TTFT p50 must stay within 2x the idle baseline, every batch job must
+# complete, and the class-labeled queue gauges must be live in /metrics
+qos-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/qos_smoke.py
+
+# mixed-workload QoS bench: idle vs batch-saturated interactive TTFT,
+# weighted-fair service shares, job throughput (BENCH_QOS_<tag>.json)
+serve-bench-qos:
+	JAX_PLATFORMS=cpu python scripts/serve_bench.py --qos --tag qos
 
 # fault-tolerance gate: master + 2 real workers on localhost, one worker
 # killed mid-stream by a deterministic fault plan — the generation must

@@ -14,7 +14,14 @@ HOT_PATHS = frozenset({
     "cake_tpu/models/common/offload_model.py",
     # continuous-batching scheduler: one iteration per pool-wide token
     "cake_tpu/serve/engine.py",
-    "cake_tpu/serve/admission.py",
+    # unified admission plane: class-aware dequeue + tenant buckets run
+    # per submitted request, job checkpoints per diffusion step
+    "cake_tpu/serve/admission/__init__.py",
+    "cake_tpu/serve/admission/classes.py",
+    "cake_tpu/serve/admission/queue.py",
+    "cake_tpu/serve/admission/tenants.py",
+    "cake_tpu/serve/admission/jobs.py",
+    "cake_tpu/serve/admission/plane.py",
     "cake_tpu/serve/slots.py",
     "cake_tpu/serve/prefix_cache.py",
     # paged KV: the allocator + table remaps run per scheduler iteration,

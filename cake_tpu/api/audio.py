@@ -1,5 +1,13 @@
 """TTS endpoint: /v1/audio/speech with optional base64 voice-clone upload,
-wav/pcm response (ref: cake-core/src/cake/sharding/api/audio.rs:1-155)."""
+wav/pcm response (ref: cake-core/src/cake/sharding/api/audio.rs:1-155).
+
+TTS flows through the unified admission plane as a GenerationJob
+(default class ``batch``): tenant quotas and class-aware backpressure
+answer typed 429s before any work starts, the job's lifecycle is
+traceable via GET /api/v1/requests/<id>, drain refuses new jobs while
+running ones finish, and the synthesis loop yields between frames
+(job.checkpoint via on_frame) so queued interactive chat is never
+starved by a long utterance."""
 from __future__ import annotations
 
 import base64
@@ -8,8 +16,10 @@ import os
 
 from aiohttp import web
 
-from ..obs import GENERATIONS, request_scope
-from .state import ApiState, run_blocking
+from ..obs import TRACE_HEADER
+from .qos import (adopt_job_request_id, resolve_admission,
+                  run_admitted_job, supports_kw)
+from .state import ApiState
 
 log = logging.getLogger("cake_tpu.api.audio")
 
@@ -37,6 +47,10 @@ async def audio_speech(request: web.Request) -> web.Response:
     state: ApiState = request.app["state"]
     if state.audio_model is None:
         return web.json_response({"error": "no audio model loaded"}, status=503)
+    if state.draining:
+        return web.json_response(
+            {"error": "server draining for shutdown"}, status=503,
+            headers={"Retry-After": "5"})
     try:
         body = await request.json()
     except Exception:
@@ -56,24 +70,31 @@ async def audio_speech(request: web.Request) -> web.Response:
         except Exception:
             return web.json_response({"error": "invalid voice_b64"}, status=400)
 
-    async with state.lock:
-        with request_scope():
+    resolved = resolve_admission(state, request, body, "batch")
+    if isinstance(resolved, web.Response):
+        return resolved
+    qos, tenant, release = resolved
+    rid = adopt_job_request_id(request, "tts")
+    gen = state.audio_model.generate_speech
 
-            def _run():
-                return state.audio_model.generate_speech(
-                    text, voice=voice, voice_wav=voice_wav,
-                    cfg_scale=float(body.get("cfg_scale", 1.3)),
-                    steps=int(body.get("steps", 10)),
-                )
+    def _run(job):
+        kw = dict(voice=voice, voice_wav=voice_wav,
+                  cfg_scale=float(body.get("cfg_scale", 1.3)),
+                  steps=int(body.get("steps", 10)))
+        if supports_kw(gen, "on_frame"):
+            # per-frame checkpoint: cancellation + interactive yield
+            kw["on_frame"] = lambda *a: job.checkpoint()
+        return gen(text, **kw)
 
-            try:
-                audio = await run_blocking(_run)
-            except Exception:
-                GENERATIONS.inc(kind="audio", status="error")
-                raise
-    GENERATIONS.inc(kind="audio", status="ok")
+    job, refusal = await run_admitted_job(state, "audio", _run, qos,
+                                          tenant, rid, release)
+    if refusal is not None:
+        return refusal
+    audio = job.result["value"]
 
     if fmt == "pcm":
         return web.Response(body=audio.pcm_bytes(),
-                            content_type="application/octet-stream")
-    return web.Response(body=audio.wav_bytes(), content_type="audio/wav")
+                            content_type="application/octet-stream",
+                            headers={TRACE_HEADER: rid})
+    return web.Response(body=audio.wav_bytes(), content_type="audio/wav",
+                        headers={TRACE_HEADER: rid})

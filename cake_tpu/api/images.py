@@ -1,6 +1,16 @@
 """Image generation endpoints: OpenAI /v1/images/generations + legacy
 /api/v1/image (ref: cake-core/src/cake/sharding/api/image.rs:1-240 —
-b64_json or png response)."""
+b64_json or png response).
+
+Since the unified admission plane (ISSUE 14), image generation no longer
+runs under the pre-PR-2 one-request lock: each request becomes a
+GenerationJob admitted under a QoS class (default ``batch``, override
+via X-Cake-QoS / body ``qos``, clamped by tenant policy) through the
+same weighted-fair queue machinery as chat — visible in the queue-depth
+gauges, the per-request timeline (enqueue→admit→finish), tenant quotas,
+and drain. The job yields between diffusion steps (job.checkpoint wired
+into the pipeline's on_step), so queued interactive chat is never stuck
+behind a 20-step FLUX generation."""
 from __future__ import annotations
 
 import base64
@@ -9,22 +19,41 @@ import time
 
 from aiohttp import web
 
-from ..obs import GENERATIONS, request_scope
-from .state import ApiState, run_blocking
+from .. import knobs
+from ..obs import TRACE_HEADER
+from .qos import (adopt_job_request_id, resolve_admission,
+                  run_admitted_job, supports_kw)
+from .state import ApiState
 
 
 def _parse_size(s: str) -> tuple[int, int]:
+    """WIDTHxHEIGHT, bounded: non-positive or absurd dimensions answer
+    400 instead of letting one request allocate an OOM-sized latent on
+    the device (CAKE_IMAGE_MAX_SIZE caps each side, default 2048)."""
     try:
         w, h = s.lower().split("x")
-        return int(w), int(h)
+        w, h = int(w), int(h)
     except Exception:
         raise web.HTTPBadRequest(text="size must be WIDTHxHEIGHT")
+    limit = knobs.get("CAKE_IMAGE_MAX_SIZE")
+    if w <= 0 or h <= 0:
+        raise web.HTTPBadRequest(
+            text=f"size {w}x{h} must be positive")
+    if w > limit or h > limit:
+        raise web.HTTPBadRequest(
+            text=f"size {w}x{h} exceeds CAKE_IMAGE_MAX_SIZE "
+                 f"({limit}x{limit})")
+    return w, h
 
 
 async def images_generations(request: web.Request) -> web.Response:
     state: ApiState = request.app["state"]
     if state.image_model is None:
         return web.json_response({"error": "no image model loaded"}, status=503)
+    if state.draining:
+        return web.json_response(
+            {"error": "server draining for shutdown"}, status=503,
+            headers={"Retry-After": "5"})
     try:
         body = await request.json()
     except Exception:
@@ -45,8 +74,8 @@ async def images_generations(request: web.Request) -> web.Response:
     # img2img: image BYTES in the body (like audio's voice_b64) — the
     # reference's legacy endpoint takes a server-side file path from the
     # request, which we deliberately do not (clients must not choose
-    # server filesystem paths). The encode itself runs under the lock in
-    # the executor below, next to the generation it feeds.
+    # server filesystem paths). The encode itself runs inside the job,
+    # next to the generation it feeds.
     init_pil = None
     if body.get("init_image_b64"):
         if not hasattr(state.image_model, "init_latent_from"):
@@ -64,15 +93,14 @@ async def images_generations(request: web.Request) -> web.Response:
     # SD-only debug surface (ref: sd.rs intermediary_images / --sd-tracing):
     # OPERATOR-set via CLI flags on ApiState — request bodies cannot point
     # the server at filesystem paths or make it dump per-step files
-    import inspect
-    sig = inspect.signature(state.image_model.generate_image).parameters
-    if "intermediate_every" in sig and state.sd_intermediate_every:
+    gen = state.image_model.generate_image
+    if supports_kw(gen, "intermediate_every") and state.sd_intermediate_every:
         kwargs["intermediate_every"] = state.sd_intermediate_every
-    if "trace_dir" in sig and state.sd_trace_dir:
+    if supports_kw(gen, "trace_dir") and state.sd_trace_dir:
         kwargs["trace_dir"] = state.sd_trace_dir
 
     # OpenAI `n` (ref: --sd-num-samples): sequential generations with
-    # derived seeds, bounded so a request can't monopolize the server
+    # derived seeds, bounded so a request can't monopolize the executor
     try:
         n = int(body.get("n") or 1)
     except (TypeError, ValueError):
@@ -81,35 +109,40 @@ async def images_generations(request: web.Request) -> web.Response:
         return web.json_response({"error": "n must be 1..4"}, status=400)
     if n > 1 and (fmt == "png" or request.path.endswith("/image")):
         # the raw-png responses carry exactly one image — generating the
-        # extras under the lock would just burn device time
+        # extras in the job would just burn device time
         return web.json_response(
             {"error": "n > 1 needs response_format=b64_json"}, status=400)
 
-    def _run():
+    # admission plane: class (default batch) + tenant quota BEFORE any
+    # queue slot; the trace id makes the job's lifecycle retrievable
+    resolved = resolve_admission(state, request, body, "batch")
+    if isinstance(resolved, web.Response):
+        return resolved
+    qos, tenant, release = resolved
+    rid = adopt_job_request_id(request, "img")
+
+    def _run(job):
+        # per-step checkpoint: a cancelled client stops the loop at the
+        # next step, and queued interactive traffic gets the thread
+        if supports_kw(gen, "on_step"):
+            kwargs["on_step"] = lambda i, total: job.checkpoint()
         if init_pil is not None:
             kwargs["init_image"] = state.image_model.init_latent_from(
                 init_pil, w, h)
         out = []
         for i in range(n):
+            job.checkpoint()
             kw = dict(kwargs)
             if n > 1:
                 kw["seed"] = (kwargs.get("seed") or 0) + i
-            out.append(state.image_model.generate_image(prompt, **kw))
+            out.append(gen(prompt, **kw))
         return out
 
-    async with state.lock:
-        with request_scope():
-            try:
-                images = await run_blocking(_run)
-            except ValueError as e:
-                # user-input class: too-small image, encoder-less checkpoint,
-                # bad parameter combinations
-                GENERATIONS.inc(kind="image", status="error")
-                return web.json_response({"error": str(e)}, status=400)
-            except Exception:
-                GENERATIONS.inc(kind="image", status="error")
-                raise
-    GENERATIONS.inc(kind="image", status="ok")
+    job, refusal = await run_admitted_job(state, "image", _run, qos,
+                                          tenant, rid, release)
+    if refusal is not None:
+        return refusal
+    images = job.result["value"]
 
     pngs = []
     for image in images:
@@ -117,8 +150,9 @@ async def images_generations(request: web.Request) -> web.Response:
         image.save(buf, format="PNG")
         pngs.append(buf.getvalue())
     if fmt == "png" or request.path.endswith("/image"):
-        return web.Response(body=pngs[0], content_type="image/png")
+        return web.Response(body=pngs[0], content_type="image/png",
+                            headers={TRACE_HEADER: rid})
     return web.json_response({
         "created": int(time.time()),
         "data": [{"b64_json": base64.b64encode(p).decode()} for p in pngs],
-    })
+    }, headers={TRACE_HEADER: rid})

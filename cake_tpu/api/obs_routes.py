@@ -224,5 +224,11 @@ async def health(request: web.Request) -> web.Response:
         if not einfo["alive"] or einfo["wedged"] or einfo.get("down"):
             degraded = True
         body["engine"] = einfo
+    plane = getattr(state, "plane", None)
+    if plane is not None:
+        # unified admission plane: heavy-job executor occupancy +
+        # per-class queue depths (jobs + chat share the class gauges;
+        # this block is the per-process view a fleet router probes)
+        body["admission"] = plane.health()
     body["status"] = "degraded" if degraded else "ok"
     return web.json_response(body, status=503 if degraded else 200)

@@ -1,0 +1,151 @@
+"""Admission-plane glue shared by the generation endpoints.
+
+One module owns the request-side vocabulary of the unified admission
+plane (serve/admission/): trace-id adoption for non-chat workloads, the
+class/tenant resolution + tenant-quota gate that runs BEFORE any queue
+slot is consumed, and the mapping from typed admission refusals onto
+their documented HTTP answers —
+
+  * ``TenantQuotaExceeded`` → 429, body ``{"type": "tenant_quota"}``,
+    Retry-After from the bucket's refill horizon;
+  * ``QueueFull``           → 429, class-aware Retry-After (that
+    class's backlog over its weighted service share);
+  * ``JobsDraining`` / engine drain → 503 + Retry-After so balancers
+    fail the client over to a replica that is staying up.
+
+Chat, images and audio all answer overload identically because they
+all go through here.
+"""
+from __future__ import annotations
+
+import contextlib
+import inspect
+import uuid
+
+from aiohttp import web
+
+from ..obs import (GENERATIONS, SERVE_QOS_SHEDS, TIMELINES, TRACE_HEADER,
+                   set_request_id)
+from ..serve.admission import (JobCancelled, JobsDraining, QueueFull,
+                               TenantQuotaExceeded, get_plane)
+
+__all__ = ["adopt_job_request_id", "admission_refusal", "get_plane",
+           "resolve_admission", "run_admitted_job", "supports_kw"]
+
+
+def adopt_job_request_id(request: web.Request, kind: str) -> str:
+    """Cross-tier trace adoption for image/audio jobs — the same
+    contract chat's _adopt_request_id implements: an X-Cake-Request-Id
+    header becomes THE id (contextvar, timeline key, response echo);
+    without one a `<kind>-…` id is minted. GET /api/v1/requests/<id>
+    then shows the job's enqueue→admit→finish lifecycle."""
+    rid = request.headers.get(TRACE_HEADER) \
+        or f"{kind}-" + uuid.uuid4().hex[:16]
+    set_request_id(rid)
+    TIMELINES.begin(rid)
+    TIMELINES.event(rid, "received")
+    return rid
+
+
+def resolve_admission(state, request: web.Request, body: dict,
+                      default_qos: str):
+    """(qos, tenant, release) for one request, or a ready web.Response
+    refusal. Resolution order: endpoint default → X-Cake-QoS header /
+    body ``qos`` → tenant policy clamp; then the tenant's token bucket
+    and inflight cap are charged (typed 429 before any queue slot).
+    `release` is an idempotent thunk the caller runs when the request
+    reaches a terminal state (handler finally)."""
+    plane = get_plane(state)
+    try:
+        qos, tenant = plane.resolve(request.headers, body, default_qos)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    try:
+        release = plane.admit(tenant)
+    except TenantQuotaExceeded as e:
+        return admission_refusal(e)
+    return qos, tenant, release
+
+
+def supports_kw(fn, name: str) -> bool:
+    """True when fn accepts keyword `name` (explicitly or via **kwargs)
+    — the image/audio pipeline surface varies by model family."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    params = sig.parameters
+    return name in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
+
+
+async def run_admitted_job(state, kind: str, fn, qos: str,
+                           tenant: str | None, rid: str, release):
+    """Submit `fn` as a GenerationJob and await its terminal state —
+    the one image/audio execution path (lock rule, refusal mapping,
+    error→status tail), so the two endpoints cannot diverge. Returns
+    (job, None) on success or (None, web.Response) to relay.
+
+    Lock rule: engine-less text models (distributed/offload) still
+    generate under state.lock, and before the plane existed heavy jobs
+    shared that lock — hold it for exactly that configuration so a
+    diffusion/TTS job can never run a device forward concurrently with
+    a locked text generation. Engine deployments stay lock-free
+    (batched decode is concurrent with jobs by design — docs/qos.md)."""
+    lock = state.lock if (state.engine is None and state.model is not None) \
+        else contextlib.nullcontext()
+    try:
+        async with lock:
+            try:
+                job = get_plane(state).submit_job(
+                    kind, fn, qos=qos, tenant=tenant, request_id=rid)
+            except Exception as e:
+                resp = admission_refusal(e)
+                if resp is not None:
+                    GENERATIONS.inc(kind=kind, status="error")
+                    return None, resp
+                raise
+            from .state import await_job
+            await await_job(job)
+    finally:
+        release()
+    err = job.result.get("error")
+    if err is not None:
+        GENERATIONS.inc(kind=kind, status="error")
+        # terminal admission refusals (executor closed under drain
+        # timeout) answer their documented status, not a bare 500
+        resp = admission_refusal(err)
+        if resp is not None:
+            return None, resp
+        if isinstance(err, ValueError):
+            # user-input class: bad sizes, encoder-less checkpoints,
+            # bad parameter combinations
+            return None, web.json_response({"error": str(err)},
+                                           status=400)
+        if isinstance(err, JobCancelled):
+            return None, web.json_response(
+                {"error": f"{kind} generation cancelled"}, status=503)
+        raise err
+    GENERATIONS.inc(kind=kind, status="ok")
+    return job, None
+
+
+def admission_refusal(err: BaseException) -> web.Response | None:
+    """Typed admission failure → its documented HTTP answer; None when
+    `err` is not an admission-plane refusal (caller decides)."""
+    if isinstance(err, TenantQuotaExceeded):
+        return web.json_response(
+            err.body(), status=429,
+            headers={"Retry-After": str(err.retry_after_s)})
+    if isinstance(err, QueueFull):
+        SERVE_QOS_SHEDS.inc(qos=err.qos)
+        return web.json_response(
+            {"error": f"server overloaded: admission queue full for "
+                      f"class {err.qos!r}", "qos": err.qos},
+            status=429,
+            headers={"Retry-After": str(err.retry_after_s)})
+    if isinstance(err, JobsDraining):
+        return web.json_response(
+            {"error": str(err)}, status=503,
+            headers={"Retry-After": str(err.retry_after_s)})
+    return None

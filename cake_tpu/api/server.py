@@ -120,8 +120,20 @@ async def graceful_drain(app: web.Application):
     instead of a severed socket."""
     state = app["state"]
     state.draining = True
+    # the admission plane's job lanes drain with the engine: NEW image/
+    # audio jobs answer typed 503s from this instant, queued + running
+    # jobs finish inside the same CAKE_DRAIN_TIMEOUT_S budget below
+    plane = getattr(state, "plane", None)
+    if plane is not None:
+        plane.begin_drain()
     engine = getattr(state, "engine", None)
     if engine is None:
+        if plane is not None:
+            timeout = knobs.get("CAKE_DRAIN_TIMEOUT_S")
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None,
+                                       lambda: plane.drain(timeout))
+            plane.close()
         return
     # flip the engine's own draining flag BEFORE the blocking drain is
     # handed to an executor thread: /health's engine block must say
@@ -135,10 +147,19 @@ async def graceful_drain(app: web.Application):
     # drain() busy-waits — keep the event loop free to stream the final
     # SSE chunks of exactly the requests being drained
     loop = asyncio.get_running_loop()
+    t0 = now()
     clean = await loop.run_in_executor(None, lambda: engine.drain(timeout))
     if not clean:
         log.warning("drain timed out; failing remaining requests")
     engine.close()
+    if plane is not None:
+        # ONE shared budget: the job lanes get whatever the engine
+        # drain left (small floor so a quick engine drain never
+        # zero-times the jobs) — CAKE_DRAIN_TIMEOUT_S stays the
+        # worst-case total an operator sizes terminationGracePeriod to
+        remaining = max(timeout - (now() - t0), 2.0)
+        await loop.run_in_executor(None, lambda: plane.drain(remaining))
+        plane.close()
 
 
 def serve(state: ApiState, host: str = "0.0.0.0", port: int = 8000,

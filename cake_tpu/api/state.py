@@ -46,6 +46,11 @@ class ApiState:
     # continuous-batching engine (cake_tpu/serve/) — set for plain
     # TextModels; None keeps every request on the locked fallback path
     engine: Any = None
+    # unified admission plane (serve/admission/): QoS class resolution,
+    # per-tenant quotas, and the heavy-job executor image/audio
+    # generation flows through. Created lazily by get_plane(state) so
+    # embedding an ApiState costs no threads until the first job
+    plane: Any = None
     # graceful-shutdown drain (SIGTERM/SIGINT): while True, new chat
     # requests on kept-alive connections answer 503 + Retry-After and
     # active generations run to completion (up to CAKE_DRAIN_TIMEOUT_S)
@@ -95,6 +100,29 @@ async def run_generation_blocking(model, messages_or_ids, gen_kwargs: dict):
 
 class GenerationCancelled(Exception):
     """Raised inside the generation worker to abort a cancelled stream."""
+
+
+async def await_job(job):
+    """Await a GenerationJob's terminal state without parking an
+    executor thread (the done-callback → future idiom the engine chat
+    path uses). A cancelled handler (client disconnect) cancels the
+    job so its step loop unwinds at the next checkpoint instead of
+    finishing work nobody reads."""
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+
+    def _on_done():
+        try:
+            loop.call_soon_threadsafe(
+                lambda: None if fut.done() else fut.set_result(None))
+        except RuntimeError:
+            pass                        # loop already closed
+    job.add_done_callback(_on_done)
+    try:
+        await fut
+    except asyncio.CancelledError:
+        job.cancel()                    # client gone: stop the steps
+        raise
 
 
 def run_generation_streamed(model, messages_or_ids, gen_kwargs: dict):

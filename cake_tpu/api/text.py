@@ -24,8 +24,8 @@ from ..ops.sampling import SamplingConfig
 from ..serve import (EngineDown, EngineDraining, PoisonedRequest,
                      QueueDeadlineExceeded, QueueFull,
                      RequestDeadlineExceeded)
-from .state import (ApiState, run_blocking, run_generation_blocking,
-                    run_generation_streamed)
+from .state import (ApiState, await_job, run_blocking,
+                    run_generation_blocking, run_generation_streamed)
 
 
 TOP_K_CHOICES = (1, 5, 10, 20, 40, 64, 100, 200)
@@ -257,14 +257,28 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     except (TypeError, ValueError) as e:
         return web.json_response({"error": f"invalid sampling params: {e}"},
                                  status=400)
-    if state.engine is not None:
-        return await _chat_engine(request, state, messages, gen_kwargs,
-                                  stream=bool(body.get("stream")),
-                                  stops=stops)
-    if body.get("stream"):
-        return await _chat_stream(request, state, messages, gen_kwargs,
-                                  stops)
-    return await _chat_blocking(request, state, messages, gen_kwargs, stops)
+    # unified admission plane: QoS class (chat defaults interactive;
+    # X-Cake-QoS / body "qos" override, tenant ceiling clamp) + tenant
+    # token-bucket/inflight quota, charged BEFORE any queue slot. The
+    # inflight lease spans the whole handler — streamed responses hold
+    # it until their final chunk — released in the finally
+    from .qos import resolve_admission
+    resolved = resolve_admission(state, request, body, "interactive")
+    if isinstance(resolved, web.Response):
+        return resolved
+    qos, tenant, release = resolved
+    try:
+        if state.engine is not None:
+            return await _chat_engine(request, state, messages, gen_kwargs,
+                                      stream=bool(body.get("stream")),
+                                      stops=stops, qos=qos, tenant=tenant)
+        if body.get("stream"):
+            return await _chat_stream(request, state, messages, gen_kwargs,
+                                      stops)
+        return await _chat_blocking(request, state, messages, gen_kwargs,
+                                    stops)
+    finally:
+        release()
 
 
 def _prompt_token_count(state: ApiState, messages) -> int:
@@ -397,7 +411,9 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
 
 
 async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
-                       stream: bool, stops: list[str] | None = None):
+                       stream: bool, stops: list[str] | None = None,
+                       qos: str = "interactive",
+                       tenant: str | None = None):
     """Submit to the serve engine: concurrent decode, bounded queue."""
     from ..models.common.text_model import chat_prompt_ids
     cid = _completion_id()
@@ -413,13 +429,14 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         req = state.engine.submit(prompt_ids,
                                   max_new_tokens=gen_kwargs["max_new_tokens"],
                                   sampling=gen_kwargs["sampling"],
-                                  request_id=rid)
+                                  request_id=rid, qos=qos, tenant=tenant)
     except QueueFull as e:
         # backpressure is a first-class answer: shed load instead of
-        # queueing unboundedly behind a bounded slot pool
-        return web.json_response(
-            {"error": "server overloaded: admission queue full"},
-            status=429, headers={"Retry-After": str(e.retry_after_s)})
+        # queueing unboundedly behind a bounded slot pool. The 429 is
+        # class-aware: Retry-After reflects THIS class's backlog over
+        # its weighted-fair service share
+        from .qos import admission_refusal
+        return admission_refusal(e)
     except EngineDraining as e:
         return web.json_response(
             {"error": str(e)}, status=503,
@@ -455,12 +472,6 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         aiter, result = state.engine.stream(req)
         return await _sse_drain(request, state, cid, aiter, result,
                                 req.cancel, stops)
-    # await completion via a done callback -> future: no executor thread
-    # is parked per in-flight request (the default executor also serves
-    # tokenization and every other endpoint — parking one thread per
-    # generation would starve the server at exactly this concurrency)
-    loop = asyncio.get_running_loop()
-    fut: asyncio.Future = loop.create_future()
     if stops:
         # early termination: watch the token stream from the scheduler
         # thread and cancel at the first completed stop match, so a
@@ -478,18 +489,10 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         for backlog_item in req.subscribe(_watch):
             _watch(backlog_item)
 
-    def _on_done():
-        try:
-            loop.call_soon_threadsafe(
-                lambda: None if fut.done() else fut.set_result(None))
-        except RuntimeError:
-            pass                            # loop already closed
-    req.add_done_callback(_on_done)
-    try:
-        await fut
-    except asyncio.CancelledError:
-        req.cancel()                        # client gone: free the slot
-        raise
+    # await completion via the shared done-callback -> future helper
+    # (no executor thread parked per in-flight request; a cancelled
+    # handler — client gone — cancels the request and frees the slot)
+    await await_job(req)
     if "error" in req.result:
         err = req.result["error"]
         GENERATIONS.inc(kind="text", status="error")

@@ -215,6 +215,15 @@ def cmd_serve(args) -> int:
         print(f"[serve engine: {state.engine.slots} slots x "
               f"{state.engine.ctx} ctx, queue {state.engine.queue.maxsize}]",
               file=sys.stderr)
+    # unified admission plane: QoS classes + tenant quotas for every
+    # endpoint, heavy-job executor for images/audio (worker threads
+    # start on the first job). Created eagerly so /health carries the
+    # admission block from boot and SIGTERM drain covers job lanes.
+    from .serve.admission import get_plane
+    plane = get_plane(state)
+    print(f"[admission plane: {plane.jobs.workers} job worker(s), "
+          f"tenants={'on' if plane.tenants.policies else 'open'}]",
+          file=sys.stderr)
     advertiser = None
     if args.announce:
         # announce this replica over the cluster discovery/PSK plumbing

@@ -53,6 +53,16 @@ __all__ = ["FleetRouter", "create_router_app", "serve_router"]
 # InjectedFleetFault subclasses ConnectionError, so drills ride this too.
 _TRANSPORT_ERRORS = (ConnectionError, asyncio.TimeoutError, OSError)
 
+# QoS plumbing, mirrored from serve/admission/classes.py by NAME ONLY:
+# importing the serve package would pull jax into the router process,
+# and the router tier deliberately stays model-free / import-light. The
+# replica is the authority — it re-resolves and clamps the class; the
+# router only needs "is this batch" for early shedding and forwards the
+# headers verbatim.
+QOS_HEADER = "X-Cake-QoS"
+TENANT_HEADER = "X-Cake-Tenant"
+_QOS_CLASSES = ("interactive", "standard", "batch")
+
 
 def _transport_errors():
     """aiohttp's client errors join the transport set lazily (the module
@@ -268,7 +278,8 @@ class FleetRouter:
 
     # -- one outbound attempt ------------------------------------------------
 
-    async def _one_json(self, rep, body: dict, rid: str | None = None):
+    async def _one_json(self, rep, body: dict, rid: str | None = None,
+                        fwd: dict | None = None):
         """One non-streamed attempt against `rep`. Returns
         ("skip", None)       — replica at cap / not acquirable,
         ("retryable", str)   — transport failure, replica 5xx or 429,
@@ -291,7 +302,7 @@ class FleetRouter:
             async with self.session.post(
                     rep.base_url + "/v1/chat/completions",
                     json=body, timeout=tmo,
-                    headers=self._trace_headers(rid)) as r:
+                    headers=self._trace_headers(rid, fwd)) as r:
                 ttfb_ms = (now() - t0) * 1e3
                 data = await r.read()
                 if r.status in (500, 502, 503):
@@ -332,12 +343,33 @@ class FleetRouter:
             rep.release(lease)
 
     @staticmethod
-    def _trace_headers(rid: str | None) -> dict:
-        """The trace-propagation header for one outbound attempt: the
-        replica adopts the id into its request-id contextvar and its
-        serve engine keys timeline events by it, so the router's
-        /api/v1/requests/<id> can stitch both tiers."""
-        return {TRACE_HEADER: rid} if rid else {}
+    def _trace_headers(rid: str | None,
+                       fwd: dict | None = None) -> dict:
+        """Headers for one outbound attempt: the trace id (the replica
+        adopts it into its request-id contextvar and its serve engine
+        keys timeline events by it, so the router's
+        /api/v1/requests/<id> can stitch both tiers) plus the
+        passthrough admission headers captured in handle_chat —
+        X-Cake-QoS / X-Cake-Tenant / Authorization — so the replica's
+        admission plane sees the same class and tenant the router shed
+        against."""
+        out = dict(fwd) if fwd else {}
+        if rid:
+            out[TRACE_HEADER] = rid
+        return out
+
+    @staticmethod
+    def _fwd_headers(request: web.Request) -> dict:
+        """The admission headers a chat request carries through the
+        router verbatim (class override, tenant key, auth credential —
+        the replica re-resolves and clamps; the router never rewrites
+        them)."""
+        out = {}
+        for h in (QOS_HEADER, TENANT_HEADER, "Authorization"):
+            v = request.headers.get(h)
+            if v:
+                out[h] = v
+        return out
 
     # -- request paths -------------------------------------------------------
 
@@ -363,28 +395,48 @@ class FleetRouter:
         rid = request.headers.get(TRACE_HEADER) \
             or "trace-" + uuid.uuid4().hex[:16]
         self.timelines.begin(rid, tier="router")
-        # router-level admission: shed BEFORE any replica queues it
-        if self.inflight >= self._global_cap():
+        # the admission class travels with the request (header or body
+        # field); the REPLICA's plane is the authority that validates
+        # and tenant-clamps it — the router only sheds early on it
+        qos = str(request.headers.get(QOS_HEADER)
+                  or body.get("qos") or "interactive").strip().lower()
+        if qos not in _QOS_CLASSES:
+            qos = "interactive"         # replica answers the 400
+        fwd = self._fwd_headers(request)
+        # router-level admission: shed BEFORE any replica queues it.
+        # Batch sheds FIRST — at CAKE_QOS_BATCH_SHED_FRAC of the global
+        # cap — so under pressure the remaining in-flight headroom stays
+        # reserved for interactive traffic (batch clients hold their
+        # Retry-After; chat keeps flowing)
+        cap = self._global_cap()
+        if self.inflight >= cap:
             return self._shed("global admission bound", rid)
+        frac = knobs.get("CAKE_QOS_BATCH_SHED_FRAC")
+        if qos == "batch" and frac < 1.0 \
+                and self.inflight >= max(1, int(cap * frac)):
+            return self._shed("batch_pressure", rid)
         order = self._order(messages)
         if not any(r.routable() for r in order):
             return self._no_replica(rid)
         self.timelines.event(rid, "route", candidates=[r.name for r in order],
-                        stream=bool(body.get("stream")))
+                        stream=bool(body.get("stream")), qos=qos)
         self.inflight += 1
         try:
             if body.get("stream"):
-                return await self._route_stream(request, body, order, rid)
+                return await self._route_stream(request, body, order, rid,
+                                                fwd=fwd)
             if self.hedge_ms > 0:
-                return await self._route_json_hedged(body, order, rid)
+                return await self._route_json_hedged(body, order, rid,
+                                                     fwd=fwd)
             return await self._route_json(body, order, 1 + self.retries,
-                                          rid=rid)
+                                          rid=rid, fwd=fwd)
         finally:
             self.inflight -= 1
 
     async def _route_json(self, body: dict, order: list, budget: int,
                           prior_attempts: int = 0,
-                          rid: str | None = None) -> web.Response:
+                          rid: str | None = None,
+                          fwd: dict | None = None) -> web.Response:
         """Sequential failover over `order` under an attempt budget.
         `prior_attempts`: attempts already spent by a caller (the hedged
         path) — they count against the budget and keep the exhausted-503
@@ -397,7 +449,7 @@ class FleetRouter:
                 break
             if not rep.routable():
                 continue
-            kind, val = await self._one_json(rep, body, rid)
+            kind, val = await self._one_json(rep, body, rid, fwd)
             if kind == "skip":
                 cap_skipped = True
                 continue
@@ -430,7 +482,8 @@ class FleetRouter:
             headers={"Retry-After": str(self._retry_after())})
 
     async def _route_json_hedged(self, body: dict, order: list,
-                                 rid: str | None = None) -> web.Response:
+                                 rid: str | None = None,
+                                 fwd: dict | None = None) -> web.Response:
         """Tail-hedged non-streamed path: if the owner has not answered
         within CAKE_FLEET_HEDGE_MS, fire a duplicate at the next-best
         replica and take whichever finishes first (the loser is
@@ -441,8 +494,9 @@ class FleetRouter:
         reps = [r for r in order if r.routable()]
         if len(reps) < 2:
             return await self._route_json(body, order, 1 + self.retries,
-                                          rid=rid)
-        primary = asyncio.create_task(self._one_json(reps[0], body, rid))
+                                          rid=rid, fwd=fwd)
+        primary = asyncio.create_task(
+            self._one_json(reps[0], body, rid, fwd))
         done, _ = await asyncio.wait({primary},
                                      timeout=self.hedge_ms / 1e3)
         tasks = {primary}
@@ -452,7 +506,7 @@ class FleetRouter:
             if rid:
                 self.timelines.event(rid, "hedge", replica=reps[1].name)
             tasks.add(asyncio.create_task(
-                self._one_json(reps[1], body, rid)))
+                self._one_json(reps[1], body, rid, fwd)))
             tried = 2
         pending = tasks
         non_final = 0
@@ -488,11 +542,12 @@ class FleetRouter:
             if rid:
                 self.timelines.event(rid, "retry")
         return await self._route_json(body, rest, 1 + self.retries,
-                                      prior_attempts=non_final, rid=rid)
+                                      prior_attempts=non_final, rid=rid,
+                                      fwd=fwd)
 
     async def _route_stream(self, request: web.Request, body: dict,
-                            order: list,
-                            rid: str | None = None) -> web.StreamResponse:
+                            order: list, rid: str | None = None,
+                            fwd: dict | None = None) -> web.StreamResponse:
         """SSE relay with pre-commit failover: attempts rotate replicas
         until one starts streaming; once the first byte has been
         relayed the request is COMMITTED to that replica, and a break
@@ -513,7 +568,7 @@ class FleetRouter:
             committed = False
             try:
                 resp, retryable = await self._relay_stream(
-                    request, rep, body, lease, rid)
+                    request, rep, body, lease, rid, fwd)
                 committed = resp is not None
                 if committed:
                     if rid:
@@ -542,7 +597,8 @@ class FleetRouter:
             headers={"Retry-After": str(self._retry_after())})
 
     async def _relay_stream(self, request, rep, body,
-                            lease: str = "slot", rid: str | None = None):
+                            lease: str = "slot", rid: str | None = None,
+                            fwd: dict | None = None):
         """One streamed attempt. Returns (response, retryable):
         response None = nothing was relayed, caller may retry
         elsewhere; a non-None response is terminal (clean EOF or typed
@@ -561,7 +617,7 @@ class FleetRouter:
             async with self.session.post(
                     rep.base_url + "/v1/chat/completions",
                     json=body, timeout=tmo,
-                    headers=self._trace_headers(rid)) as r:
+                    headers=self._trace_headers(rid, fwd)) as r:
                 if r.status != 200:
                     data = await r.read()
                     if r.status in (500, 502, 503):

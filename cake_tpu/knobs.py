@@ -127,6 +127,36 @@ _knob("CAKE_SERVE_FAULT_PLAN", str, None, "serve",
       'deterministic serve-engine fault injection (tests/drills only), '
       'e.g. "raise_on_step=6;kind=device" — see serve/faults.py')
 
+# -- qos (unified admission plane) ----------------------------------------
+_knob("CAKE_QOS_WEIGHTS", str, None, "qos",
+      'weighted-fair dequeue weights per QoS class, e.g. '
+      '"interactive=8,standard=4,batch=1" (the default); weights must '
+      "be > 0 — under saturation service converges to the weight ratio "
+      "and every class still progresses")
+_knob("CAKE_QOS_BOUNDS", str, None, "qos",
+      'per-class admission-queue bounds overriding the engine default, '
+      'e.g. "batch=128,interactive=32"; overflow answers a class-aware '
+      "429 whose Retry-After reflects that class's backlog")
+_knob("CAKE_QOS_TENANTS", str, None, "qos",
+      'per-tenant quota policies, e.g. "acme:rps=5,burst=10,inflight=4,'
+      'max_class=standard;*:rps=20" — token-bucket rate + concurrent '
+      "inflight + QoS ceiling, keyed by X-Cake-Tenant or the bearer "
+      "key; unconfigured tenants are default-open (typed 429 "
+      "tenant_quota when over)")
+_knob("CAKE_JOB_WORKERS", int, 1, "qos",
+      "max concurrently RUNNING heavy generation jobs (image "
+      "diffusion / TTS) under the admission plane; queued jobs drain "
+      "weighted-fair behind interactive traffic")
+_knob("CAKE_IMAGE_MAX_SIZE", int, 2048, "qos",
+      "max image width/height the /v1/images endpoints accept; "
+      "out-of-range sizes answer 400 instead of letting one request "
+      "OOM the device")
+_knob("CAKE_QOS_BATCH_SHED_FRAC", float, 0.8, "qos",
+      "router-tier batch shedding threshold as a fraction of the "
+      "global in-flight cap: batch-class requests shed 429 at this "
+      "fill level so the remaining headroom stays reserved for "
+      "interactive traffic; >= 1 disables the early shed")
+
 # -- speculative decoding -------------------------------------------------
 _knob("CAKE_SPEC", str, None, "spec",
       'drafter for spec=None paths: "ngram" enables prompt-lookup '
@@ -263,6 +293,7 @@ _knob("CAKE_TPU_CACHE", str, "~/.cache/cake-tpu", "paths",
 
 _AREA_TITLES = (
     ("serve", "Serving (continuous-batching engine)"),
+    ("qos", "QoS (unified admission plane)"),
     ("spec", "Speculative decoding"),
     ("fleet", "Fleet (router tier over N serve replicas)"),
     ("cluster", "Cluster (distributed pipeline + fault tolerance)"),

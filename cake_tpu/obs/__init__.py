@@ -211,6 +211,50 @@ SERVE_PREEMPTIONS = REGISTRY.counter(
     "Slots evicted because the paged KV pool was exhausted",
     labelnames=("mode",))           # swap | recompute
 
+# -- unified admission plane (QoS classes / tenants / jobs) ------------------
+# The class-aware queue publishes per-class depth SUMMED across every
+# live queue (engine request queue + job queue), so one scrape sees the
+# whole plane's backlog; the SLO pair decomposes latency by class —
+# the qos-smoke gate ("interactive TTFT under batch saturation") reads
+# these.
+
+SERVE_QOS_QUEUE_DEPTH = REGISTRY.gauge(
+    "cake_serve_qos_queue_depth",
+    "Queued requests + jobs per QoS class, summed across the admission "
+    "plane's queues (chat, image, audio)",
+    labelnames=("qos",))            # interactive | standard | batch
+
+SERVE_QOS_TTFT_SECONDS = REGISTRY.histogram(
+    "cake_serve_qos_ttft_seconds",
+    "Serve-engine time to first token by QoS class and outcome — the "
+    "per-class SLO the weighted-fair dequeue exists to protect",
+    labelnames=("qos", "outcome"))
+
+SERVE_QOS_E2E_SECONDS = REGISTRY.histogram(
+    "cake_serve_qos_e2e_seconds",
+    "End-to-end latency by QoS class and outcome, observed for engine "
+    "requests AND heavy generation jobs (image/TTS)",
+    labelnames=("qos", "outcome"))
+
+SERVE_QOS_SHEDS = REGISTRY.counter(
+    "cake_serve_qos_sheds_total",
+    "Requests/jobs answered a class-aware 429 because their QoS "
+    "class's queue lane was at its bound",
+    labelnames=("qos",))
+
+SERVE_TENANT_THROTTLES = REGISTRY.counter(
+    "cake_serve_tenant_throttled_total",
+    "Requests/jobs refused 429 tenant_quota before any queue slot was "
+    "consumed (only configured tenants can throttle, so cardinality is "
+    "operator-bounded)",
+    labelnames=("tenant", "reason"))    # rate | inflight
+
+SERVE_JOBS_RUNNING = REGISTRY.gauge(
+    "cake_serve_jobs_running",
+    "Heavy generation jobs (image diffusion / TTS) currently executing "
+    "under the admission plane's CAKE_JOB_WORKERS bound",
+    labelnames=("kind",))           # image | audio
+
 FLEET_REPLICAS = REGISTRY.gauge(
     "cake_fleet_replicas",
     "Registered replicas by membership state — the primary autoscaling "
@@ -241,7 +285,8 @@ FLEET_REPLICA_INFLIGHT = REGISTRY.gauge(
 FLEET_SHEDS = REGISTRY.counter(
     "cake_fleet_sheds_total",
     "Requests shed 429 AT THE ROUTER before any replica admitted them",
-    labelnames=("reason",))         # global | replica_cap | no_replica
+    labelnames=("reason",))         # global | replica_cap | no_replica |
+                                    # batch_pressure (QoS early shed)
 
 FLEET_EJECTS = REGISTRY.counter(
     "cake_fleet_ejects_total",
@@ -318,6 +363,9 @@ __all__ = [
     "SERVE_PREFIX_MISSES", "SERVE_PREFIX_EVICTIONS", "SERVE_PREFIX_BYTES",
     "SERVE_QUEUE_TIMEOUTS", "SERVE_STEP_FAILURES", "SERVE_ENGINE_REBUILDS",
     "SERVE_ENGINE_WEDGES", "SERVE_ENGINE_DOWN", "SERVE_POISONED",
+    "SERVE_QOS_QUEUE_DEPTH", "SERVE_QOS_TTFT_SECONDS",
+    "SERVE_QOS_E2E_SECONDS", "SERVE_QOS_SHEDS", "SERVE_TENANT_THROTTLES",
+    "SERVE_JOBS_RUNNING",
     "SERVE_REQUEST_TIMEOUTS", "SERVE_KV_BLOCKS_FREE",
     "SERVE_KV_BLOCKS_USED", "SERVE_KV_BLOCKS_SHARED", "SERVE_PREEMPTIONS",
     "CLUSTER_STAGE_FAILURES", "CLUSTER_RECONNECTS",

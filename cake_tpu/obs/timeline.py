@@ -55,11 +55,14 @@ MAX_EVENTS = 512
 # that records it.
 EVENT_KINDS: dict[str, str] = {
     # replica API tier
-    "received": "chat request reached the replica API handler",
-    # serve engine tier (scheduler thread)
-    "enqueue": "request entered the admission queue (`depth` behind it)",
-    "admit": "slot assigned; chunked prefill opens (`slot`, "
-             "`queue_wait_ms`)",
+    "received": "request reached the replica API handler (chat, image, "
+                "or audio)",
+    # admission plane + serve engine tier
+    "enqueue": "request/job entered the admission queue (`depth` behind "
+               "it, `qos` class, `tenant`/`workload` when set)",
+    "admit": "slot assigned (chunked prefill opens: `slot`, "
+             "`queue_wait_ms`) or heavy job started (`workload`); "
+             "carries `qos`",
     "prefix_hit": "prefix-cache splice skipped `tokens` prompt tokens",
     "prefill_chunk": "one chunk scattered into the pool row (`pos0`, "
                      "`tokens`)",

@@ -2,7 +2,9 @@
 bounded admission, chunked prefill, shared-prefix KV reuse, and crash-only
 supervision (typed step failures, rebuild-by-replay, poison quarantine,
 wedge watchdog) — see docs/serving.md and docs/fault_tolerance.md."""
-from .admission import AdmissionQueue, QueueFull
+from .admission import (AdmissionPlane, AdmissionQueue, GenerationJob,
+                        JobCancelled, JobExecutor, JobsDraining, QueueFull,
+                        TenantQuotaExceeded, TenantRegistry)
 from .engine import (EngineDraining, QueueDeadlineExceeded, ServeEngine,
                      ServeRequest, maybe_engine)
 from .paged import BlockAllocator, KVPoolExhausted, PagedKV
@@ -11,7 +13,10 @@ from .slots import SlotPool
 from .supervisor import (EngineDown, PoisonedRequest,
                          RequestDeadlineExceeded, StepFailure, Supervisor)
 
-__all__ = ["AdmissionQueue", "QueueFull", "EngineDraining",
+__all__ = ["AdmissionPlane", "AdmissionQueue", "GenerationJob",
+           "JobCancelled", "JobExecutor", "JobsDraining",
+           "TenantQuotaExceeded", "TenantRegistry",
+           "QueueFull", "EngineDraining",
            "QueueDeadlineExceeded", "EngineDown", "KVPoolExhausted",
            "PoisonedRequest", "RequestDeadlineExceeded", "StepFailure",
            "Supervisor", "BlockAllocator", "PagedKV", "PagedPrefixCache",

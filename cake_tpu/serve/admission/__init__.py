@@ -1,0 +1,28 @@
+"""Unified admission plane: weighted QoS classes, per-tenant quotas,
+and heavy (image/TTS) generation jobs through one scheduler surface.
+
+Grown from PR 2's single bounded FIFO (serve/admission.py) into a
+package: every generation endpoint — chat through the serve engine,
+images and audio through the JobExecutor — is admitted under a QoS
+class with weighted-fair dequeue, per-tenant token-bucket quotas
+answered with typed 429s before any queue slot is consumed, shared
+queue-depth/SLO instruments, shared timeline events, and one drain
+switch. See docs/qos.md.
+"""
+from .classes import (QOS_CLASSES, QOS_HEADER, TENANT_HEADER, class_bounds,
+                      class_of, class_weights, clamp_class, priority,
+                      resolve_class, retry_after_for)
+from .jobs import GenerationJob, JobCancelled, JobExecutor, JobsDraining
+from .plane import AdmissionPlane, get_plane
+from .queue import AdmissionQueue, QueueFull
+from .tenants import (TenantPolicy, TenantQuotaExceeded, TenantRegistry,
+                      parse_policies)
+
+__all__ = [
+    "AdmissionPlane", "AdmissionQueue", "GenerationJob", "JobCancelled",
+    "JobExecutor", "JobsDraining", "QOS_CLASSES", "QOS_HEADER",
+    "QueueFull", "TENANT_HEADER", "TenantPolicy", "TenantQuotaExceeded",
+    "TenantRegistry", "class_bounds", "class_of", "class_weights",
+    "clamp_class", "get_plane", "parse_policies", "priority",
+    "resolve_class", "retry_after_for",
+]

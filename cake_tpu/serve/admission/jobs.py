@@ -1,0 +1,273 @@
+"""GenerationJob + JobExecutor: non-slot workloads through the plane.
+
+Image diffusion and TTS don't decode through KV slots, so the serve
+engine can't batch them — but before this module they also bypassed the
+admission queue entirely (the pre-PR-2 one-request lock), invisible to
+backpressure, drain, tracing, and the queue-depth gauges. A
+GenerationJob wraps one such workload so it flows through the SAME
+class-aware weighted-fair queue as chat (its depth counts into
+cake_serve_queue_depth / cake_serve_qos_queue_depth), emits the SAME
+timeline events (enqueue/admit/finish with class + tenant attrs, so
+``GET /api/v1/requests/<id>`` shows an image job's lifecycle), and
+respects drain (new jobs are refused typed while running ones finish).
+
+The executor keeps at most CAKE_JOB_WORKERS (default 1) heavy jobs
+running. Job functions receive the job and are expected to call
+``job.checkpoint()`` between diffusion steps / TTS frames: the
+checkpoint raises JobCancelled when the client vanished (the 20-step
+FLUX job stops at the next step instead of finishing for nobody) and
+briefly yields the thread while interactive requests are queued
+anywhere on the plane — so a newly-enqueued chat request is never stuck
+behind a long diffusion step loop that hasn't looked up from the
+device.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+
+from ... import knobs
+from ...obs import (SERVE_JOBS_RUNNING, SERVE_QOS_E2E_SECONDS,
+                    SERVE_QOS_QUEUE_DEPTH, TIMELINES, now, set_request_id)
+from .queue import AdmissionQueue
+
+__all__ = ["GenerationJob", "JobCancelled", "JobExecutor",
+           "JobsDraining"]
+
+log = logging.getLogger("cake_tpu.serve.admission")
+
+# seconds a checkpoint yields when interactive work is queued: long
+# enough for the engine scheduler thread to win the GIL and dispatch,
+# short enough to cost a 20-step job at most ~40ms per pass
+_YIELD_S = 0.002
+
+
+class JobCancelled(Exception):
+    """Raised inside job.checkpoint() when the client abandoned the job
+    — the step loop unwinds instead of finishing work nobody reads."""
+
+
+class JobsDraining(RuntimeError):
+    """Job admission refused because the plane is draining for
+    shutdown; running jobs finish, new ones answer 503 + Retry-After."""
+
+    def __init__(self, retry_after_s: int = 5):
+        super().__init__("admission plane draining for shutdown")
+        self.retry_after_s = retry_after_s
+
+
+class GenerationJob:
+    """One queued heavy workload (image diffusion, TTS). Mirrors the
+    ServeRequest surface the queue, the timelines, and the API waiters
+    need: id / qos / tenant / t_enqueue / cancelled / admitted / done /
+    result."""
+
+    def __init__(self, kind: str, fn, qos: str = "batch",
+                 tenant: str | None = None,
+                 request_id: str | None = None):
+        self.id = request_id or f"{kind}-" + uuid.uuid4().hex[:16]
+        self.kind = kind                # "image" | "audio" | ...
+        self.fn = fn                    # fn(job) -> result value
+        self.qos = qos
+        self.tenant = tenant
+        self.t_enqueue = now()
+        self.cancelled = threading.Event()
+        self.admitted = threading.Event()
+        self.done = threading.Event()
+        self.result: dict = {}          # "value" | "error"
+        self._done_cbs: list = []
+        self._cb_lock = threading.Lock()
+
+    # -- client surface ------------------------------------------------------
+
+    def cancel(self):
+        self.cancelled.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.done.wait(timeout)
+
+    def add_done_callback(self, cb):
+        """cb fires (worker thread) at the terminal transition; fires
+        immediately (caller thread) if the job already finished."""
+        with self._cb_lock:
+            if not self.done.is_set():
+                self._done_cbs.append(cb)
+                return
+        cb()
+
+    # -- job-function surface ------------------------------------------------
+
+    def checkpoint(self):
+        """Call between diffusion steps / TTS frames: aborts a
+        cancelled job, and yields the thread while interactive traffic
+        is queued anywhere on the plane (the engine's queue and the job
+        queue publish into the same per-class gauge) so chat admission
+        is never starved by a step loop."""
+        if self.cancelled.is_set():
+            raise JobCancelled(f"job {self.id} cancelled")
+        if SERVE_QOS_QUEUE_DEPTH.value(qos="interactive") > 0:
+            time.sleep(_YIELD_S)
+
+    # -- executor internals --------------------------------------------------
+
+    def _finish(self, value=None, error: BaseException | None = None):
+        if error is not None:
+            self.result["error"] = error
+        else:
+            self.result["value"] = value
+        with self._cb_lock:
+            self.done.set()
+            cbs, self._done_cbs = self._done_cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                pass                    # waiter's loop may be gone
+
+
+class JobExecutor:
+    """At most `workers` (CAKE_JOB_WORKERS) heavy jobs running at once,
+    fed weighted-fair from a class-aware AdmissionQueue. Worker threads
+    start lazily on the first submit so embedding an ApiState in a unit
+    test costs no threads."""
+
+    def __init__(self, workers: int | None = None,
+                 max_queue: int | None = None):
+        if workers is None:
+            workers = knobs.get("CAKE_JOB_WORKERS")
+        self.workers = max(int(workers), 1)
+        if max_queue is None:
+            max_queue = knobs.get("CAKE_MAX_QUEUE")
+        self.queue = AdmissionQueue(max_queue)
+        self.running = 0                # guarded-by: self._lock
+        self._running_by_kind = {}      # guarded-by: self._lock
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- submit / lifecycle --------------------------------------------------
+
+    def submit(self, job: GenerationJob) -> GenerationJob:
+        """Enqueue a job. Raises JobsDraining during drain (running
+        jobs finish; new ones are refused typed) and QueueFull when the
+        job's class lane is at its bound."""
+        if self._draining.is_set() or self._stop.is_set():
+            raise JobsDraining()
+        self.queue.put(job)
+        # close() may have drained the queue and joined the workers
+        # between the check above and the put: re-check and reclaim, or
+        # the job would sit unexecuted forever with its waiter hung
+        # (close's own drain catches the put-before-stop ordering)
+        if self._stop.is_set() and self.queue.purge(lambda j: j is job):
+            raise JobsDraining()
+        TIMELINES.begin(job.id)
+        # attr named `workload`, not `kind` — event()'s positional
+        # parameter is `kind` (the supervisor hit the same collision)
+        TIMELINES.event(job.id, "enqueue", qos=job.qos, workload=job.kind,
+                        depth=self.queue.depth(),
+                        **({"tenant": job.tenant} if job.tenant else {}))
+        self._ensure_threads()
+        self._wake.set()
+        return job
+
+    def _ensure_threads(self):
+        with self._lock:
+            while len(self._threads) < self.workers:
+                t = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"cake-jobs-{len(self._threads)}")
+                self._threads.append(t)
+                t.start()
+
+    def begin_drain(self):
+        """Refuse new jobs immediately; running jobs keep going."""
+        self._draining.set()
+        self._wake.set()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Stop admission and wait for queued + running jobs to finish
+        (queued jobs still execute — they were accepted before the
+        drain; only NEW submissions are refused). True = went idle."""
+        self.begin_drain()
+        deadline = None if timeout is None else now() + timeout
+        while self.queue.depth() or self.running_count():
+            if deadline is not None and now() >= deadline:
+                return False
+            time.sleep(0.02)
+        return True
+
+    def running_count(self) -> int:
+        """Locked accessor for out-of-class readers (health views)."""
+        with self._lock:
+            return self.running
+
+    def close(self, timeout: float = 5.0):
+        self._stop.set()
+        self._wake.set()
+        for job in self.queue.drain():
+            job._finish(error=JobsDraining())
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    # -- worker loop ---------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            job = self.queue.pop()
+            if job is None:
+                self._wake.wait(0.2)
+                self._wake.clear()
+                continue
+            if job.cancelled.is_set():
+                job._finish(error=JobCancelled(
+                    f"job {job.id} cancelled while queued"))
+                continue
+            self._run_one(job)
+
+    def _run_one(self, job: GenerationJob):
+        with self._lock:
+            self.running += 1
+            self._running_by_kind[job.kind] = \
+                self._running_by_kind.get(job.kind, 0) + 1
+            kind_running = self._running_by_kind[job.kind]
+        # the gauge is per KIND: with >1 worker and mixed workloads the
+        # executor-wide count would set the wrong label (and leave a
+        # stale non-zero value behind the last finisher)
+        SERVE_JOBS_RUNNING.set(kind_running, kind=job.kind)
+        job.admitted.set()
+        wait_ms = round((now() - job.t_enqueue) * 1e3, 3)
+        TIMELINES.event(job.id, "admit", qos=job.qos, workload=job.kind,
+                        queue_wait_ms=wait_ms,
+                        **({"tenant": job.tenant} if job.tenant else {}))
+        set_request_id(job.id)          # spans inside attribute to the job
+        try:
+            value = job.fn(job)
+        except JobCancelled as e:
+            TIMELINES.event(job.id, "error", type="cancelled")
+            job._finish(error=e)
+        except BaseException as e:      # surfaced to the API waiter
+            TIMELINES.event(job.id, "error", type=type(e).__name__)
+            job._finish(error=e)
+        else:
+            TIMELINES.event(
+                job.id, "finish", outcome="ok", qos=job.qos,
+                e2e_ms=round((now() - job.t_enqueue) * 1e3, 3),
+                **({"tenant": job.tenant} if job.tenant else {}))
+            job._finish(value=value)
+        finally:
+            set_request_id(None)
+            with self._lock:
+                self.running -= 1
+                self._running_by_kind[job.kind] = max(
+                    self._running_by_kind.get(job.kind, 1) - 1, 0)
+                kind_running = self._running_by_kind[job.kind]
+            SERVE_JOBS_RUNNING.set(kind_running, kind=job.kind)
+            SERVE_QOS_E2E_SECONDS.observe(
+                now() - job.t_enqueue, exemplar=job.id, qos=job.qos,
+                outcome="ok" if "error" not in job.result else (
+                    "cancelled" if isinstance(job.result.get("error"),
+                                              JobCancelled) else "error"))

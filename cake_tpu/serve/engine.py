@@ -65,7 +65,8 @@ import numpy as np
 from .. import knobs
 from ..obs import (RECORDER, SERVE_BATCH_OCCUPANCY, SERVE_E2E_SECONDS,
                    SERVE_ITL_SECONDS, SERVE_PREFILL_CHUNKS, SERVE_POISONED,
-                   SERVE_PREEMPTIONS, SERVE_QUEUE_TIMEOUTS,
+                   SERVE_PREEMPTIONS, SERVE_QOS_E2E_SECONDS,
+                   SERVE_QOS_TTFT_SECONDS, SERVE_QUEUE_TIMEOUTS,
                    SERVE_QUEUE_WAIT_SECONDS, SERVE_REQUEST_TIMEOUTS,
                    SERVE_SLOTS_BUSY, SERVE_TTFT_SECONDS, TIMELINES, now,
                    set_request_id)
@@ -74,8 +75,10 @@ from ..spec import resolve_drafter
 from ..spec.verify import record_step
 from . import faults
 from .admission import AdmissionQueue, QueueFull
+from .admission.classes import class_of, priority
 from .flight import FlightRecorder
-from .paged import KVPoolExhausted, PagedKV, PreemptedSlot, choose_victim
+from .paged import (KVPoolExhausted, PagedKV, PreemptedSlot, choose_victim,
+                    victim_rank)
 from .prefix_cache import PagedPrefixCache, PrefixCache
 from .slots import SlotPool, slot_bucket
 from .supervisor import (EngineDown, PoisonedRequest,
@@ -164,11 +167,16 @@ class ServeRequest:
     DONE = object()
 
     def __init__(self, prompt_ids: list[int], max_new_tokens: int,
-                 sampling: SamplingConfig, request_id: str | None = None):
+                 sampling: SamplingConfig, request_id: str | None = None,
+                 qos: str = "interactive", tenant: str | None = None):
         self.id = request_id or "serve-" + uuid.uuid4().hex[:16]
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         self.sampling = sampling or SamplingConfig()
+        # QoS class (admission lane, weighted-fair share, preemption
+        # rank) + tenant (quota accounting / timeline attribution)
+        self.qos = qos
+        self.tenant = tenant
         self.out_q: queue_mod.Queue = queue_mod.Queue()
         self.cancelled = threading.Event()
         self.admitted = threading.Event()   # set when a slot is assigned
@@ -433,12 +441,16 @@ class ServeEngine:
 
     def submit(self, prompt_ids: list[int], max_new_tokens: int = 256,
                sampling: SamplingConfig | None = None,
-               request_id: str | None = None) -> ServeRequest:
-        """Enqueue a generation. Raises QueueFull under backpressure,
-        EngineDown while the engine is dead or in budget-exhausted
-        degraded mode (API: 503 + Retry-After), PoisonedRequest for
-        quarantined prompts, and ValueError for prompts the pool can
-        never hold."""
+               request_id: str | None = None, qos: str = "interactive",
+               tenant: str | None = None) -> ServeRequest:
+        """Enqueue a generation under QoS class `qos` (admission lane,
+        weighted-fair share, preemption rank — resolved and clamped by
+        the API's admission plane). Raises QueueFull under backpressure
+        (class-aware: the 429's Retry-After reflects that class's
+        backlog), EngineDown while the engine is dead or in
+        budget-exhausted degraded mode (API: 503 + Retry-After),
+        PoisonedRequest for quarantined prompts, and ValueError for
+        prompts the pool can never hold."""
         if self.dead is not None or not self._thread.is_alive():
             raise EngineDown(f"serve engine is down: {self.dead}",
                              retry_after_s=30)
@@ -472,13 +484,16 @@ class ServeEngine:
                 f"prompt needs {paged.blocks_for(n + 1)} KV blocks "
                 f"but the pool holds {paged.num_blocks} "
                 f"(CAKE_KV_BLOCKS x CAKE_KV_BLOCK_TOKENS tokens total)")
-        req = ServeRequest(prompt_ids, max_new_tokens, sampling, request_id)
+        req = ServeRequest(prompt_ids, max_new_tokens, sampling, request_id,
+                           qos=qos, tenant=tenant)
         req._engine = self
         # free slots extend the bound: a burst that fits the idle pool is
         # admitted even though the scheduler drains one per iteration
         self.queue.put(req, allow_extra=self.pool.free_count)
         TIMELINES.begin(req.id)
-        TIMELINES.event(req.id, "enqueue", depth=self.queue.depth())
+        TIMELINES.event(req.id, "enqueue", depth=self.queue.depth(),
+                        qos=req.qos,
+                        **({"tenant": req.tenant} if req.tenant else {}))
         self._wake.set()
         if self.dead is not None or self.supervisor.is_down():
             # the scheduler crashed (or went down) between the liveness
@@ -541,6 +556,7 @@ class ServeEngine:
             "slots": self.slots,
             "slots_busy": self.pool.busy_count,
             "queue_depth": self.queue.depth(),
+            "queue_by_class": self.queue.depths(),
             "ctx_len": self.ctx,
             "prefill_chunk": self.chunk,
             "prefilling": len(self._prefills),
@@ -1010,7 +1026,7 @@ class ServeEngine:
         req.slot = slot
         req.admitted.set()
         req.stats = {"queue_wait_s": now() - req.t_enqueue}
-        TIMELINES.event(req.id, "admit", slot=slot,
+        TIMELINES.event(req.id, "admit", slot=slot, qos=req.qos,
                         queue_wait_ms=round(
                             req.stats["queue_wait_s"] * 1e3, 3))
         self._begin_prefill(_Prefill(req, slot))
@@ -1193,21 +1209,33 @@ class ServeEngine:
         decoding slot, and requeues every other admission before giving
         up), so the prompt can never fit and parking would hang it."""
         take = min(self.chunk, pf.n - pf.pos)
-        if self._reserve_blocks(pf.slot, pf.pos, take):
+        got = self._reserve_blocks(pf.slot, pf.pos, take,
+                                   requester=pf.req)
+        if got == "self":
+            # every reclaimable block is held by HIGHER-class work:
+            # this admission parks itself (clean restart — nothing
+            # emitted) and retries when blocks free, instead of
+            # evicting an interactive slot to admit a batch prompt
+            self._requeue_admission(pf)
+            return None
+        if got:
             return pf
         self._abort_prefill(pf, KVPoolExhausted(
             f"KV pool exhausted admitting {pf.req.id}: the prompt needs "
             "more blocks than the pool can ever free"))
         return None
 
-    def _reserve_blocks(self, slot: int, pos0: int, n: int) -> bool:
+    def _reserve_blocks(self, slot: int, pos0: int, n: int,
+                        requester=None):
         """Back positions [pos0, pos0+n) of `slot` with physical blocks,
         evicting prefix-cache LRU (inside the allocator) and then
-        preempting victims until it fits. False = nothing left to
-        reclaim."""
+        preempting victims (QoS policy via _preempt_one) until it fits.
+        "self" = only higher-class work holds blocks, the caller must
+        park itself; False = nothing left to reclaim."""
         while not self.paged.reserve_range(slot, pos0, n):
-            if not self._preempt_one(exclude=slot):
-                return False
+            got = self._preempt_one(exclude=slot, requester=requester)
+            if got is not True:
+                return got
         return True
 
     def _ensure_decode_blocks(self, active: list[int],
@@ -1242,7 +1270,15 @@ class ServeEngine:
                     n_drafts[i] = 0
                     self._cur_nd[i] = 0
                     continue
-                if not self._preempt_one(exclude=i):
+                got = self._preempt_one(exclude=i, requester=req)
+                if got == "self":
+                    # the only reclaimable space is held by HIGHER-class
+                    # work: this slot parks itself (swap/recompute — it
+                    # resumes bit-identical when blocks free) instead of
+                    # kicking an interactive admission back to the queue
+                    self._preempt_slot(i, req)
+                    break
+                if not got:
                     req.result["error"] = KVPoolExhausted(
                         f"KV pool exhausted: request {req.id} cannot "
                         f"grow past {wp} tokens and nothing is left to "
@@ -1251,28 +1287,46 @@ class ServeEngine:
                     break
         return [i for i in active if self._reqs[i] is not None]
 
-    def _preempt_one(self, exclude: int) -> bool:
+    def _preempt_one(self, exclude: int, requester=None):
         """Free blocks by reclaiming the cheapest thing first: other
         slots' speculative frontier tails (pure rollback — nobody loses
-        work), then a DECODING victim (latest admission — the cheapest
-        to redo, and the oldest request can never be starved by
-        newcomers), else the youngest OTHER in-flight admission goes
-        back to readmission (it has emitted nothing, so a restart is
-        clean). False = nothing left to reclaim or preempt."""
+        work), then a DECODING victim (QoS policy: lowest class first,
+        LIFO within a class — the cheapest to redo, and the oldest
+        request in its class can never be starved by newcomers), else
+        an OTHER in-flight admission goes back to readmission (it has
+        emitted nothing, so a restart is clean; lowest class, youngest
+        first). When the only candidate admission outranks `requester`'s
+        class, returns "self": the caller's slot must park itself
+        rather than displace higher-class work (a batch decoder never
+        requeues an interactive admission). False = nothing left to
+        reclaim or preempt."""
         if self.spec_drafter is not None and self._trim_spec_tails(exclude):
             return True
+
+        def outranks(r):
+            return requester is not None and \
+                priority(class_of(r)) > priority(class_of(requester))
         prefilling = {p.slot for p in self._prefills}
         cands = [(i, self._reqs[i]) for i in self.pool.busy()
                  if i not in prefilling]
         victim = choose_victim(cands, exclude=exclude)
-        if victim is not None:
+        others = [p for p in self._prefills if p.slot != exclude]
+        pick = max(others, key=lambda p: victim_rank(p.req)) \
+            if others else None
+        # evict in policy order, but never displace strictly-higher-
+        # class work: a protected victim falls through to the admission
+        # check (a lower-class admission may still be requeued — the
+        # review caught the early "self" return inverting priority when
+        # e.g. a standard decode was blocked by interactive decodes
+        # while a batch prefill held reclaimable blocks)
+        if victim is not None and not outranks(victim[1]):
             self._preempt_slot(*victim)
             return True
-        others = [p for p in self._prefills if p.slot != exclude]
-        if others:
-            self._requeue_admission(
-                max(others, key=lambda p: p.req.t_enqueue))
+        if pick is not None and not outranks(pick.req):
+            self._requeue_admission(pick)
             return True
+        if victim is not None or pick is not None:
+            return "self"       # only higher-class work holds blocks
         return False
 
     def _preempt_slot(self, slot: int, req: ServeRequest):
@@ -1321,7 +1375,15 @@ class ServeEngine:
         SERVE_PREEMPTIONS.inc(mode="recompute")
         TIMELINES.event(pf.req.id, "preempt", mode="requeue",
                         tokens=pf.pos)
-        self._preempted.append(PreemptedSlot(pf.req, "recompute", 0))
+        # resume gate = the WHOLE prompt's blocks (submit already
+        # validated it fits an empty pool): gating on fewer would
+        # re-admit the prefill while higher-class work still holds the
+        # pool, and the "self" park path would bounce it back every
+        # scheduler iteration — preempt/resume churn in the counters,
+        # the timeline ring, and the log
+        self._preempted.append(
+            PreemptedSlot(pf.req, "recompute",
+                          max(len(pf.req.prompt_ids) - 1, 0)))
         SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         log.warning("readmitting request %s: KV pool exhausted "
                     "mid-prefill", pf.req.id)
@@ -1747,8 +1809,10 @@ class ServeEngine:
             self._observe_slo(req, outcome)
             TIMELINES.event(
                 req.id, "finish", outcome=outcome, tokens=len(req.tokens),
+                qos=req.qos,
                 ttft_ms=round(req.stats.get("ttft_s", 0.0) * 1e3, 3),
-                e2e_ms=round((now() - req.t_enqueue) * 1e3, 3))
+                e2e_ms=round((now() - req.t_enqueue) * 1e3, 3),
+                **({"tenant": req.tenant} if req.tenant else {}))
         SERVE_SLOTS_BUSY.set(self.pool.busy_count)
         req._deliver(ServeRequest.DONE)
         req._fire_done()
@@ -1760,9 +1824,15 @@ class ServeEngine:
         scrape links to a concrete /api/v1/requests/<id> timeline."""
         SERVE_E2E_SECONDS.observe(now() - req.t_enqueue, exemplar=req.id,
                                   outcome=outcome)
+        SERVE_QOS_E2E_SECONDS.observe(now() - req.t_enqueue,
+                                      exemplar=req.id, qos=req.qos,
+                                      outcome=outcome)
         if req.t_first:
             SERVE_TTFT_SECONDS.observe(req.t_first - req.t_enqueue,
                                        exemplar=req.id, outcome=outcome)
+            SERVE_QOS_TTFT_SECONDS.observe(req.t_first - req.t_enqueue,
+                                           exemplar=req.id, qos=req.qos,
+                                           outcome=outcome)
             ndec = max(len(req.tokens) - 1, 0)
             if ndec:
                 SERVE_ITL_SECONDS.observe(

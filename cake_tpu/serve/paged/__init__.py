@@ -5,7 +5,8 @@ blocks behind per-slot indirection tables (vLLM/PagedAttention). Enabled
 by CAKE_KV_BLOCKS > 0; see docs/serving.md#paged-kv-pool."""
 from .allocator import BlockAllocator
 from .pool import KVPoolExhausted, PagedKV, pow2_block_tokens
-from .preempt import PreemptedSlot, choose_victim
+from .preempt import PreemptedSlot, choose_victim, victim_rank
 
 __all__ = ["BlockAllocator", "KVPoolExhausted", "PagedKV",
-           "PreemptedSlot", "choose_victim", "pow2_block_tokens"]
+           "PreemptedSlot", "choose_victim", "pow2_block_tokens",
+           "victim_rank"]

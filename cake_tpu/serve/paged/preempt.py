@@ -13,17 +13,24 @@ to evict), a victim slot is evicted to make room:
     streams resume on a fresh rng fold (the documented rebuild
     exception).
 
-Victim choice is latest-admission-first (LIFO, the vLLM rule): the
-request that has consumed the least scheduler work is the cheapest to
-re-run, and the oldest request can never be starved by newcomers.
-Parked requests resume oldest-first, before any new admission, as soon
-as a slot and enough blocks are free.
+Victim choice is POLICY-DRIVEN by QoS class: among candidates, the
+LOWEST class goes first (batch before standard before interactive — an
+interactive admission under pool pressure evicts a batch image/chat
+slot, never the other way around while a batch victim exists), and
+WITHIN a class latest-admission-first (LIFO, the vLLM rule: the request
+that has consumed the least scheduler work is the cheapest to re-run,
+and the oldest request in its class can never be starved by
+newcomers). Single-class traffic therefore behaves exactly as before
+this policy existed. Parked requests resume oldest-first, before any
+new admission, as soon as a slot and enough blocks are free.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
 
-__all__ = ["PreemptedSlot", "choose_victim"]
+from ..admission.classes import class_of, priority
+
+__all__ = ["PreemptedSlot", "choose_victim", "victim_rank"]
 
 
 @dataclass
@@ -36,14 +43,22 @@ class PreemptedSlot:
                                       # req.sampling at resume)
 
 
+def victim_rank(req) -> tuple:
+    """Sort key under which the MAX element is the preferred victim:
+    lowest QoS class first (negated priority), latest admission within
+    a class (LIFO). Shared by slot victim choice and the mid-prefill
+    requeue pick so the two paths cannot rank classes differently."""
+    return (-priority(class_of(req)), getattr(req, "t_enqueue", 0.0))
+
+
 def choose_victim(candidates: list[tuple[int, object]],
                   exclude: int | None = None) -> tuple[int, object] | None:
     """(slot, req) to preempt from `candidates` [(slot, req)], or None.
-    Latest admission first; `exclude` protects the slot whose allocation
-    triggered the preemption (a slot cannot make room by evicting
-    itself)."""
+    Lowest class first, LIFO within a class; `exclude` protects the
+    slot whose allocation triggered the preemption (a slot cannot make
+    room by evicting itself)."""
     pool = [(s, r) for s, r in candidates
             if s != exclude and r is not None]
     if not pool:
         return None
-    return max(pool, key=lambda sr: sr[1].t_enqueue)
+    return max(pool, key=lambda sr: victim_rank(sr[1]))

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""QoS admission-plane smoke: interactive chat latency must survive
+batch-image saturation.
+
+Drives the REAL API app (aiohttp TestServer) over a tiny CPU TextModel
+engine plus a tiny diffusion STUB image model (N steps of real jnp
+dispatches with on_step checkpoints — the shape of a FLUX loop without
+its weights). Phases:
+
+  1. idle baseline — stream=True chats, client-observed TTFT p50;
+  2. saturation — a backlog of batch-class image jobs (default class
+     for /v1/images/generations) kept deep for the whole phase, with
+     interactive chats interleaved;
+  3. gates — chat TTFT p50 under saturation within 2x the idle
+     baseline (floored at 50 ms to absorb scheduler noise on this
+     shared CPU box), ZERO batch failures (every image job 200s), and
+     a non-zero class-labeled queue gauge scraped from /metrics while
+     saturated.
+
+Exits non-zero on any missed gate. Run via `make qos-smoke`.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                    # noqa: E402
+
+from cake_tpu.api import ApiState, create_app              # noqa: E402
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.obs import SERVE_QOS_QUEUE_DEPTH             # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+from cake_tpu.serve.admission import get_plane             # noqa: E402
+
+BASELINE_FLOOR_S = 0.05
+N_IDLE = 6
+N_SAT = 8
+N_JOBS = 6
+JOB_STEPS = 24
+
+
+class SmokeTok:
+    def encode(self, text):
+        return [3 + (sum(w.encode()) % 200) for w in text.split()][:48] or [3]
+
+    def decode(self, ids):
+        return "".join(f"<{i}>" for i in ids)
+
+
+class StubDiffusion:
+    """The SHAPE of a FLUX generation without its weights: JOB_STEPS
+    real device dispatches with an on_step callback after each — which
+    is where the admission plane's job.checkpoint() yield runs."""
+
+    def __init__(self):
+        self._w = jnp.ones((64, 64), jnp.float32)
+
+    def generate_image(self, prompt, width=64, height=64, steps=JOB_STEPS,
+                       on_step=None, **kw):
+        x = jnp.ones((64, 64), jnp.float32)
+        for i in range(steps):
+            x = jnp.tanh(x @ self._w * 1e-3)
+            x.block_until_ready()
+            time.sleep(0.003)           # a real step is not free
+            if on_step:
+                on_step(i + 1, steps)
+        from PIL import Image
+        return Image.new("RGB", (width, height), (int(abs(float(x[0, 0])))
+                                                  % 255, 64, 128))
+
+
+def _pctl(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+async def _ttft_stream(client, content: str) -> float:
+    """Client-observed TTFT: POST a streamed chat, stamp the first
+    content-bearing SSE chunk."""
+    t0 = time.monotonic()
+    async with client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": content}],
+            "max_tokens": 4, "temperature": 0.0, "stream": True}) as r:
+        assert r.status == 200, await r.text()
+        async for piece in r.content.iter_any():
+            for line in piece.split(b"\n"):
+                if not line.startswith(b"data: ") or b"[DONE]" in line:
+                    continue
+                chunk = json.loads(line[6:])
+                if chunk["choices"][0]["delta"].get("content"):
+                    ttft = time.monotonic() - t0
+                    await r.release()
+                    return ttft
+    raise AssertionError("stream produced no content chunk")
+
+
+async def main_async() -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=128)
+    model.tokenizer = SmokeTok()
+    engine = ServeEngine(model, slots=2, max_queue=16, ctx_len=128,
+                         prefill_chunk=16, prefix_cache_mb=0)
+    state = ApiState(model=model, tokenizer=model.tokenizer,
+                     model_id="qos-smoke", image_model=StubDiffusion())
+    state.engine = engine
+    get_plane(state)                    # job executor (1 worker)
+    app = create_app(state)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        # warm the decode path (first chat compiles the slot programs)
+        await _ttft_stream(client, "warmup request one")
+        await _ttft_stream(client, "warmup request two")
+
+        # -- phase 1: idle TTFT baseline
+        idle = [await _ttft_stream(client, f"idle probe {i}")
+                for i in range(N_IDLE)]
+        idle_p50 = _pctl(idle, 0.5)
+
+        # -- phase 2: batch-image saturation + interleaved chat
+        jobs = [asyncio.ensure_future(client.post(
+            "/v1/images/generations",
+            json={"prompt": f"cake {i}", "size": "64x64",
+                  "steps": JOB_STEPS}))
+            for i in range(N_JOBS)]
+        # the stub job runs ~steps x (dispatch+3ms) on 1 worker: the
+        # backlog stays deep for the whole chat phase
+        max_batch_depth = 0.0
+        sat = []
+        for i in range(N_SAT):
+            sat.append(await _ttft_stream(client, f"interactive {i}"))
+            max_batch_depth = max(max_batch_depth,
+                                  SERVE_QOS_QUEUE_DEPTH.value(qos="batch"))
+        # class-labeled queue gauge visible in a real scrape
+        metrics = await (await client.get("/metrics")).text()
+        mm = re.search(
+            r'^cake_serve_qos_queue_depth\{qos="batch"\} (\S+)$',
+            metrics, re.M)
+        assert mm is not None, "no class-labeled queue gauge in /metrics"
+        assert max_batch_depth > 0, \
+            "batch queue depth never rose — saturation phase is broken"
+
+        # -- phase 3: every batch job completes 200 (zero failures)
+        statuses = [(await t).status for t in jobs]
+        assert statuses == [200] * N_JOBS, f"batch failures: {statuses}"
+
+        sat_p50 = _pctl(sat, 0.5)
+        baseline = max(idle_p50, BASELINE_FLOOR_S)
+        assert sat_p50 <= 2.0 * baseline, (
+            f"interactive TTFT p50 {sat_p50 * 1e3:.1f}ms exceeds 2x the "
+            f"idle baseline {baseline * 1e3:.1f}ms under batch "
+            "saturation")
+        return {
+            "qos_smoke": "ok",
+            "idle_ttft_p50_ms": round(idle_p50 * 1e3, 2),
+            "saturated_ttft_p50_ms": round(sat_p50 * 1e3, 2),
+            "gate_ratio": round(sat_p50 / baseline, 3),
+            "batch_jobs": statuses.count(200),
+            "max_batch_queue_depth": max_batch_depth,
+            "idle_ms": [round(x * 1e3, 1) for x in idle],
+            "saturated_ms": [round(x * 1e3, 1) for x in sat],
+        }
+    finally:
+        await client.close()
+        engine.close()
+
+
+def main() -> int:
+    out = asyncio.run(main_async())
+    print(json.dumps(out, indent=2))
+    mean_idle = statistics.fmean(out["idle_ms"])
+    print(f"\nqos-smoke OK: idle p50 {out['idle_ttft_p50_ms']}ms "
+          f"(mean {mean_idle:.1f}ms), saturated p50 "
+          f"{out['saturated_ttft_p50_ms']}ms, ratio {out['gate_ratio']}x, "
+          f"{out['batch_jobs']} batch jobs clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -503,6 +503,89 @@ def bench_fleet(model):
     }
 
 
+def bench_qos(model):
+    """Mixed-workload QoS section: (1) weighted-fair service shares out
+    of a saturated class-aware queue (pure scheduler — deterministic),
+    (2) interactive chat TTFT through the engine, idle vs saturated by
+    a flood of batch diffusion-stub jobs on the plane's executor, (3)
+    batch job throughput under that interleaving. The TTFT gate mirrors
+    qos-smoke: saturated p50 within 2x the idle baseline (50 ms floor
+    on this shared CPU box)."""
+    import jax.numpy as jnp2
+    from cake_tpu.serve.admission import (AdmissionQueue, GenerationJob,
+                                          JobExecutor)
+    from types import SimpleNamespace
+
+    # -- (1) service shares: 3 saturated lanes, 2 full DRR rounds
+    q = AdmissionQueue(64, weights={"interactive": 8.0, "standard": 4.0,
+                                    "batch": 1.0})
+    for _ in range(30):
+        for cls in ("interactive", "standard", "batch"):
+            q.put(SimpleNamespace(qos=cls))
+    served = [q.pop().qos for _ in range(26)]
+    shares = {c: served.count(c) for c in
+              ("interactive", "standard", "batch")}
+    q.drain()
+
+    # -- (2) idle interactive TTFT
+    eng = ServeEngine(model, slots=2, max_queue=16, ctx_len=CTX,
+                      prefill_chunk=CHUNK, prefix_cache_mb=0)
+    prompts = _prompts()
+
+    def chat_ttfts(n, phase):
+        ttfts = []
+        for i in range(n):
+            r = eng.submit(prompts[i % len(prompts)], max_new_tokens=4,
+                           sampling=GREEDY, qos="interactive")
+            assert r.wait(300), f"{phase} chat timed out"
+            assert "error" not in r.result, r.result.get("error")
+            ttfts.append(r.stats["ttft_s"])
+        return ttfts
+
+    w = jnp2.ones((64, 64), jnp2.float32)
+
+    def stub_job(job):
+        x = jnp2.ones((64, 64), jnp2.float32)
+        for _ in range(24):
+            x = jnp2.tanh(x @ w * 1e-3)
+            x.block_until_ready()
+            time.sleep(0.002)
+            job.checkpoint()
+        return True
+
+    try:
+        chat_ttfts(2, "warmup")
+        idle = chat_ttfts(8, "idle")
+        # -- (3) saturate with batch jobs, interleave interactive chat
+        ex = JobExecutor(workers=1, max_queue=32)
+        t0 = time.monotonic()
+        jobs = [ex.submit(GenerationJob("image", stub_job, qos="batch"))
+                for _ in range(10)]
+        try:
+            sat = chat_ttfts(8, "saturated")
+            for j in jobs:
+                assert j.wait(300), "batch job timed out"
+                assert "error" not in j.result, j.result.get("error")
+            jobs_wall = time.monotonic() - t0
+        finally:
+            ex.close()
+    finally:
+        eng.close()
+    idle_p50, sat_p50 = _pctl(idle, 0.5), _pctl(sat, 0.5)
+    baseline = max(idle_p50, 0.05)
+    return {
+        "service_shares_2_rounds": shares,
+        "idle_ttft_p50_s": round(idle_p50, 5),
+        "idle_ttft_p95_s": round(_pctl(idle, 0.95), 5),
+        "saturated_ttft_p50_s": round(sat_p50, 5),
+        "saturated_ttft_p95_s": round(_pctl(sat, 0.95), 5),
+        "gate_ratio": round(sat_p50 / baseline, 3),
+        "batch_jobs": len(jobs),
+        "batch_jobs_per_s": round(len(jobs) / jobs_wall, 3),
+        "qos_protected": sat_p50 <= 2.0 * baseline,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tag", default="local")
@@ -518,7 +601,34 @@ def main() -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="fleet mode: 2 replicas + router, follow-up "
                     "TTFT under prefix-affinity routing vs round-robin")
+    ap.add_argument("--qos", action="store_true",
+                    help="QoS mode: weighted-fair service shares + "
+                    "interactive TTFT idle vs batch-job saturation")
     args = ap.parse_args()
+
+    if args.qos:
+        model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                          max_cache_len=CTX)
+        out = {
+            "bench": "serve-qos",
+            "ts": int(time.time()),
+            "config": {"ctx": CTX, "prefill_chunk": CHUNK,
+                       "weights": {"interactive": 8, "standard": 4,
+                                   "batch": 1},
+                       "job_workers": 1, "platform": "cpu-tiny"},
+            "qos": bench_qos(model),
+        }
+        path = args.out or f"BENCH_QOS_{args.tag}.json"
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(json.dumps(out, indent=2))
+        print(f"\nwrote {path}", file=sys.stderr)
+        if not out["qos"]["qos_protected"]:
+            print(f"FAIL: saturated interactive TTFT p50 ratio "
+                  f"{out['qos']['gate_ratio']} > 2x idle baseline",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     if args.fleet:
         model = TextModel(tiny_config("llama"), dtype=jnp.float32,

@@ -87,14 +87,18 @@ serve-chaos-smoke: lint
 # killed mid-traffic — zero failed non-streamed requests (transparent
 # failover), a visible eject -> readmit cycle in /fleet + /metrics, and
 # saturation shed as router-level 429s (shed_by=router), never replica
-# errors
+# errors. Streamed phase (hard gate): the owning replica is killed
+# MID-STREAM — the self-healed body must be byte-identical to an
+# unbroken run with zero client-visible errors, and resume budget 0
+# must preserve the typed error event (now with resume_token).
 fleet-chaos-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/fleet_chaos_smoke.py
 
 # fleet affinity bench: 2 replicas + router, conversational follow-up
 # traffic with prefix-affinity routing vs round-robin — affinity must
 # beat round-robin on warm follow-up TTFT (the owning replica holds the
-# conversation's prefix KV blocks). Writes BENCH_FLEET_<tag>.json.
+# conversation's prefix KV blocks) — plus the self-healing resume stat
+# (splice gap vs cold client retry). Writes BENCH_FLEET_<tag>.json.
 serve-bench-fleet:
 	JAX_PLATFORMS=cpu python scripts/serve_bench.py --fleet --tag fleet
 

@@ -30,6 +30,15 @@ from .state import (ApiState, await_job, run_blocking,
 
 TOP_K_CHOICES = (1, 5, 10, 20, 40, 64, 100, 200)
 
+# continuation handshake with the fleet router (mirrored there by name —
+# the router tier stays import-light): a streamed continuation-mode
+# response reports how many chars of the partial assistant text this
+# replica consumed, so the router's mid-stream resume can strip any
+# re-emitted overlap by POSITION instead of guessing from content. This
+# implementation always continues the partial verbatim, so it reports
+# the full length.
+CONTINUATION_CHARS_HEADER = "X-Cake-Continuation-Chars"
+
 
 def _grid(v: float, step: float, lo: float, hi: float) -> float:
     return round(round(max(lo, min(hi, v)) / step) * step, 2)
@@ -248,6 +257,21 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         if not isinstance(m, dict) or "role" not in m or "content" not in m:
             return web.json_response(
                 {"error": "each message needs role and content"}, status=400)
+    # continuation mode: a final assistant message carrying
+    # `"continue": true` is a PARTIAL turn — the prompt is templated
+    # WITHOUT a second assistant header, the engine prefills
+    # prompt + partial content, and generation continues the same
+    # message (greedy continuations are bit-identical to the stream
+    # that was never broken; sampled ones resume on a fresh rng fold,
+    # the documented rebuild-parity exception). The fleet router's
+    # transparent mid-stream resume splices through this, and a client
+    # holding a typed stream-broken error finishes through it by hand.
+    continuation = bool(messages[-1].get("continue"))
+    if continuation and messages[-1].get("role") != "assistant":
+        return web.json_response(
+            {"error": '"continue": true requires the final message to '
+                      "be role=assistant (the partial turn being "
+                      "continued)"}, status=400)
 
     try:
         # validate/quantize sampling params BEFORE any streaming response
@@ -271,12 +295,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         if state.engine is not None:
             return await _chat_engine(request, state, messages, gen_kwargs,
                                       stream=bool(body.get("stream")),
-                                      stops=stops, qos=qos, tenant=tenant)
+                                      stops=stops, qos=qos, tenant=tenant,
+                                      continuation=continuation)
         if body.get("stream"):
             return await _chat_stream(request, state, messages, gen_kwargs,
-                                      stops)
+                                      stops, continuation=continuation)
         return await _chat_blocking(request, state, messages, gen_kwargs,
-                                    stops)
+                                    stops, continuation=continuation)
     finally:
         release()
 
@@ -327,7 +352,7 @@ def _stats_snapshot(stats: dict, cid: str | None = None) -> dict:
         out["completion_id"] = cid
     for k in ("ttft_s", "decode_tokens", "decode_s", "tok_per_s",
               "stage_rtts", "prefill", "queue_wait_s", "prefill_chunks",
-              "prefix_hit_tokens"):
+              "prefix_hit_tokens", "continuation"):
         if k in stats:
             out[k] = stats[k]
     return out
@@ -370,17 +395,37 @@ def _completion_json(state: ApiState, cid: str, toks: list[int],
     })
 
 
+async def _continuation_ids(state: ApiState, messages):
+    """Token ids for a continuation-mode request (final message is the
+    partial assistant turn) — the locked fallback paths hand these to
+    generate() directly, since chat_generate would re-template with a
+    duplicate assistant header."""
+    from ..models.common.text_model import continuation_prompt_ids
+    tok = state.tokenizer or getattr(state.model, "tokenizer", None)
+    return await run_blocking(lambda: continuation_prompt_ids(tok, messages))
+
+
 async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
-                         stops: list[str] | None = None):
+                         stops: list[str] | None = None,
+                         continuation: bool = False):
     cid = _completion_id()
     # the request id (router-injected trace id, or the completion id)
     # rides the contextvar: spans recorded during this generation (model
     # phases, cluster hops) carry it, so a trace export is joinable with
     # API logs/responses — and with the fleet router's timeline
     rid = _adopt_request_id(request, cid)
+    prompt_in, n_in = messages, None
+    if continuation:
+        try:
+            prompt_in = await _continuation_ids(state, messages)
+        except Exception as e:
+            return web.json_response(
+                {"error": f"chat template failed: {e}"}, status=400)
+        n_in = len(prompt_in)
     async with state.lock:                  # one inference at a time
         try:
-            toks, stats = await run_generation_blocking(state.model, messages,
+            toks, stats = await run_generation_blocking(state.model,
+                                                        prompt_in,
                                                         gen_kwargs)
             state.last_stats = _stats_snapshot(stats, cid)
         except Exception as e:
@@ -402,7 +447,8 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
                                      status=500)
     GENERATIONS.inc(kind="text", status="ok")
     resp = _completion_json(state, cid, toks, stats,
-                            _prompt_token_count(state, messages), stops)
+                            n_in if n_in is not None
+                            else _prompt_token_count(state, messages), stops)
     resp.headers[TRACE_HEADER] = rid
     return resp
 
@@ -413,15 +459,18 @@ async def _chat_blocking(request, state: ApiState, messages, gen_kwargs,
 async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
                        stream: bool, stops: list[str] | None = None,
                        qos: str = "interactive",
-                       tenant: str | None = None):
+                       tenant: str | None = None,
+                       continuation: bool = False):
     """Submit to the serve engine: concurrent decode, bounded queue."""
-    from ..models.common.text_model import chat_prompt_ids
+    from ..models.common.text_model import (chat_prompt_ids,
+                                            continuation_prompt_ids)
     cid = _completion_id()
     rid = _adopt_request_id(request, cid)
     tokenizer = state.tokenizer or getattr(state.model, "tokenizer", None)
     try:
         prompt_ids = await run_blocking(
-            lambda: chat_prompt_ids(tokenizer, messages))
+            lambda: continuation_prompt_ids(tokenizer, messages)
+            if continuation else chat_prompt_ids(tokenizer, messages))
     except Exception as e:
         return web.json_response({"error": f"chat template failed: {e}"},
                                  status=400)
@@ -429,7 +478,8 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
         req = state.engine.submit(prompt_ids,
                                   max_new_tokens=gen_kwargs["max_new_tokens"],
                                   sampling=gen_kwargs["sampling"],
-                                  request_id=rid, qos=qos, tenant=tenant)
+                                  request_id=rid, qos=qos, tenant=tenant,
+                                  continuation=continuation)
     except QueueFull as e:
         # backpressure is a first-class answer: shed load instead of
         # queueing unboundedly behind a bounded slot pool. The 429 is
@@ -471,7 +521,10 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
                 return resp
         aiter, result = state.engine.stream(req)
         return await _sse_drain(request, state, cid, aiter, result,
-                                req.cancel, stops)
+                                req.cancel, stops,
+                                cont_chars=len(str(
+                                    messages[-1].get("content") or ""))
+                                if continuation else None)
     if stops:
         # early termination: watch the token stream from the scheduler
         # thread and cancel at the first completed stop match, so a
@@ -514,7 +567,8 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
 
 
 async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
-                     cancel, stops: list[str] | None = None
+                     cancel, stops: list[str] | None = None,
+                     cont_chars: int | None = None
                      ) -> web.StreamResponse:
     """Drain a token stream into SSE chunks — shared by the engine and
     locked paths. `cancel` is a thunk that aborts the producer; it fires
@@ -523,15 +577,20 @@ async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
     `stops`: OpenAI stop sequences — matched text is never emitted (a
     StopMatcher holds back potential partial matches across token
     boundaries), the stream finishes with finish_reason="stop", and the
-    producer is cancelled at the match."""
-    resp = web.StreamResponse(headers={
+    producer is cancelled at the match. `cont_chars`: continuation mode
+    only — chars of the partial assistant turn consumed (reported to the
+    router's resume splice via the handshake header)."""
+    hdrs = {
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
         "Connection": "keep-alive",
         # the cross-tier trace id rides the SSE headers too, so a
         # streaming client can pull /api/v1/requests/<id> afterwards
         TRACE_HEADER: current_request_id() or cid,
-    })
+    }
+    if cont_chars is not None:
+        hdrs[CONTINUATION_CHARS_HEADER] = str(cont_chars)
+    resp = web.StreamResponse(headers=hdrs)
     try:
         return await _sse_drain_inner(request, state, cid, aiter, result,
                                       cancel, resp, stops)
@@ -620,14 +679,26 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
 
 
 async def _chat_stream(request, state: ApiState, messages, gen_kwargs,
-                       stops: list[str] | None = None):
+                       stops: list[str] | None = None,
+                       continuation: bool = False):
     cid = _completion_id()
     _adopt_request_id(request, cid)     # spans carry the trace id / cid
+    prompt_in = messages
+    if continuation:
+        try:
+            prompt_in = await _continuation_ids(state, messages)
+        except Exception as e:
+            return web.json_response(
+                {"error": f"chat template failed: {e}"}, status=400)
     async with state.lock:      # locked fallback: one inference at a time
-        aiter, result, cancel = run_generation_streamed(state.model, messages,
+        aiter, result, cancel = run_generation_streamed(state.model,
+                                                        prompt_in,
                                                         gen_kwargs)
         return await _sse_drain(request, state, cid, aiter, result,
-                                cancel.set, stops)
+                                cancel.set, stops,
+                                cont_chars=len(str(
+                                    messages[-1].get("content") or ""))
+                                if continuation else None)
 
 
 async def list_models(request: web.Request) -> web.Response:

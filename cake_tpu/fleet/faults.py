@@ -24,8 +24,14 @@ A fault plan is one `key=val[;key=val...]` clause from the
                         of S ms first (the router awaits it — gray
                         slow-but-alive, drives the TTFB p95 detector)
     break_stream_after=N  sever the SSE relay after N forwarded chunks —
-                        the mid-stream failure drill (typed error event
-                        + resume hints, never a silent hang)
+                        the mid-stream failure drill (transparent
+                        splice-resume under CAKE_FLEET_STREAM_RESUMES,
+                        typed error event past the budget — never a
+                        silent hang)
+    break_times=K       sever only the first K streams to the target
+                        (default: every stream) — lets a resume drill
+                        break the owner once and then prove the SAME
+                        replica serves clean splices afterwards
 
 An "op" is one outbound ATTEMPT against the target replica (retries and
 hedges count separately); the counter survives ejection/readmission
@@ -64,9 +70,12 @@ class FleetFaultInjector:
     refuse_times: int | None = None     # None = refuse forever once armed
     stall_ms: float = 0.0
     break_stream_after: int | None = None
+    break_times: int | None = None      # None = sever every stream
     ops: int = 0                        # attempts seen against the target
+    streams_broken: int = 0             # severs already delivered
 
-    _INT_KEYS = ("refuse_after_ops", "refuse_times", "break_stream_after")
+    _INT_KEYS = ("refuse_after_ops", "refuse_times", "break_stream_after",
+                 "break_times")
 
     @classmethod
     def parse(cls, clause: str) -> "FleetFaultInjector":
@@ -111,10 +120,18 @@ class FleetFaultInjector:
         return self.stall_ms / 1e3
 
     def break_stream(self, replica: str, chunks_sent: int) -> bool:
-        """True when the SSE relay to this replica must sever now."""
-        return (replica == self.replica
-                and self.break_stream_after is not None
-                and chunks_sent >= self.break_stream_after)
+        """True when the SSE relay to this replica must sever now; each
+        True consumes one of the break_times window (None = sever every
+        stream to the target forever)."""
+        if (replica != self.replica
+                or self.break_stream_after is None
+                or chunks_sent < self.break_stream_after):
+            return False
+        if (self.break_times is not None
+                and self.streams_broken >= self.break_times):
+            return False
+        self.streams_broken += 1
+        return True
 
 
 def parse_plan(spec: str) -> FleetFaultInjector:

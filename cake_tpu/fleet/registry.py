@@ -144,6 +144,15 @@ class Replica:
         with self._lock:
             return self._cap()
 
+    def weight(self) -> float:
+        """Rendezvous placement weight: the replica's probed capacity
+        (engine slots from its last /health), 1.0 before the first
+        probe lands — so a heterogeneous fleet places conversations
+        proportionally to real slot counts while a fresh fleet starts
+        uniform."""
+        with self._lock:
+            return float(self.slots_hint) if self.slots_hint > 0 else 1.0
+
     def try_acquire(self) -> str | None:
         """Reserve one routing slot on this replica. Returns a truthy
         lease token — "slot" for a normal reservation, "trial" for THE

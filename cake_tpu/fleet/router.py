@@ -12,12 +12,22 @@ registry's membership machine (fleet/registry.py) and the affinity hash
   2. FAIL OVER — a transport failure or replica 5xx retries on the
      deterministic next-best replica under a per-request budget
      (CAKE_FLEET_RETRIES) with capped-exponential backoff +/-25% jitter.
-     Streamed requests retry only BEFORE the first byte reaches the
-     client; a mid-stream break emits a typed SSE error event with
-     resume hints instead of a silent hang. Non-streamed requests can
-     optionally hedge (CAKE_FLEET_HEDGE_MS): no reply after the
-     threshold fires a duplicate at the next-best replica and the first
-     response wins ("The Tail at Scale").
+     Streamed requests fail over invisibly BEFORE the first byte
+     reaches the client (the commit point); AFTER it the router
+     SELF-HEALS: it keeps a bounded replay buffer of the relayed
+     assistant text (CAKE_FLEET_RESUME_BUFFER_KB) and on a break
+     re-issues the buffered partial in CONTINUATION MODE (the replica
+     prefills prompt + partial and continues the same message) on the
+     affinity next-best replica — overlap stripped, chunk ids rewritten
+     onto the original stream, relayed on the SAME client socket — up
+     to CAKE_FLEET_STREAM_RESUMES times. Only an exhausted budget (or a
+     blown buffer) emits the typed SSE error event, whose resume block
+     now carries a resume_token so the client can still finish via the
+     same continuation mode by hand. Requests can optionally hedge
+     (CAKE_FLEET_HEDGE_MS): no reply after the threshold fires a
+     duplicate at the next-best replica and the first response wins
+     ("The Tail at Scale") — streams hedge up to their commit point,
+     the first replica to produce a body byte wins the socket.
 
   3. SHED — a per-replica in-flight cap and a global admission bound
      turn overload into typed 429s AT THE ROUTER (body carries
@@ -31,6 +41,7 @@ separately from the replicas."""
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import logging
 import random
@@ -40,7 +51,7 @@ from aiohttp import web
 
 from .. import knobs
 from ..obs import (FLEET_HEDGES, FLEET_PROXIED, FLEET_RETRIES, FLEET_SHEDS,
-                   TRACE_HEADER, TimelineStore, now)
+                   FLEET_STREAM_RESUMES, TRACE_HEADER, TimelineStore, now)
 from . import faults
 from .registry import ReplicaRegistry, discover_replicas
 from .routing import affinity_key, conversation_head, rank_replicas
@@ -63,6 +74,15 @@ QOS_HEADER = "X-Cake-QoS"
 TENANT_HEADER = "X-Cake-Tenant"
 _QOS_CLASSES = ("interactive", "standard", "batch")
 
+# continuation handshake, mirrored from api/text.py by NAME ONLY (same
+# import-light rule as the QoS headers): a replica answering a
+# continuation-mode request reports how many chars of the partial
+# assistant text it actually consumed, and the router strips EXACTLY
+# the re-emitted remainder from the resumed stream's front. Position
+# accounting, not content matching — a suffix-match heuristic cannot
+# tell boundary re-emission from genuinely repeating tokens.
+CONTINUATION_CHARS_HEADER = "X-Cake-Continuation-Chars"
+
 
 def _transport_errors():
     """aiohttp's client errors join the transport set lazily (the module
@@ -81,6 +101,60 @@ class _ClientGone(Exception):
     detector and eject a healthy replica)."""
 
 
+class _StreamRelay:
+    """Client-side state of ONE streamed request across every replica
+    attempt it takes: the (single) prepared client response, the
+    identity of the first relayed stream (chunk id / created stamp —
+    the resume path rewrites spliced chunks onto them so the client
+    sees one continuous completion), and the bounded replay buffer a
+    resume splice is rebuilt from. Event-loop-confined, like all
+    handler state."""
+
+    def __init__(self, limit_bytes: int):
+        self.resp: web.StreamResponse | None = None
+        self.claimed = False        # commit claim (one hedge leg wins)
+        self.owner: str | None = None       # replica name that claimed
+        self.commit_evt = asyncio.Event()
+        self.cid: str | None = None         # first stream's chunk "id"
+        self.created = None                 # ... and "created" stamp
+        self.chunks = 0             # SSE events relayed to the client
+        self.tokens = 0             # content-bearing chunks (~tokens)
+        self.content_chars = 0      # relayed content length (always on)
+        self.text = ""              # replay buffer (until overflow)
+        self.text_bytes = 0         # running UTF-8 size of the buffer
+        self.splice_chars = 0       # FULL partial length of the last
+                                    # splice request (client's own
+                                    # continuation prefix + buffer)
+        self.limit = max(int(limit_bytes), 1024)
+        self.overflow = False       # buffer blown: splice impossible
+        self.opaque = False         # unparseable data event: ditto
+        self.finished = False       # a finish_reason reached the client
+        self.last_exc: BaseException | None = None
+
+    def account(self, content: str | None, finish) -> None:
+        """Fold one relayed chunk into the replay buffer/accounting."""
+        if finish:
+            self.finished = True
+        if content:
+            self.tokens += 1
+            self.content_chars += len(content)
+            if not self.overflow:
+                self.text += content
+                # running counter: re-encoding the whole buffer per
+                # chunk would make the relay O(n^2) in stream length
+                self.text_bytes += len(
+                    content.encode("utf-8", "surrogatepass"))
+                if self.text_bytes > self.limit:
+                    # past the bound the splice can no longer be built:
+                    # drop the buffer (memory bound is the point) and
+                    # let a later break take the typed-error path
+                    self.overflow = True
+                    self.text = ""
+
+    def spliceable(self) -> bool:
+        return not (self.overflow or self.opaque)
+
+
 class FleetRouter:
     """Router state + handlers. One instance per router process; all
     handler state is event-loop-confined (single asyncio thread), while
@@ -96,7 +170,9 @@ class FleetRouter:
                  attempt_timeout_s: float | None = None,
                  probe_s: float | None = None,
                  cluster_key: str | None = None,
-                 discover_s: float | None = None):
+                 discover_s: float | None = None,
+                 stream_resumes: int | None = None,
+                 resume_buffer_kb: int | None = None):
         self.registry = registry
         self.retries = retries if retries is not None \
             else knobs.get("CAKE_FLEET_RETRIES")
@@ -118,6 +194,11 @@ class FleetRouter:
         self.cluster_key = cluster_key
         self.discover_s = discover_s if discover_s is not None \
             else knobs.get("CAKE_FLEET_DISCOVER_S")
+        self.stream_resumes = stream_resumes if stream_resumes is not None \
+            else knobs.get("CAKE_FLEET_STREAM_RESUMES")
+        self.resume_buffer_kb = resume_buffer_kb \
+            if resume_buffer_kb is not None \
+            else knobs.get("CAKE_FLEET_RESUME_BUFFER_KB")
         self.session = None                 # aiohttp.ClientSession
         self.inflight = 0                   # event-loop-confined
         self.draining = False
@@ -261,7 +342,12 @@ class FleetRouter:
         if self.affinity and messages:
             key = affinity_key(conversation_head(messages),
                                self.affinity_blocks)
-            ranked = rank_replicas(key, names)
+            # weighted rendezvous: probed capacity (engine slots from
+            # /health) scales each replica's score, so a heterogeneous
+            # fleet places conversations proportionally
+            weights = {r.name: r.weight()
+                       for r in self.registry.replicas()}
+            ranked = rank_replicas(key, names, weights)
         else:
             start = self.registry.next_rr() % len(names)
             ranked = sorted(names)
@@ -548,65 +634,378 @@ class FleetRouter:
     async def _route_stream(self, request: web.Request, body: dict,
                             order: list, rid: str | None = None,
                             fwd: dict | None = None) -> web.StreamResponse:
-        """SSE relay with pre-commit failover: attempts rotate replicas
-        until one starts streaming; once the first byte has been
-        relayed the request is COMMITTED to that replica, and a break
-        after commit emits a typed error event + resume hints (the
-        client re-issues; affinity routes the retry warm)."""
-        budget = 1 + self.retries
-        attempts = 0
-        cap_skipped = False
-        for i, rep in enumerate(order):
-            if attempts >= budget:
-                break
-            if not rep.routable():
-                continue
-            lease = rep.try_acquire()
-            if not lease:
-                cap_skipped = True
-                continue
-            committed = False
-            try:
-                resp, retryable = await self._relay_stream(
-                    request, rep, body, lease, rid, fwd)
-                committed = resp is not None
-                if committed:
-                    if rid:
-                        self.timelines.event(rid, "done", status=resp.status)
-                    return resp
-                attempts += 1
-                if retryable and attempts < budget \
-                        and any(r.routable() for r in order[i + 1:]):
-                    FLEET_RETRIES.inc()
-                    if rid:
-                        self.timelines.event(rid, "retry")
-                    await self._sleep_backoff(attempts)
-            finally:
-                rep.release(lease)
-        if attempts == 0:
+        """SSE relay with pre-commit failover/hedging and post-commit
+        SELF-HEALING: attempts rotate replicas until one starts
+        streaming; once the first byte has been relayed the request is
+        COMMITTED to that replica's stream identity, and a break after
+        commit is spliced back together — the buffered partial content
+        is re-issued in continuation mode on the affinity next-best
+        survivor and the continuation relayed on the SAME client socket
+        — up to CAKE_FLEET_STREAM_RESUMES times. Only an exhausted
+        budget (or a blown replay buffer) emits the typed error event,
+        which now carries a resume_token for a manual continuation."""
+        st = _StreamRelay(self.resume_buffer_kb * 1024)
+        bs = {"attempts": 0, "budget": 1 + self.retries,
+              "cap_skipped": False}
+        # rng-fold parity exception rides the resume: a sampled stream
+        # (temperature > 0) still resumes, but its continuation draws
+        # from a fresh fold — flagged on the timeline, same documented
+        # exception as a crash rebuild
+        sampled = float(body.get("temperature", 0.7) or 0.0) > 0.0
+        if self.hedge_ms > 0:
+            kind, val = await self._stream_first_hedged(
+                request, body, order, rid, fwd, st, bs)
+        else:
+            kind, val = await self._stream_seq(
+                request, body, order, rid, fwd, st, bs)
+        failed: set = set()
+        resumes = 0
+        while kind == "broken":
+            broken = val
+            failed.add(broken.name)
+            if st.finished:
+                # the break lost only the [DONE] sentinel (the finish
+                # chunk already reached the client): close the stream
+                # clean — there is nothing left to resume
+                return await self._finish_interrupted(st, rid)
+            max_tok = int(body.get("max_tokens",
+                                   body.get("max_completion_tokens",
+                                            256)) or 256)
+            if st.tokens >= max_tok:
+                # every budgeted token was already delivered — only the
+                # finish chunk and [DONE] died with the connection. A
+                # splice here would decode PAST the client's budget
+                # (max_tokens clamps at 1), so synthesize the finish
+                # instead of resuming a completed generation.
+                return await self._finish_interrupted(st, rid,
+                                                      synth_finish=True)
+            if resumes >= self.stream_resumes:
+                FLEET_STREAM_RESUMES.inc(outcome="exhausted")
+                return await self._stream_broken_terminal(
+                    st, rid, broken, resumes)
+            if not st.spliceable():
+                FLEET_STREAM_RESUMES.inc(outcome="overflow")
+                return await self._stream_broken_terminal(
+                    st, rid, broken, resumes)
+            resumes += 1
+            if rid:
+                self.timelines.event(rid, "stream_resume",
+                                     replica=broken.name, attempt=resumes,
+                                     **({"sampled": True} if sampled
+                                        else {}))
+            splice = self._splice_body(body, st)
+            # affinity next-best over the (unchanged) conversation head:
+            # the survivor ranked after the broken owner most likely
+            # holds the shared prefix blocks, so the splice prefill is
+            # the warm path. Replicas that already broke THIS stream
+            # are skipped even if not yet ejected; the resume is a
+            # fresh outbound placement, so it rotates under its own
+            # attempt budget rather than whatever the initial
+            # placement left over.
+            rbs = {"attempts": 0, "budget": 1 + self.retries,
+                   "cap_skipped": False}
+            kind, val = await self._stream_seq(
+                request, splice, self._order(splice["messages"]), rid,
+                fwd, st, rbs, resumed=True, skip=failed)
+            if kind == "none":
+                FLEET_STREAM_RESUMES.inc(outcome="error")
+                return await self._stream_broken_terminal(
+                    st, rid, broken, resumes)
+            if kind == "broken":
+                FLEET_STREAM_RESUMES.inc(outcome="broken")
+        if kind == "final":
+            if resumes:
+                FLEET_STREAM_RESUMES.inc(outcome="ok")
+            if rid:
+                self.timelines.event(rid, "done", status=val.status)
+            return val
+        # kind == "none": the stream never started anywhere
+        if bs["attempts"] == 0:
             return self._shed("replica in-flight caps", rid) \
-                if cap_skipped else self._no_replica(rid)
+                if bs["cap_skipped"] else self._no_replica(rid)
         FLEET_PROXIED.inc(outcome="failed")
         if rid:
             self.timelines.event(rid, "done", status=503)
         return web.json_response(
             {"error": "fleet failover budget exhausted (stream never "
-                      "started)", "attempts": attempts,
+                      "started)", "attempts": bs["attempts"],
              "shed_by": "router"},
             status=503,
             headers={"Retry-After": str(self._retry_after())})
 
+    async def _stream_seq(self, request, body, order: list,
+                          rid: str | None, fwd: dict | None,
+                          st: _StreamRelay, bs: dict,
+                          resumed: bool = False, skip=()):
+        """Sequential streamed placement over `order` under bs's shared
+        attempt budget: rotate candidates until one commits (relays a
+        byte to the client). Pre-commit failures stay invisible.
+        Returns ("final", resp) | ("broken", replica) | ("none", None);
+        `skip` names replicas that already broke this stream."""
+        for i, rep in enumerate(order):
+            if bs["attempts"] >= bs["budget"]:
+                break
+            if rep.name in skip or not rep.routable():
+                continue
+            kind, val = await self._stream_leg(request, rep, body, rid,
+                                               fwd, st, resumed)
+            if kind == "skip":
+                bs["cap_skipped"] = True
+                continue
+            if kind == "lost":              # hedge twin owns the socket
+                continue
+            if kind in ("final", "broken"):
+                return (kind, val)
+            bs["attempts"] += 1
+            # back off only when another attempt can actually happen
+            rest = [r for r in order[i + 1:]
+                    if r.name not in skip and r.routable()]
+            if bs["attempts"] < bs["budget"] and rest:
+                FLEET_RETRIES.inc()
+                if rid:
+                    self.timelines.event(rid, "retry")
+                await self._sleep_backoff(bs["attempts"])
+        return ("none", None)
+
+    async def _stream_leg(self, request, rep, body, rid, fwd,
+                          st: _StreamRelay, resumed: bool = False):
+        """One streamed attempt holding its own routing-slot lease (so
+        a hedge winner can cancel the loser without leaking it)."""
+        lease = rep.try_acquire()
+        if not lease:
+            return ("skip", None)
+        try:
+            return await self._relay_stream(request, rep, body, lease,
+                                            rid, fwd, st, resumed)
+        finally:
+            rep.release(lease)
+
+    async def _stream_first_hedged(self, request, body, order: list,
+                                   rid: str | None, fwd: dict | None,
+                                   st: _StreamRelay, bs: dict):
+        """Pre-commit tail hedge for streams: if the owner has produced
+        no body byte after CAKE_FLEET_HEDGE_MS, fire a duplicate at the
+        next-best replica; the FIRST leg to claim the commit point owns
+        the client socket and the loser is cancelled before it can ever
+        write (the claim is the exclusion — a leg checks-and-sets it
+        with no await in between). Hedge attempts spend the shared
+        budget exactly like the non-streamed path; falls back to the
+        sequential relay when fewer than two replicas are routable or
+        every fired leg dies pre-commit."""
+        reps = [r for r in order if r.routable()]
+        if len(reps) < 2:
+            return await self._stream_seq(request, body, order, rid, fwd,
+                                          st, bs)
+        legs: dict = {}
+
+        def fire(rep):
+            legs[rep.name] = asyncio.create_task(
+                self._stream_leg(request, rep, body, rid, fwd, st))
+        fire(reps[0])
+        await asyncio.wait(set(legs.values()),
+                           timeout=self.hedge_ms / 1e3)
+        if not st.claimed and not legs[reps[0].name].done():
+            FLEET_HEDGES.inc()
+            if rid:
+                self.timelines.event(rid, "hedge", replica=reps[1].name)
+            fire(reps[1])
+        tried = len(legs)
+        watch = asyncio.create_task(st.commit_evt.wait())
+        result = None
+        try:
+            pending = set(legs.values())
+            while pending and result is None:
+                done, _ = await asyncio.wait(
+                    pending | {watch},
+                    return_when=asyncio.FIRST_COMPLETED)
+                if st.claimed:
+                    # a leg owns the socket: cancel the one that lost
+                    # the race (still pre-commit by construction) and
+                    # ride the winner to its terminal state
+                    for name, t in legs.items():
+                        if name != st.owner and not t.done():
+                            t.cancel()
+                    result = await legs[st.owner]
+                    break
+                for t in done:
+                    if t is watch:
+                        continue
+                    pending.discard(t)
+                    kind, val = t.result()
+                    if kind == "final":
+                        result = (kind, val)
+                        break
+                    if kind in ("skip", "lost"):
+                        if kind == "skip":
+                            bs["cap_skipped"] = True
+                        continue
+                    bs["attempts"] += 1     # pre-commit failure
+        finally:
+            watch.cancel()
+            for t in legs.values():
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(*legs.values(), return_exceptions=True)
+        if result is not None:
+            return result
+        # every fired leg failed pre-commit: sequential over the rest
+        rest = reps[tried:]
+        if bs["attempts"] and any(r.routable() for r in rest):
+            FLEET_RETRIES.inc()             # hedge -> sequential handoff
+            if rid:
+                self.timelines.event(rid, "retry")
+            # same spacing as every other failover attempt: a fleet-wide
+            # hiccup (both hedge legs 503ing) must not be hammered with
+            # a zero-delay third attempt
+            await self._sleep_backoff(max(bs["attempts"], 1))
+        return await self._stream_seq(request, body, rest, rid, fwd, st,
+                                      bs)
+
+    # -- resume plumbing -----------------------------------------------------
+
+    @staticmethod
+    def _splice_body(body: dict, st: _StreamRelay) -> dict:
+        """The continuation-mode request that resumes a broken stream:
+        original messages + the buffered partial as a final assistant
+        turn with `"continue": true` (merged in place when the client
+        was ITSELF already continuing), token budget reduced by what
+        was already generated so the resumed replica produces exactly
+        the remainder. Records the FULL partial length on the relay
+        state — the continuation-chars handshake reports consumption
+        against the whole merged partial, not just the buffer."""
+        msgs = [dict(m) if isinstance(m, dict) else m
+                for m in (body.get("messages") or [])]
+        if msgs and isinstance(msgs[-1], dict) \
+                and msgs[-1].get("continue") \
+                and msgs[-1].get("role") == "assistant":
+            msgs[-1]["content"] = str(msgs[-1].get("content") or "") \
+                + st.text
+        else:
+            msgs.append({"role": "assistant", "content": st.text,
+                         "continue": True})
+        st.splice_chars = len(str(msgs[-1]["content"]))
+        out = dict(body)
+        out["messages"] = msgs
+        max_tok = body.get("max_tokens", body.get("max_completion_tokens"))
+        if max_tok is not None:
+            out.pop("max_completion_tokens", None)
+            out["max_tokens"] = max(int(max_tok) - st.tokens, 1)
+        return out
+
+    @staticmethod
+    def _resume_token(st: _StreamRelay, resumes: int) -> str:
+        """The typed error's machine-readable half: the splice
+        accounting a client needs to verify its own continuation
+        (committed text length + generated-token count — NOT the event
+        count, which includes role/finish chunks) before finishing the
+        stream by hand. base64url JSON, inspectable on purpose."""
+        tok = {"v": 1, "mode": "continue",
+               "content_chars": st.content_chars,
+               "tokens_generated": st.tokens,
+               "chunks_relayed": st.chunks,
+               "resumes_attempted": resumes}
+        return base64.urlsafe_b64encode(
+            json.dumps(tok, separators=(",", ":")).encode()).decode()
+
+    async def _stream_broken_terminal(self, st: _StreamRelay,
+                                      rid: str | None, rep,
+                                      resumes: int) -> web.StreamResponse:
+        """Self-healing gave up (budget exhausted, buffer blown, or no
+        survivor could splice): emit the typed error event + [DONE] so
+        the client sees a structured failure it can finish by hand via
+        continuation mode — never a silent dead socket."""
+        FLEET_PROXIED.inc(outcome="broken_stream")
+        e = st.last_exc
+        payload = {"error": {
+            "type": "replica_stream_broken",
+            "replica": rep.name,
+            "message": f"{type(e).__name__}: {e}" if e is not None
+                       else "stream broken after commit",
+            "resume": {
+                "chunks_relayed": st.chunks,
+                "content_chars": st.content_chars,
+                "tokens_generated": st.tokens,
+                "resumes_attempted": resumes,
+                "resume_token": self._resume_token(st, resumes),
+                "hint": "append the received partial text as "
+                        '{"role": "assistant", "content": <text>, '
+                        '"continue": true} and re-issue: the replica '
+                        "continues the same message in place "
+                        "(prefix-affinity lands the retry warm; greedy "
+                        "continuations are bit-identical)",
+            },
+        }}
+        if rid:
+            self.timelines.event(rid, "done", status=200)
+        try:
+            await st.resp.write(b"data: "
+                                + json.dumps(payload).encode() + b"\n\n")
+            await st.resp.write(b"data: [DONE]\n\n")
+            await st.resp.write_eof()
+        except _transport_errors():
+            pass                        # client also gone
+        return st.resp
+
+    async def _finish_interrupted(self, st: _StreamRelay,
+                                  rid: str | None,
+                                  synth_finish: bool = False
+                                  ) -> web.StreamResponse:
+        """Close a broken stream that has nothing left to generate.
+        `synth_finish`: the break also ate the finish chunk (the whole
+        token budget was delivered) — emit one in the original stream's
+        identity so the client sees a complete, well-formed stream."""
+        FLEET_PROXIED.inc(outcome="ok")
+        if rid:
+            self.timelines.event(rid, "done", status=200)
+        try:
+            if synth_finish:
+                chunk = {"object": "chat.completion.chunk",
+                         "choices": [{"index": 0, "delta": {},
+                                      "finish_reason": "length"}]}
+                if st.cid is not None:
+                    chunk["id"] = st.cid
+                if st.created is not None:
+                    chunk["created"] = st.created
+                await st.resp.write(b"data: "
+                                    + json.dumps(chunk).encode()
+                                    + b"\n\n")
+            await st.resp.write(b"data: [DONE]\n\n")
+            await st.resp.write_eof()
+        except _transport_errors():
+            pass
+        return st.resp
+
     async def _relay_stream(self, request, rep, body,
                             lease: str = "slot", rid: str | None = None,
-                            fwd: dict | None = None):
-        """One streamed attempt. Returns (response, retryable):
-        response None = nothing was relayed, caller may retry
-        elsewhere; a non-None response is terminal (clean EOF or typed
-        mid-stream error)."""
+                            fwd: dict | None = None,
+                            st: _StreamRelay | None = None,
+                            resumed: bool = False):
+        """One streamed attempt relayed onto the client socket held by
+        `st`. Returns:
+          ("final", resp)  — terminal: clean EOF, a relayed refusal, or
+                             the client itself went away;
+          ("none", True)   — nothing (new) reached the client; the
+                             caller may rotate to another candidate;
+          ("lost", None)   — a hedge twin claimed the socket first;
+          ("broken", rep)  — transport break AFTER this attempt relayed
+                             bytes; st carries the replay buffer.
+        Non-resumed attempts relay events verbatim while ACCOUNTING the
+        delta text into the replay buffer; resumed attempts PARSE and
+        REWRITE — the duplicate assistant-role chunk is dropped,
+        retokenization overlap against the buffer tail is stripped, and
+        the chunk id / created stamp are rewritten to the first
+        stream's so the client sees one continuous completion."""
         hook = faults.FAULT_HOOK
         t0 = now()
-        chunks = 0
-        resp = None
+        chunks = 0          # events read from THIS upstream (fault seam)
+        relayed = 0         # events THIS attempt wrote to the client
+        # full partial length of the splice request (client's own
+        # continuation prefix included) — consumption is reported
+        # against this, not just the router's buffer
+        splice_chars = st.splice_chars if resumed else 0
+        strip_left = 0      # re-emitted overlap chars still to drop
+        stripped = 0        # overlap chars dropped at the splice point
+        ttfb_ms = None
         try:
             if hook is not None:
                 stall = hook.on_attempt(rep.name)
@@ -622,27 +1021,46 @@ class FleetRouter:
                     data = await r.read()
                     if r.status in (500, 502, 503):
                         rep.record_result(False, lease=lease)
-                        return None, True
+                        return ("none", True)
                     if r.status == 429:
-                        return None, True
+                        return ("none", True)
+                    if resumed:
+                        # a refusal cannot be relayed onto a socket that
+                        # is already a committed 200 SSE stream: count
+                        # the candidate out and rotate (the replica
+                        # answered, so it is not a transport failure)
+                        rep.record_result(True, (now() - t0) * 1e3,
+                                          lease=lease)
+                        return ("none", True)
                     # non-retryable refusal (400 etc.): relay verbatim
                     rep.record_result(True, (now() - t0) * 1e3,
                                       lease=lease)
                     FLEET_PROXIED.inc(
                         outcome="ok" if r.status < 400 else "failed")
-                    return web.Response(
+                    return ("final", web.Response(
                         body=data, status=r.status,
                         content_type=r.content_type
-                        or "application/json"), False
-                ttfb_ms = None
+                        or "application/json"))
+                if resumed:
+                    # deterministic overlap: the replica says how much
+                    # of the partial its continuation consumed (ours
+                    # consume all of it); the difference is re-emitted
+                    # text the client already has. No header = assume
+                    # exact continuation, strip nothing.
+                    hdr = r.headers.get(CONTINUATION_CHARS_HEADER)
+                    if hdr is not None:
+                        try:
+                            strip_left = max(splice_chars - int(hdr), 0)
+                        except ValueError:
+                            strip_left = 0
                 buf = b""
                 async for piece in r.content.iter_any():
                     if not piece:
                         continue
                     buf += piece
                     # relay whole SSE events, not TCP pieces: the break
-                    # drill (and the chunks_relayed resume hint) count
-                    # EVENTS, which TCP coalescing would otherwise blur
+                    # drill (and the resume accounting) count EVENTS,
+                    # which TCP coalescing would otherwise blur
                     while b"\n\n" in buf:
                         event, buf = buf.split(b"\n\n", 1)
                         event += b"\n\n"
@@ -651,8 +1069,63 @@ class FleetRouter:
                             raise faults.InjectedFleetFault(
                                 f"fault injected: stream to {rep.name} "
                                 f"severed after {chunks} chunks")
-                        if resp is None:
+                        chunks += 1
+                        if ttfb_ms is None:
                             ttfb_ms = (now() - t0) * 1e3
+                        if not resumed and st.claimed \
+                                and st.owner != rep.name:
+                            # a hedge twin claimed the socket between
+                            # our upstream read and this event: stand
+                            # down BEFORE parsing — a loser that folded
+                            # its own stream's cid/opaque flags into the
+                            # shared relay state would poison the
+                            # winner's replay buffer
+                            return ("lost", None)
+                        # parse the event for the replay buffer (and,
+                        # on a resumed leg, to rewrite/strip it)
+                        content = finish = None
+                        obj = None
+                        if event.startswith(b"data:"):
+                            pl = event[5:].strip()
+                            if pl != b"[DONE]":
+                                try:
+                                    obj = json.loads(pl)
+                                except Exception:
+                                    # opaque payload: relayable, but a
+                                    # future splice could not rebuild
+                                    # it — disable resume honestly
+                                    st.opaque = True
+                        if isinstance(obj, dict):
+                            choice = (obj.get("choices") or [{}])[0] or {}
+                            delta = choice.get("delta") or {}
+                            content = delta.get("content")
+                            finish = choice.get("finish_reason")
+                            if not resumed and st.cid is None \
+                                    and obj.get("id"):
+                                st.cid = obj["id"]
+                                st.created = obj.get("created")
+                        out = event
+                        if resumed and isinstance(obj, dict):
+                            if "role" in (choice.get("delta") or {}) \
+                                    and not content:
+                                continue    # duplicate assistant header
+                            if strip_left and content:
+                                cut = min(len(content), strip_left)
+                                strip_left -= cut
+                                stripped += cut
+                                content = content[cut:]
+                                choice["delta"]["content"] = content
+                                if not content and finish is None:
+                                    continue    # chunk fully re-emitted
+                            if st.cid is not None and obj.get("id"):
+                                obj["id"] = st.cid
+                                if st.created is not None:
+                                    obj["created"] = st.created
+                            out = b"data: " \
+                                + json.dumps(obj).encode() + b"\n\n"
+                        if st.resp is None:
+                            st.claimed = True
+                            st.owner = rep.name
                             if rid:
                                 self.timelines.event(
                                     rid, "commit", replica=rep.name,
@@ -664,69 +1137,66 @@ class FleetRouter:
                             }
                             if rid:
                                 hdrs[TRACE_HEADER] = rid
-                            resp = web.StreamResponse(headers=hdrs)
+                            st.resp = web.StreamResponse(headers=hdrs)
+                            st.commit_evt.set()
                             try:
-                                await resp.prepare(request)
+                                await st.resp.prepare(request)
                             except _transport_errors() as we:
                                 raise _ClientGone() from we
                         try:
-                            await resp.write(event)
+                            await st.resp.write(out)
                         except _transport_errors() as we:
                             raise _ClientGone() from we
-                        chunks += 1
-                if resp is not None and buf:
-                    try:
-                        await resp.write(buf)    # non-event tail
-                    except _transport_errors() as we:
-                        raise _ClientGone() from we
-                if resp is None:
+                        if resumed and relayed == 0 and rid:
+                            self.timelines.event(
+                                rid, "resume_spliced", replica=rep.name,
+                                overlap_chars=stripped)
+                        relayed += 1
+                        st.chunks += 1
+                        st.account(content, finish)
+                if st.resp is None:
                     # upstream 200 with an empty body: broken replica
                     rep.record_result(False, lease=lease)
-                    return None, True
+                    return ("none", True)
+                if resumed and relayed == 0:
+                    # a 200 that relayed nothing new (only a role chunk
+                    # or pure overlap): a failed splice candidate, not
+                    # a finished stream
+                    rep.record_result(False, lease=lease)
+                    return ("none", True)
+                if buf:
+                    try:
+                        await st.resp.write(buf)    # non-event tail
+                    except _transport_errors() as we:
+                        raise _ClientGone() from we
                 rep.record_result(True, ttfb_ms, lease=lease)
                 FLEET_PROXIED.inc(outcome="ok")
-                await resp.write_eof()
-                return resp, False
+                await st.resp.write_eof()
+                return ("final", st.resp)
         except _ClientGone:
             # the CLIENT went away, the replica was fine: closing the
             # upstream context cancels the replica-side generation (its
             # disconnect sweep frees the slot) and no failure is
-            # recorded against it
+            # recorded against it — a resume the client abandoned is
+            # NOT replica evidence either
             rep.record_result(True, (now() - t0) * 1e3,
                               lease=lease)
             FLEET_PROXIED.inc(outcome="ok")
-            return (resp if resp is not None and resp.prepared
-                    else web.Response(status=200)), False
+            return ("final",
+                    st.resp if st.resp is not None and st.resp.prepared
+                    else web.Response(status=200))
         except _transport_errors() as e:
             rep.record_result(False, transport=True, lease=lease)
-            if resp is None:
-                return None, True           # pre-commit: retry elsewhere
-            # mid-stream break AFTER bytes reached the client: typed
-            # error event + resume hints — never a silent dead socket
-            FLEET_PROXIED.inc(outcome="broken_stream")
+            if st.resp is None or relayed == 0:
+                return ("none", True)   # nothing (new) was relayed
+            # break AFTER bytes reached the client: hand the replay
+            # buffer back to _route_stream, whose resume budget decides
+            # between a transparent splice and the typed error event
+            st.last_exc = e
             if rid:
-                self.timelines.event(rid, "stream_broken", replica=rep.name,
-                                chunks=chunks)
-            payload = {"error": {
-                "type": "replica_stream_broken",
-                "replica": rep.name,
-                "message": f"{type(e).__name__}: {e}",
-                "resume": {
-                    "chunks_relayed": chunks,
-                    "hint": "re-issue the request with the partial "
-                            "assistant content appended to messages; "
-                            "prefix-affinity routes the retry onto a "
-                            "replica holding the shared prefix",
-                },
-            }}
-            try:
-                await resp.write(b"data: "
-                                 + json.dumps(payload).encode() + b"\n\n")
-                await resp.write(b"data: [DONE]\n\n")
-                await resp.write_eof()
-            except _transport_errors():
-                pass                        # client also gone
-            return resp, False
+                self.timelines.event(rid, "stream_broken",
+                                     replica=rep.name, chunks=st.chunks)
+            return ("broken", rep)
 
     # -- passthrough + introspection ----------------------------------------
 

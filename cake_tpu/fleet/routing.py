@@ -23,17 +23,26 @@ insight, minus the remote radix trees):
     hash every conversation to one key and melt a single replica.
 
   * the key is placed on replicas by RENDEZVOUS (highest-random-weight)
-    hashing: every replica scores blake2b(key || name) and candidates
-    are ranked by score. Adding or ejecting a replica reshuffles only
-    the conversations it owned, and the failover order is DETERMINISTIC
-    — when the owner is ejected, every router instance agrees on the
-    same next-best replica, so the reroute itself stays cache-friendly.
+    hashing: every replica draws a uniform u = blake2b(key || name) in
+    (0, 1) and candidates are ranked by -w / ln(u), the logarithmic
+    weighted-rendezvous score — a replica with twice the probed
+    capacity (slots from /health) owns twice the conversations in
+    expectation, so heterogeneous fleets place load proportionally.
+    With equal weights the score is monotone in u, which makes the
+    ranking IDENTICAL to the classic unweighted digest sort (placement
+    is backward-compatible; benches stay comparable). Adding or
+    ejecting a replica reshuffles only the conversations it owned,
+    changing ONE replica's weight remaps only conversations moving to
+    or from it, and the failover order is DETERMINISTIC — when the
+    owner is ejected, every router instance agrees on the same
+    next-best replica, so the reroute itself stays cache-friendly.
 
 Pure functions, no I/O: the router feeds them membership and bodies.
 """
 from __future__ import annotations
 
 import hashlib
+import math
 
 __all__ = ["affinity_key", "rank_replicas", "conversation_head",
            "AFFINITY_BLOCK"]
@@ -74,13 +83,29 @@ def affinity_key(data: bytes, max_blocks: int = 4) -> bytes:
     return h.digest()
 
 
-def rank_replicas(key: bytes, names: list) -> list:
-    """Rendezvous order of `names` for `key`: descending
-    blake2b(key || name) score, name-tiebroken. rank[0] is the owner;
-    rank[1] is the deterministic next-best every router agrees on when
-    the owner is ejected."""
-    def score(name: str) -> bytes:
-        return hashlib.blake2b(
+def rank_replicas(key: bytes, names: list,
+                  weights: dict | None = None) -> list:
+    """Weighted rendezvous order of `names` for `key`: descending
+    -w / ln(u) with u uniform in (0, 1) from blake2b(key || name),
+    name-tiebroken. rank[0] is the owner; rank[1] is the deterministic
+    next-best every router agrees on when the owner is ejected.
+    `weights` maps name -> capacity (missing or non-positive = 1.0);
+    a replica's expected share of keys is proportional to its weight,
+    and equal weights reproduce the unweighted digest ordering exactly
+    (the score is monotone in u)."""
+    def score(name: str) -> float:
+        h = hashlib.blake2b(
             key + name.encode("utf-8", "surrogatepass"),
             digest_size=8).digest()
+        # (h + 0.5) / 2^64 keeps u strictly inside (0, 1) in exact
+        # arithmetic, but digests within ~1024 of 2^64 ROUND to 1.0 in
+        # float64 — and ln(1) = 0 would make the score a deterministic
+        # ZeroDivisionError for that (key, name) pair forever; clamp to
+        # the largest float64 below 1.0 (ties broken by name as usual)
+        u = min((int.from_bytes(h, "big") + 0.5) / 2.0 ** 64,
+                1.0 - 2.0 ** -53)
+        w = float((weights or {}).get(name, 1.0))
+        if w <= 0.0:
+            w = 1.0
+        return -w / math.log(u)
     return sorted(names, key=lambda n: (score(n), n), reverse=True)

@@ -234,6 +234,17 @@ _knob("CAKE_FLEET_DISCOVER_S", float, 0.0, "fleet",
       "periodic UDP re-discovery interval: newly announced `cake serve "
       "--announce` replicas join the registry without a router restart; "
       "0 = discover once at startup only")
+_knob("CAKE_FLEET_STREAM_RESUMES", int, 1, "fleet",
+      "per-stream self-healing budget: how many times the router may "
+      "transparently splice-resume a stream broken AFTER its commit "
+      "point (first relayed byte) by re-issuing the buffered partial "
+      "content in continuation mode on the affinity next-best replica; "
+      "0 restores the client-visible typed error event on every break")
+_knob("CAKE_FLEET_RESUME_BUFFER_KB", int, 256, "fleet",
+      "per-stream replay-buffer bound (KB of relayed assistant text) "
+      "the resume splice is built from; a stream whose content outgrows "
+      "the buffer falls back to the typed error event (the resume_token "
+      "still lets the client finish via continuation mode)")
 _knob("CAKE_FLEET_FAULT_PLAN", str, None, "fleet",
       'deterministic router fault injection (tests/drills only), e.g. '
       '"replica=r1;refuse_after_ops=3" — see fleet/faults.py')

@@ -5,4 +5,4 @@ from .config import (ARCH_ADAPTERS, FAMILY_ADAPTERS, LayerSpec,
 from .layers import (block_forward, embed_tokens, forward_layers, init_params,
                      lm_head_logits, make_rope)
 from .text_model import (LocalStage, SamplingConfig, TextModel, Token,
-                         bucket_for, render_chat)
+                         bucket_for, continuation_prompt_ids, render_chat)

@@ -1306,3 +1306,25 @@ def render_chat(tokenizer, messages: list[dict]) -> str:
         parts.append(f"<|im_start|>{m['role']}\n{m['content']}<|im_end|>\n")
     parts.append("<|im_start|>assistant\n")
     return "".join(parts)
+
+
+def continuation_prompt_ids(tokenizer, messages: list[dict]) -> list[int]:
+    """Continuation-mode templating: the FINAL message is a partial
+    assistant turn (role=assistant, `"continue": true`) and the prompt
+    must end INSIDE it — the history is templated with its normal
+    generation prompt (one assistant header) and the partial content is
+    appended verbatim, with no second assistant header and no
+    end-of-turn token. The engine then prefills prompt + partial and
+    decode continues the same message: a greedy continuation is
+    bit-identical to the stream that was never broken (the fleet
+    router's mid-stream resume splice, and any client finishing a
+    broken stream by hand, both ride this)."""
+    head, partial = messages[:-1], str(messages[-1].get("content") or "")
+    if hasattr(tokenizer, "apply_chat"):
+        prompt = tokenizer.apply_chat(head) + partial
+        if hasattr(tokenizer, "encode_chat_prompt"):
+            return list(tokenizer.encode_chat_prompt(prompt))
+    else:
+        prompt = render_chat(tokenizer, head) + partial
+    enc = tokenizer.encode(prompt)
+    return list(enc.ids if hasattr(enc, "ids") else enc)

@@ -314,6 +314,14 @@ FLEET_PROXIED = REGISTRY.counter(
     "Chat requests proxied through the fleet router",
     labelnames=("outcome",))        # ok | failed | shed | broken_stream
 
+FLEET_STREAM_RESUMES = REGISTRY.counter(
+    "cake_fleet_stream_resumes_total",
+    "Transparent mid-stream resume attempts: streams broken after the "
+    "commit point that the router spliced (or tried to) onto another "
+    "replica in continuation mode",
+    labelnames=("outcome",))        # ok | broken | error | exhausted |
+                                    # overflow
+
 CLUSTER_STAGE_FAILURES = REGISTRY.counter(
     "cake_cluster_stage_failures_total",
     "Classified remote-hop failures observed by the master",
@@ -375,5 +383,5 @@ __all__ = [
     "FLEET_REPLICAS", "FLEET_REPLICA_QUEUE_DEPTH",
     "FLEET_REPLICA_OCCUPANCY", "FLEET_REPLICA_INFLIGHT", "FLEET_SHEDS",
     "FLEET_EJECTS", "FLEET_READMITS", "FLEET_RETRIES", "FLEET_HEDGES",
-    "FLEET_PROXIED",
+    "FLEET_PROXIED", "FLEET_STREAM_RESUMES",
 ]

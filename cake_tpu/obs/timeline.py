@@ -97,8 +97,16 @@ EVENT_KINDS: dict[str, str] = {
     "shed": "router refused before any replica admitted (`reason`)",
     "commit": "first streamed byte relayed; the request is committed "
               "to `replica`",
-    "stream_broken": "stream severed after commit; typed error event "
-                     "sent (`replica`, `chunks`)",
+    "stream_broken": "stream severed after commit (`replica`, `chunks` "
+                     "relayed so far); the resume budget decides what "
+                     "happens next",
+    "stream_resume": "router began a transparent splice-resume of the "
+                     "broken stream (`replica` that broke, `attempt`, "
+                     "`sampled` when the rng-fold parity exception "
+                     "applies)",
+    "resume_spliced": "resumed replica's continuation reached the "
+                      "client: first spliced chunk relayed on the same "
+                      "socket (`replica`, `overlap_chars` stripped)",
     "done": "terminal: router relayed the final response (`status`)",
 }
 

@@ -168,7 +168,8 @@ class ServeRequest:
 
     def __init__(self, prompt_ids: list[int], max_new_tokens: int,
                  sampling: SamplingConfig, request_id: str | None = None,
-                 qos: str = "interactive", tenant: str | None = None):
+                 qos: str = "interactive", tenant: str | None = None,
+                 continuation: bool = False):
         self.id = request_id or "serve-" + uuid.uuid4().hex[:16]
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
@@ -177,6 +178,12 @@ class ServeRequest:
         # rank) + tenant (quota accounting / timeline attribution)
         self.qos = qos
         self.tenant = tenant
+        # continuation admission: the prompt's tail is a PARTIAL
+        # assistant turn being continued (mid-stream resume splice or
+        # client-side finish of a broken stream) — flagged through the
+        # enqueue timeline event and stats so operators can tell a
+        # splice prefill from a fresh conversation
+        self.continuation = continuation
         self.out_q: queue_mod.Queue = queue_mod.Queue()
         self.cancelled = threading.Event()
         self.admitted = threading.Event()   # set when a slot is assigned
@@ -442,10 +449,15 @@ class ServeEngine:
     def submit(self, prompt_ids: list[int], max_new_tokens: int = 256,
                sampling: SamplingConfig | None = None,
                request_id: str | None = None, qos: str = "interactive",
-               tenant: str | None = None) -> ServeRequest:
+               tenant: str | None = None,
+               continuation: bool = False) -> ServeRequest:
         """Enqueue a generation under QoS class `qos` (admission lane,
         weighted-fair share, preemption rank — resolved and clamped by
-        the API's admission plane). Raises QueueFull under backpressure
+        the API's admission plane). `continuation` marks a splice
+        prefill whose prompt tail is a partial assistant turn being
+        continued in place (the prefix cache makes the shared head
+        nearly free, so a resume's TTFR is the warm path, not a full
+        re-prefill). Raises QueueFull under backpressure
         (class-aware: the 429's Retry-After reflects that class's
         backlog), EngineDown while the engine is dead or in
         budget-exhausted degraded mode (API: 503 + Retry-After),
@@ -485,7 +497,7 @@ class ServeEngine:
                 f"but the pool holds {paged.num_blocks} "
                 f"(CAKE_KV_BLOCKS x CAKE_KV_BLOCK_TOKENS tokens total)")
         req = ServeRequest(prompt_ids, max_new_tokens, sampling, request_id,
-                           qos=qos, tenant=tenant)
+                           qos=qos, tenant=tenant, continuation=continuation)
         req._engine = self
         # free slots extend the bound: a burst that fits the idle pool is
         # admitted even though the scheduler drains one per iteration
@@ -493,7 +505,9 @@ class ServeEngine:
         TIMELINES.begin(req.id)
         TIMELINES.event(req.id, "enqueue", depth=self.queue.depth(),
                         qos=req.qos,
-                        **({"tenant": req.tenant} if req.tenant else {}))
+                        **({"tenant": req.tenant} if req.tenant else {}),
+                        **({"continuation": True} if req.continuation
+                           else {}))
         self._wake.set()
         if self.dead is not None or self.supervisor.is_down():
             # the scheduler crashed (or went down) between the liveness
@@ -1026,6 +1040,8 @@ class ServeEngine:
         req.slot = slot
         req.admitted.set()
         req.stats = {"queue_wait_s": now() - req.t_enqueue}
+        if req.continuation:
+            req.stats["continuation"] = True
         TIMELINES.event(req.id, "admit", slot=slot, qos=req.qos,
                         queue_wait_ms=round(
                             req.stats["queue_wait_s"] * 1e3, 3))

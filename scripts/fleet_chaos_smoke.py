@@ -11,7 +11,17 @@ Asserts, in order:
      cake_fleet_readmits_total);
   3. saturation sheds at the ROUTER: with a small global admission bound
      and slowed decode, overflow answers 429 with shed_by=router (and
-     zero replica-originated 5xx/429s leak through).
+     zero replica-originated 5xx/429s leak through);
+  4. SELF-HEALING STREAMS (ISSUE 15 hard gate): the owning replica is
+     killed MID-STREAM with one resume in the budget — the client
+     receives the complete greedy body BYTE-IDENTICAL to an unbroken
+     run with zero client-visible errors,
+     cake_fleet_stream_resumes_total{outcome="ok"} > 0, and the
+     router timeline for that request id chains
+     stream_broken -> stream_resume -> resume_spliced -> done;
+  5. with the resume budget at 0 the legacy typed error event is
+     preserved — now carrying a resume_token + honest content
+     accounting so a client can finish via continuation mode.
 
 Every phase polls WITH A DEADLINE (the serve-chaos lesson: fixed sleeps
 flake on this container's slow CPU). Exits non-zero on any missing
@@ -20,6 +30,7 @@ signal. Run via `make fleet-chaos-smoke`.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import re
 import sys
@@ -38,6 +49,7 @@ from aiohttp.test_utils import TestClient, TestServer      # noqa: E402
 from cake_tpu.api import ApiState, create_app              # noqa: E402
 from cake_tpu.fleet import (FleetRouter, MembershipPolicy,  # noqa: E402
                             ReplicaRegistry, create_router_app)
+from cake_tpu.fleet import faults as fleet_faults          # noqa: E402
 from cake_tpu.models import TextModel, tiny_config         # noqa: E402
 from cake_tpu.serve import ServeEngine                     # noqa: E402
 from cake_tpu.serve import faults as serve_faults          # noqa: E402
@@ -48,11 +60,24 @@ MAX_NEW = 8
 
 
 class SmokeTok:
+    """Word-hash for prose, ROUND-TRIP for generated ids: decode emits
+    " t<id>" words and encode parses them back verbatim, so a
+    continuation splice (chat template + partial content) re-encodes to
+    exactly `prompt ids + generated ids` — the property the streamed
+    byte-parity drill rests on (real tokenizers round-trip their own
+    decodes the same way)."""
+
     def encode(self, text):
-        return [3 + (sum(w.encode()) % 200) for w in text.split()][:48] or [3]
+        out = []
+        for w in text.split():
+            if w[:1] == "t" and w[1:].isdigit():
+                out.append(int(w[1:]))
+            else:
+                out.append(3 + (sum(w.encode()) % 200))
+        return out[:64] or [3]
 
     def decode(self, ids):
-        return "".join(f"<{i}>" for i in ids)
+        return "".join(f" t{i}" for i in ids)
 
 
 class ReplicaProc:
@@ -78,8 +103,16 @@ class ReplicaProc:
         return f"http://127.0.0.1:{self.port}"
 
     async def kill(self):
-        """Sever the HTTP surface (the engine thread stays, like a
-        network partition / crashed frontend)."""
+        """Sever the HTTP surface ABRUPTLY (the engine thread stays,
+        like a network partition / crashed frontend): in-flight
+        responses — including a mid-relay SSE stream — die with a
+        reset instead of being drained gracefully, which is what the
+        self-healing drill needs a kill to look like."""
+        server = self.runner.server
+        for proto in list(getattr(server, "connections", []) or []):
+            tr = getattr(proto, "transport", None)
+            if tr is not None:
+                tr.abort()
         await self.runner.cleanup()
         self.runner = None
 
@@ -111,13 +144,17 @@ async def _poll_fleet(client, pred, deadline_s: float, what: str):
 async def main_async() -> dict:
     model = TextModel(tiny_config("llama"), dtype=jnp.float32,
                       max_cache_len=CTX)
+    # streamed chunks decode per-token through the MODEL's tokenizer
+    # (the API-layer tokenizer only renders prompts/blocking bodies)
+    model.tokenizer = SmokeTok()
     out: dict = {}
     replicas = [ReplicaProc(f"r{i}", model) for i in range(N_REPLICAS)]
     registry = ReplicaRegistry(MembershipPolicy(
         eject_fails=2, err_window=16, err_rate=0.5,
         degraded_ttft_ms=0.0, eject_s=0.3))
     router = FleetRouter(registry, retries=2, backoff_s=0.01,
-                         probe_s=0.15, hedge_ms=0.0, max_inflight=0)
+                         probe_s=0.15, hedge_ms=0.0, max_inflight=0,
+                         stream_resumes=1)
     client = None
     try:
         for rep in replicas:
@@ -195,6 +232,119 @@ async def main_async() -> dict:
         m = re.search(r"^cake_fleet_sheds_total{[^}]*}\s+(\d+)", mtext,
                       re.M)
         assert m and int(m.group(1)) >= 1, "cake_fleet_sheds_total missing"
+
+        # -- phase 4: self-healing streams across a mid-stream kill -------
+        STREAM_MAX_NEW = 24
+
+        def smsg(convo: int) -> list:
+            return [
+                {"role": "system", "content": "fleet smoke system prompt "
+                                              "shared by every conversation"},
+                {"role": "user", "content": f"stream conversation {convo} "
+                                            "tell me a long story"}]
+
+        async def stream_once(convo: int, kill_after: int | None = None,
+                              victim: ReplicaProc | None = None):
+            """One streamed request through the router; optionally kill
+            `victim` once `kill_after` content chunks have arrived.
+            Returns (content, error_events, request_id)."""
+            content, errors = "", []
+            killed = False
+            buf = b""
+            async with client.post("/v1/chat/completions", json={
+                    "messages": smsg(convo), "max_tokens": STREAM_MAX_NEW,
+                    "temperature": 0.0, "stream": True}) as r:
+                assert r.status == 200, await r.text()
+                rid = r.headers.get("X-Cake-Request-Id")
+                ntoks = 0
+                async for piece in r.content.iter_any():
+                    buf += piece
+                    while b"\n\n" in buf:
+                        ev, buf = buf.split(b"\n\n", 1)
+                        if not ev.startswith(b"data: "):
+                            continue
+                        pl = ev[6:].strip()
+                        if pl == b"[DONE]":
+                            continue
+                        obj = json.loads(pl)
+                        if "error" in obj:
+                            errors.append(obj["error"])
+                            continue
+                        delta = obj["choices"][0]["delta"]
+                        if delta.get("content"):
+                            content += delta["content"]
+                            ntoks += 1
+                            if (kill_after is not None and not killed
+                                    and ntoks >= kill_after):
+                                killed = True
+                                await victim.kill()
+            return content, errors, rid
+
+        def commit_replica(rid: str) -> str:
+            tl = router.timelines.get(rid)
+            return next(e["replica"] for e in tl["events"]
+                        if e["kind"] == "commit")
+
+        serve_faults.install("delay_ms=40")     # stretch decode so the
+        try:                                    # kill lands mid-stream
+            convo = base = rid0 = None
+            for c in range(40, 48):     # find a convo that decodes long
+                base, errs, rid0 = await stream_once(c)
+                assert not errs, errs
+                if base.count(" t") >= 10:
+                    convo = c
+                    break
+            assert convo is not None, "no convo produced >= 10 tokens"
+            owner = next(rp for rp in replicas
+                         if rp.name == commit_replica(rid0))
+            healed, errs, rid = await stream_once(convo, kill_after=5,
+                                                  victim=owner)
+            assert not errs, f"client saw error events: {errs}"
+            assert healed == base, \
+                f"healed stream diverged:\n  base:   {base!r}\n" \
+                f"  healed: {healed!r}"
+            out["stream_killed"] = owner.name
+            out["stream_body_identical"] = True
+            kinds = [e["kind"] for e in router.timelines.get(rid)["events"]]
+            for k in ("stream_broken", "stream_resume", "resume_spliced",
+                      "done"):
+                assert k in kinds, (k, kinds)
+            assert kinds.index("stream_broken") \
+                < kinds.index("stream_resume") \
+                < kinds.index("resume_spliced") < kinds.index("done")
+            out["stream_timeline_chain"] = True
+            mtext = await (await client.get("/metrics")).text()
+            m = re.search(r'^cake_fleet_stream_resumes_total'
+                          r'{outcome="ok"}\s+(\d+)', mtext, re.M)
+            assert m and int(m.group(1)) >= 1, \
+                'cake_fleet_stream_resumes_total{outcome="ok"} missing'
+            out["stream_resumes_ok"] = int(m.group(1))
+        finally:
+            serve_faults.clear()
+        await owner.start()                 # same port, same name
+        await _poll_fleet(
+            client, lambda s: s["routable"] == N_REPLICAS,
+            15.0, "stream victim readmitted")
+
+        # -- phase 5: resume budget 0 preserves the legacy typed error ----
+        base2, errs2, rid2 = await stream_once(60)
+        assert not errs2, errs2
+        owner2 = commit_replica(rid2)
+        router.stream_resumes = 0
+        fleet_faults.install(f"replica={owner2};break_stream_after=3")
+        try:
+            part, errs2, _ = await stream_once(60)
+            assert errs2 and errs2[0]["type"] == "replica_stream_broken", \
+                errs2
+            resume = errs2[0]["resume"]
+            assert resume.get("resume_token"), resume
+            assert resume["tokens_generated"] >= 1
+            assert resume["content_chars"] == len(part)
+            assert part and base2.startswith(part)
+            out["legacy_typed_error_with_token"] = True
+        finally:
+            fleet_faults.clear()
+            router.stream_resumes = 1
 
         # fleet health is clean again
         h = await client.get("/health")

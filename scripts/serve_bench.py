@@ -336,13 +336,22 @@ FLEET_CTX = 256     # conversations grow ~2 blocks per turn; the affinity
 class FleetTok:
     """Word-hash tokenizer (no length cap): conversation prompts grow a
     shared token prefix turn over turn, which is what the replica prefix
-    caches (and therefore affinity routing) exist for."""
+    caches (and therefore affinity routing) exist for. decode/encode
+    round-trip generated ids (" t<id>" words) so a resume splice
+    re-encodes the relayed partial to the exact generated tokens —
+    the resume bench rides the same contract the chaos drill pins."""
 
     def encode(self, text):
-        return [3 + (sum(w.encode()) % 200) for w in text.split()] or [3]
+        out = []
+        for w in text.split():
+            if w[:1] == "t" and w[1:].isdigit():
+                out.append(int(w[1:]))
+            else:
+                out.append(3 + (sum(w.encode()) % 200))
+        return out or [3]
 
     def decode(self, ids):
-        return " ".join(f"t{i}" for i in ids)
+        return "".join(f" t{i}" for i in ids)
 
 
 def _fleet_messages(convo: int, turn: int) -> list:
@@ -503,6 +512,145 @@ def bench_fleet(model):
     }
 
 
+RESUME_ITERS = 4
+RESUME_MAX_NEW = 24
+
+
+def bench_fleet_resume(model):
+    """Self-healing stream cost (ISSUE 15): for a mid-stream break that
+    the router heals transparently, measure the client-visible SPLICE
+    GAP — the largest inter-chunk arrival gap of the healed stream,
+    which is where break detection + the continuation splice + the
+    survivor's prefill all hide — against the COLD alternative a manual
+    client retry pays. The honest retry baseline is CATCH-UP time: a
+    naive re-issue prefills from scratch AND regenerates every token
+    the client already had before it produces the first NEW one; the
+    splice skips the regeneration entirely (the partial is prefilled,
+    not decoded). Cold TTFR alone is also recorded for scale."""
+    import asyncio
+
+    from aiohttp import web as aioweb
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from cake_tpu.api import ApiState, create_app
+    from cake_tpu.fleet import (FleetRouter, MembershipPolicy,
+                                ReplicaRegistry, create_router_app)
+    from cake_tpu.fleet import faults as fleet_faults
+    from cake_tpu.serve import faults as serve_faults
+
+    # streamed chunks decode per-token through the MODEL's tokenizer
+    model.tokenizer = FleetTok()
+
+    async def run() -> dict:
+        engines, runners = [], []
+        # breaks are injected on purpose: keep the detector from
+        # ejecting the target replica mid-bench
+        registry = ReplicaRegistry(MembershipPolicy(eject_fails=100,
+                                                    err_rate=1.1))
+        for i in range(2):
+            eng = ServeEngine(model, slots=2, max_queue=32,
+                              ctx_len=FLEET_CTX,
+                              prefill_chunk=CHUNK, prefix_cache_mb=64)
+            engines.append(eng)
+            state = ApiState(model=model, tokenizer=FleetTok(),
+                             model_id=f"bench-rs{i}")
+            state.engine = eng
+            runner = aioweb.AppRunner(create_app(state))
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            port = site._server.sockets[0].getsockname()[1]
+            registry.add(f"r{i}", f"http://127.0.0.1:{port}")
+        router = FleetRouter(registry, retries=1, backoff_s=0.01,
+                             probe_s=5.0, hedge_ms=0.0, affinity=True,
+                             stream_resumes=1)
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()
+
+        async def stream(convo: int, max_new: int):
+            """(t_post, content_arrival_times, rid, error_seen)."""
+            times = []
+            buf = b""
+            t_post = time.perf_counter()
+            async with client.post("/v1/chat/completions", json={
+                    "messages": _fleet_messages(convo, 0),
+                    "stream": True, "max_tokens": max_new,
+                    "temperature": 0.0}) as r:
+                assert r.status == 200, await r.text()
+                rid = r.headers.get("X-Cake-Request-Id")
+                async for piece in r.content.iter_any():
+                    buf += piece
+                    while b"\n\n" in buf:
+                        ev, buf = buf.split(b"\n\n", 1)
+                        if not ev.startswith(b"data: "):
+                            continue
+                        pl = ev[6:].strip()
+                        if pl == b"[DONE]":
+                            continue
+                        obj = json.loads(pl)
+                        if "error" in obj:
+                            return t_post, times, rid, True
+                        d = obj["choices"][0]["delta"]
+                        if d.get("content"):
+                            times.append(time.perf_counter())
+            return t_post, times, rid, False
+
+        def owner_of(rid: str) -> str:
+            tl = router.timelines.get(rid)
+            return next(e["replica"] for e in tl["events"]
+                        if e["kind"] == "commit")
+
+        gaps, colds, catchups = [], [], []
+        healed = 0
+        serve_faults.install("delay_ms=15")     # keep breaks mid-stream
+        try:
+            await stream(900, 6)                # compile warmup
+            for i in range(RESUME_ITERS):
+                convo = 910 + i
+                _, _, rid, _ = await stream(convo, 4)   # probe the owner
+                fleet_faults.install(
+                    f"replica={owner_of(rid)};break_stream_after=6;"
+                    "break_times=1")
+                try:
+                    _, times, rid, err = await stream(convo,
+                                                      RESUME_MAX_NEW)
+                finally:
+                    fleet_faults.clear()
+                if err or len(times) < 3:
+                    continue
+                healed += 1
+                deltas = [b - a for a, b in zip(times, times[1:])]
+                gap_at = max(range(len(deltas)), key=deltas.__getitem__)
+                gaps.append(deltas[gap_at])
+                # cold retry baseline: fresh conversation, full prefill,
+                # and it must REGENERATE the gap_at+1 tokens the broken
+                # stream had already delivered before the first new one
+                t0, ctimes, _, _ = await stream(950 + i, RESUME_MAX_NEW)
+                if len(ctimes) > gap_at + 1:
+                    colds.append(ctimes[0] - t0)
+                    catchups.append(ctimes[gap_at + 1] - t0)
+            return {
+                "iters": RESUME_ITERS,
+                "healed": healed,
+                "splice_gap_p50_s": round(_pctl(gaps, 0.5), 5),
+                "splice_gap_max_s": round(max(gaps), 5),
+                "cold_ttfr_p50_s": round(_pctl(colds, 0.5), 5),
+                "cold_catchup_p50_s": round(_pctl(catchups, 0.5), 5),
+                "resume_beats_cold_retry":
+                    _pctl(gaps, 0.5) < _pctl(catchups, 0.5),
+            }
+        finally:
+            serve_faults.clear()
+            await client.close()
+            for runner in runners:
+                await runner.cleanup()
+            for eng in engines:
+                eng.close()
+
+    return asyncio.new_event_loop().run_until_complete(run())
+
+
 def bench_qos(model):
     """Mixed-workload QoS section: (1) weighted-fair service shares out
     of a saturated class-aware queue (pure scheduler — deterministic),
@@ -600,7 +748,9 @@ def main() -> int:
                     "contiguous + paged engines")
     ap.add_argument("--fleet", action="store_true",
                     help="fleet mode: 2 replicas + router, follow-up "
-                    "TTFT under prefix-affinity routing vs round-robin")
+                    "TTFT under prefix-affinity routing vs round-robin, "
+                    "plus the self-healing stream splice gap vs a cold "
+                    "restart")
     ap.add_argument("--qos", action="store_true",
                     help="QoS mode: weighted-fair service shares + "
                     "interactive TTFT idle vs batch-job saturation")
@@ -641,6 +791,7 @@ def main() -> int:
                        "turns": FLEET_TURNS, "platform": "cpu-tiny"},
             "fleet": bench_fleet(model),
         }
+        out["fleet"]["resume"] = bench_fleet_resume(model)
         path = args.out or f"BENCH_FLEET_{args.tag}.json"
         with open(path, "w") as f:
             json.dump(out, f, indent=2)
@@ -658,6 +809,15 @@ def main() -> int:
         if not fl["affinity_wins"]:
             print("warning: affinity follow-up TTFT p50 did not beat "
                   "round-robin this run (wall-clock noise)",
+                  file=sys.stderr)
+        rs = fl["resume"]
+        if rs["healed"] == 0:
+            print("FAIL: no mid-stream break was healed in the resume "
+                  "bench", file=sys.stderr)
+            return 1
+        if not rs["resume_beats_cold_retry"]:
+            print("warning: splice gap did not beat the cold catch-up "
+                  "baseline this run (wall-clock noise)",
                   file=sys.stderr)
         return 0
 

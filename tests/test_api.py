@@ -511,3 +511,64 @@ def test_images_n_validation():
             "prompt": "x", "n": 2, "response_format": "png"})
         assert r.status == 400
     with_client(make_state(), scenario)
+
+
+def test_continuation_template_no_duplicate_assistant_header():
+    """Continuation-mode templating ends the prompt INSIDE the partial
+    assistant turn: exactly one assistant header, the partial content
+    appended verbatim, no end-of-turn token after it."""
+    from cake_tpu.models.common.text_model import continuation_prompt_ids
+
+    class CapturingTok:
+        def encode(self, text):
+            self.last = text
+            return [1, 2, 3]
+
+    tok = CapturingTok()
+    msgs = [{"role": "system", "content": "sys"},
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "par tial", "continue": True}]
+    continuation_prompt_ids(tok, msgs)
+    assert tok.last.endswith("<|im_start|>assistant\npar tial")
+    assert tok.last.count("<|im_start|>assistant") == 1
+    assert "par tial<|im_end|>" not in tok.last
+
+
+def test_chat_continuation_mode_and_validation():
+    """`"continue": true` on a non-assistant tail is a 400; on an
+    assistant tail the request generates normally (the continuation of
+    the same message)."""
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi",
+                          "continue": True}]})
+        assert r.status == 400
+        assert "continue" in (await r.json())["error"]
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"},
+                         {"role": "assistant", "content": "Hel",
+                          "continue": True}]})
+        assert r.status == 200
+        data = await r.json()
+        assert data["choices"][0]["message"]["content"] == "Hello world !"
+    with_client(make_state(), scenario)
+
+
+def test_chat_continuation_stream():
+    """Continuation mode streams like any chat (the locked fallback
+    path hands token ids to generate())."""
+    async def scenario(client):
+        r = await client.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"},
+                         {"role": "assistant", "content": "Hel",
+                          "continue": True}],
+            "stream": True})
+        assert r.status == 200
+        body = (await r.read()).decode()
+        text = "".join(
+            json.loads(line[6:])["choices"][0]["delta"].get("content", "")
+            for line in body.split("\n\n")
+            if line.startswith("data: ") and line != "data: [DONE]")
+        assert text == "Hello world !"
+        assert body.strip().endswith("data: [DONE]")
+    with_client(make_state(), scenario)

@@ -6,10 +6,14 @@ chain (same conversation -> same replica; ejected owner -> deterministic
 next-best). HTTP-level tests drive a real router app over FAKE replica
 servers (canned JSON/SSE — no model, no engine) and pin the failure
 semantics: transparent failover, retry-budget exhaustion as a typed 503,
-router-level 429 before any replica admits, and the typed mid-stream
-error event with resume hints.
+router-level 429 before any replica admits, SELF-HEALING mid-stream
+resume (ISSUE 15: splice byte-identity, overlap strip, chunk-id rewrite,
+budget-exhausted typed event with resume_token, client-disconnect during
+resume, sampled-resume flagging, pre-commit stream hedging) and weighted
+rendezvous placement.
 """
 import asyncio
+import base64
 import json
 
 import pytest
@@ -333,13 +337,26 @@ def test_fleet_fault_plan_parse_and_refuse():
 
 
 class FakeReplica:
-    """Canned `cake serve` stand-in: JSON + SSE chat, /health with an
-    engine block, a mutable behavior switch, and a request log."""
+    """Canned `cake serve` stand-in: JSON + SSE chat (role chunk,
+    per-token content chunks carrying a replica-scoped completion id,
+    finish chunk, [DONE]), CONTINUATION MODE (a final assistant message
+    with "continue": true resumes at the token its partial content ends
+    on — counting "tok" occurrences stands in for re-tokenizing), a
+    /health engine block, a mutable behavior switch, and request logs."""
+
+    N_TOKS = 4
 
     def __init__(self, name: str):
         self.name = name
-        self.mode = "ok"        # ok | http500 | http429 | hang
+        self.mode = "ok"        # ok | http500 | http429 | hang |
+                                # slow_stream | abrupt (sever the
+                                # transport after break_after content
+                                # chunks, no [DONE]) | overlap_resume
+                                # (continuations re-emit the last
+                                # already-relayed token first)
+        self.break_after = 2    # content chunks before an abrupt sever
         self.served = []        # prompts this replica actually admitted
+        self.continuations = []  # partial contents it spliced
         self.server = None
         self.release = asyncio.Event()
 
@@ -354,23 +371,58 @@ class FakeReplica:
                                          headers={"Retry-After": "3"})
             if self.mode == "hang":
                 await self.release.wait()
-            self.served.append(body["messages"][-1]["content"])
+            msgs = body["messages"]
+            start = 0
+            cont = bool(msgs and msgs[-1].get("continue"))
+            if cont:
+                if msgs[-1].get("role") != "assistant":
+                    return web.json_response(
+                        {"error": "continue needs an assistant tail"},
+                        status=400)
+                partial = msgs[-1]["content"]
+                self.continuations.append(partial)
+                start = partial.count("tok")
+                if self.mode == "overlap_resume" and start > 0:
+                    start -= 1      # round down: re-emit the boundary
+            self.served.append(msgs[-1]["content"])
             if body.get("stream"):
-                resp = web.StreamResponse(headers={
-                    "Content-Type": "text/event-stream"})
+                hdrs = {"Content-Type": "text/event-stream"}
+                if cont:
+                    # continuation handshake: chars of the partial this
+                    # replica's continuation actually consumed
+                    hdrs["X-Cake-Continuation-Chars"] = str(
+                        len("".join(f"tok{i}" for i in range(start))))
+                resp = web.StreamResponse(headers=hdrs)
                 await resp.prepare(request)
-                n = 12 if self.mode == "slow_stream" else 4
-                for i in range(n):
-                    if self.mode == "slow_stream":
-                        await asyncio.sleep(0.05)
-                    try:
-                        await resp.write(
-                            b'data: {"choices":[{"delta":{"content":"tok'
-                            + str(i).encode() + b'"}}]}\n\n')
-                    except ConnectionError:
-                        return resp          # router/client went away
-                await resp.write(b"data: [DONE]\n\n")
-                await resp.write_eof()
+
+                def chunk(delta, finish=None):
+                    return b"data: " + json.dumps({
+                        "id": f"chatcmpl-{self.name}", "created": 1000,
+                        "choices": [{"index": 0, "delta": delta,
+                                     "finish_reason": finish}],
+                    }).encode() + b"\n\n"
+                n = 12 if self.mode == "slow_stream" else self.N_TOKS
+                try:
+                    await resp.write(chunk({"role": "assistant"}))
+                    for i in range(start, n):
+                        if self.mode == "slow_stream":
+                            await asyncio.sleep(0.05)
+                        if self.mode == "abrupt" \
+                                and i - start >= self.break_after:
+                            request.transport.close()
+                            return resp
+                        await resp.write(chunk({"content": f"tok{i}"}))
+                    if self.mode == "abrupt" \
+                            and n - start <= self.break_after:
+                        # content fit under the sever point: eat the
+                        # finish/[DONE] tail instead
+                        request.transport.close()
+                        return resp
+                    await resp.write(chunk({}, "stop"))
+                    await resp.write(b"data: [DONE]\n\n")
+                    await resp.write_eof()
+                except ConnectionError:
+                    return resp              # router/client went away
                 return resp
             return web.json_response({
                 "id": "x", "object": "chat.completion",
@@ -565,7 +617,11 @@ def test_replica_429_fails_over_without_eject():
 
 
 def test_stream_pre_token_failover_and_mid_stream_typed_error():
-    replicas, registry, mk = _fleet_client(2)
+    """With the resume budget at 0 the legacy semantics are preserved:
+    pre-commit breaks fail over invisibly, post-commit breaks emit the
+    typed error event — which now also carries the resume_token and the
+    honest content accounting (chars + tokens, not just SSE events)."""
+    replicas, registry, mk = _fleet_client(2, stream_resumes=0)
 
     async def run():
         client, _router = await mk()
@@ -584,7 +640,7 @@ def test_stream_pre_token_failover_and_mid_stream_typed_error():
             assert "tok0" in await r.text()
             owner.mode = "ok"
 
-            # mid-stream break: typed error event + resume hints
+            # mid-stream break: typed error event + resume accounting
             victim = next(rep for rep in replicas if rep is not owner)
             target = owner if owner.served else victim
             fleet_faults.install(
@@ -598,6 +654,19 @@ def test_stream_pre_token_failover_and_mid_stream_typed_error():
                 assert "replica_stream_broken" in text
                 assert "chunks_relayed" in text
                 assert text.rstrip().endswith("data: [DONE]")
+                err = next(json.loads(line[6:])["error"]
+                           for line in text.split("\n\n")
+                           if line.startswith("data: ")
+                           and "replica_stream_broken" in line)
+                resume = err["resume"]
+                # role chunk + 1 content chunk relayed before the sever
+                assert resume["chunks_relayed"] == 2
+                assert resume["tokens_generated"] == 1
+                assert resume["content_chars"] == len("tok0")
+                tok = json.loads(base64.urlsafe_b64decode(
+                    resume["resume_token"]))
+                assert tok["mode"] == "continue"
+                assert tok["tokens_generated"] == 1
             finally:
                 fleet_faults.clear()
         finally:
@@ -709,6 +778,375 @@ def test_round_robin_mode_spreads():
                                       json=_chat_body("same convo"))
                 assert r.status == 200
             assert all(rep.served for rep in replicas)   # both took load
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# self-healing streams (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _sse_chunks(text: str) -> list:
+    return [json.loads(line[6:]) for line in text.split("\n\n")
+            if line.startswith("data: ") and line.strip() != "data: [DONE]"]
+
+
+def _sse_content(text: str) -> str:
+    return "".join(c["choices"][0]["delta"].get("content") or ""
+                   for c in _sse_chunks(text) if "choices" in c)
+
+
+def _events(router, rid):
+    tl = router.timelines.get(rid)
+    assert tl is not None, f"no router timeline for {rid}"
+    return tl["events"]
+
+
+def test_stream_resume_spliced_byte_identical():
+    """Kill the owner mid-stream with one resume in the budget: the
+    client receives the full body byte-identical to an unbroken run on
+    the SAME socket — no error event, exactly one role chunk, every
+    spliced chunk rewritten onto the original stream's id — and the
+    router timeline shows stream_broken -> stream_resume ->
+    resume_spliced -> done."""
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, router = await mk()
+        try:
+            from cake_tpu.obs import FLEET_STREAM_RESUMES
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("heal convo",
+                                                  stream=True))
+            assert r.status == 200
+            base = await r.text()
+            owner = next(rep for rep in replicas if rep.served)
+            other = next(rep for rep in replicas if rep is not owner)
+            assert _sse_content(base) == "tok0tok1tok2tok3"
+
+            pre_ok = FLEET_STREAM_RESUMES.value(outcome="ok")
+            owner.mode = "abrupt"       # sever after 2 content chunks
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("heal convo",
+                                                  stream=True))
+            assert r.status == 200
+            rid = r.headers["X-Cake-Request-Id"]
+            text = await r.text()
+            # zero client-visible errors, full greedy body, clean end
+            assert "replica_stream_broken" not in text
+            assert _sse_content(text) == _sse_content(base)
+            assert text.rstrip().endswith("data: [DONE]")
+            chunks = [c for c in _sse_chunks(text) if "choices" in c]
+            assert sum(1 for c in chunks
+                       if "role" in c["choices"][0]["delta"]) == 1
+            # spliced chunks are renumbered onto the FIRST stream's id
+            assert {c["id"] for c in chunks} \
+                == {f"chatcmpl-{owner.name}"}
+            # the survivor served the splice in continuation mode
+            assert other.continuations == ["tok0tok1"]
+            assert FLEET_STREAM_RESUMES.value(outcome="ok") == pre_ok + 1
+            kinds = [e["kind"] for e in _events(router, rid)]
+            for k in ("commit", "stream_broken", "stream_resume",
+                      "resume_spliced", "done"):
+                assert k in kinds, (k, kinds)
+            assert kinds.index("stream_broken") \
+                < kinds.index("stream_resume") \
+                < kinds.index("resume_spliced") < kinds.index("done")
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_stream_resume_overlap_strip():
+    """A resumed replica that re-emits the splice-boundary token (the
+    retokenization overlap case) has the duplicate stripped — the
+    client still sees the body exactly once."""
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, router = await mk()
+        try:
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("ov convo",
+                                                  stream=True))
+            assert r.status == 200
+            await r.text()
+            owner = next(rep for rep in replicas if rep.served)
+            other = next(rep for rep in replicas if rep is not owner)
+            owner.mode = "abrupt"
+            other.mode = "overlap_resume"
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("ov convo",
+                                                  stream=True))
+            assert r.status == 200
+            rid = r.headers["X-Cake-Request-Id"]
+            text = await r.text()
+            assert "replica_stream_broken" not in text
+            assert _sse_content(text) == "tok0tok1tok2tok3"
+            spliced = next(e for e in _events(router, rid)
+                           if e["kind"] == "resume_spliced")
+            assert spliced["overlap_chars"] == len("tok1")
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_stream_resume_budget_exhausted_typed_event_with_token():
+    """When the resumed stream breaks again past the budget, the typed
+    error event fires with the resume_token carrying the FULL splice
+    accounting (text relayed across both legs)."""
+    replicas, registry, mk = _fleet_client(2, stream_resumes=1)
+
+    async def run():
+        client, router = await mk()
+        try:
+            from cake_tpu.obs import FLEET_STREAM_RESUMES
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("ex convo",
+                                                  stream=True))
+            assert r.status == 200
+            await r.text()
+            owner = next(rep for rep in replicas if rep.served)
+            other = next(rep for rep in replicas if rep is not owner)
+            owner.mode = "abrupt"       # breaks after 2 content chunks
+            other.mode = "abrupt"
+            other.break_after = 1       # the splice breaks too
+            pre = FLEET_STREAM_RESUMES.value(outcome="exhausted")
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("ex convo",
+                                                  stream=True))
+            assert r.status == 200
+            text = await r.text()
+            assert "replica_stream_broken" in text
+            assert text.rstrip().endswith("data: [DONE]")
+            # the client still got everything both legs relayed
+            assert _sse_content(text) == "tok0tok1tok2"
+            err = next(json.loads(line[6:])["error"]
+                       for line in text.split("\n\n")
+                       if line.startswith("data: ")
+                       and "replica_stream_broken" in line)
+            resume = err["resume"]
+            assert resume["tokens_generated"] == 3
+            assert resume["content_chars"] == len("tok0tok1tok2")
+            assert resume["resumes_attempted"] == 1
+            tok = json.loads(base64.urlsafe_b64decode(
+                resume["resume_token"]))
+            assert tok == {"v": 1, "mode": "continue",
+                           "content_chars": 12, "tokens_generated": 3,
+                           "chunks_relayed": resume["chunks_relayed"],
+                           "resumes_attempted": 1}
+            assert FLEET_STREAM_RESUMES.value(outcome="exhausted") \
+                == pre + 1
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_client_disconnect_during_resume_not_replica_failure():
+    """A client that walks away while the SPLICED stream is relaying
+    must not count against the replica serving the resume."""
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, router = await mk()
+        try:
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("cd convo",
+                                                  stream=True))
+            assert r.status == 200
+            await r.text()
+            owner = next(rep for rep in replicas if rep.served)
+            other = next(rep for rep in replicas if rep is not owner)
+            owner.mode = "abrupt"
+            other.mode = "slow_stream"  # resume crawls: time to vanish
+            resp = await client.post("/v1/chat/completions",
+                                     json=_chat_body("cd convo",
+                                                     stream=True))
+            assert resp.status == 200
+            await resp.content.read(16)          # first bytes flowed
+            await asyncio.sleep(0.2)             # resume under way
+            resp.close()                         # client walks away
+            await asyncio.sleep(0.8)             # relay notices + unwinds
+            snap = registry.get(other.name).snapshot()
+            assert snap["state"] == HEALTHY, snap
+            assert snap["consec_fails"] == 0
+            assert snap["ejects"] == 0
+            assert snap["inflight"] == 0         # slot released
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_sampled_stream_resume_flagged():
+    """Sampled (temperature > 0) streams still resume, but the timeline
+    flags the rng-fold parity exception."""
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, router = await mk()
+        try:
+            body = _chat_body("sa convo", stream=True)
+            body["temperature"] = 0.8
+            r = await client.post("/v1/chat/completions", json=body)
+            assert r.status == 200
+            await r.text()
+            owner = next(rep for rep in replicas if rep.served)
+            owner.mode = "abrupt"
+            r = await client.post("/v1/chat/completions", json=body)
+            assert r.status == 200
+            rid = r.headers["X-Cake-Request-Id"]
+            text = await r.text()
+            assert "replica_stream_broken" not in text
+            assert _sse_content(text) == "tok0tok1tok2tok3"
+            ev = next(e for e in _events(router, rid)
+                      if e["kind"] == "stream_resume")
+            assert ev.get("sampled") is True
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_stream_hedge_pre_commit_first_byte_wins():
+    """Streamed tail hedge up to the commit point: a stalled owner does
+    not own the socket — the duplicate's first body byte claims it, the
+    loser is cancelled, and the client sees ONE clean stream."""
+    replicas, registry, mk = _fleet_client(2, hedge_ms=30.0)
+
+    async def run():
+        client, router = await mk()
+        try:
+            from cake_tpu.obs import FLEET_HEDGES
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("hs convo",
+                                                  stream=True))
+            assert r.status == 200
+            await r.text()
+            owner = next(rep for rep in replicas if rep.served)
+            other = next(rep for rep in replicas if rep is not owner)
+            pre = FLEET_HEDGES.value()
+            fleet_faults.install(f"replica={owner.name};stall_ms=1500")
+            try:
+                t0 = asyncio.get_event_loop().time()
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat_body("hs convo",
+                                                      stream=True))
+                text = await r.text()
+                wall = asyncio.get_event_loop().time() - t0
+                assert r.status == 200
+                assert wall < 1.0, wall      # did not wait out the stall
+                assert FLEET_HEDGES.value() == pre + 1
+                assert _sse_content(text) == "tok0tok1tok2tok3"
+                chunks = [c for c in _sse_chunks(text)
+                          if "choices" in c]
+                assert sum(1 for c in chunks
+                           if "role" in c["choices"][0]["delta"]) == 1
+                assert text.rstrip().endswith("data: [DONE]")
+                assert other.served              # duplicate won the race
+            finally:
+                fleet_faults.clear()
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# weighted rendezvous
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_rendezvous_share_distribution():
+    """Owner share tracks capacity: weight 3 vs 1 converges to a 3:1
+    conversation split (0.75 +- sampling noise over 2000 keys)."""
+    names = ["big", "small"]
+    weights = {"big": 3.0, "small": 1.0}
+    big = 0
+    for i in range(2000):
+        key = affinity_key(conversation_head(_convo(f"wconvo {i}")), 4)
+        if rank_replicas(key, names, weights)[0] == "big":
+            big += 1
+    assert 0.70 <= big / 2000 <= 0.80, big / 2000
+
+
+def test_weighted_rendezvous_equal_weights_match_unweighted():
+    """Uniform weights reproduce the classic unweighted ranking exactly
+    (the log-score is monotone in the hash), so homogeneous fleets keep
+    their placement across the upgrade."""
+    names = [f"r{i}" for i in range(5)]
+    for i in range(64):
+        key = affinity_key(conversation_head(_convo(f"eq {i}")), 4)
+        assert rank_replicas(key, names) \
+            == rank_replicas(key, names, {n: 2.0 for n in names}) \
+            == rank_replicas(key, names, {})
+
+
+def test_weighted_rendezvous_affinity_stability():
+    """Raising ONE replica's weight only moves conversations TO it;
+    every key it does not win keeps its previous ranking among the
+    others — the affinity-stability property that keeps a weight bump
+    from cold-starting the whole fleet's caches."""
+    names = [f"r{i}" for i in range(4)]
+    w1 = {n: 1.0 for n in names}
+    w2 = dict(w1, r2=2.5)
+    moved = 0
+    for i in range(300):
+        key = affinity_key(conversation_head(_convo(f"st {i}")), 4)
+        a = rank_replicas(key, names, w1)
+        b = rank_replicas(key, names, w2)
+        if a[0] != b[0]:
+            moved += 1
+            assert b[0] == "r2"              # only r2 gains owners
+        assert [n for n in a if n != "r2"] \
+            == [n for n in b if n != "r2"]   # relative order preserved
+    assert 0 < moved < 300
+
+
+def test_stream_break_after_budget_complete_synthesizes_finish():
+    """A break that eats only the finish/[DONE] tail — every budgeted
+    token was already delivered — must NOT splice (a resume would decode
+    past max_tokens): the router closes the stream with a synthesized
+    finish chunk in the original stream's identity instead."""
+    replicas, registry, mk = _fleet_client(2)
+
+    async def run():
+        client, router = await mk()
+        try:
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("bf convo",
+                                                  stream=True))
+            assert r.status == 200
+            await r.text()
+            owner = next(rep for rep in replicas if rep.served)
+            other = next(rep for rep in replicas if rep is not owner)
+            owner.mode = "abrupt"
+            owner.break_after = FakeReplica.N_TOKS  # sever before finish
+            body = _chat_body("bf convo", stream=True)
+            body["max_tokens"] = FakeReplica.N_TOKS  # budget delivered
+            r = await client.post("/v1/chat/completions", json=body)
+            assert r.status == 200
+            text = await r.text()
+            assert "replica_stream_broken" not in text
+            assert _sse_content(text) == "tok0tok1tok2tok3"
+            chunks = [c for c in _sse_chunks(text) if "choices" in c]
+            assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+            assert chunks[-1]["id"] == f"chatcmpl-{owner.name}"
+            assert text.rstrip().endswith("data: [DONE]")
+            assert not other.continuations       # no splice happened
         finally:
             await client.close()
             for rep in replicas:

@@ -944,3 +944,20 @@ def test_api_stream_queue_deadline_503(model):
             await client.close()
     _run(scenario())
     eng.close()
+
+
+def test_engine_continuation_splice_bit_identical(model, engine):
+    """The mid-stream resume contract at the engine level: prefilling
+    prompt + the first k generated tokens (a continuation splice) and
+    decoding the remainder reproduces the unbroken greedy run
+    bit-for-bit — and the continuation flag rides the stats."""
+    full = engine.submit(P_LONG, max_new_tokens=10, sampling=GREEDY)
+    assert full.wait(120)
+    toks = full.result["tokens"]
+    assert toks == _ref(model, P_LONG, 10)
+    k = 4
+    resumed = engine.submit(P_LONG + toks[:k], max_new_tokens=10 - k,
+                            sampling=GREEDY, continuation=True)
+    assert resumed.wait(120)
+    assert resumed.result["tokens"] == toks[k:]
+    assert resumed.result["stats"].get("continuation") is True

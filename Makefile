@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos serve-bench-telemetry paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke telemetry-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -93,6 +93,22 @@ serve-chaos-smoke: lint
 # must preserve the typed error event (now with resume_token).
 fleet-chaos-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/fleet_chaos_smoke.py
+
+# fleet telemetry gate: 2 real engine-backed replicas behind the router,
+# a traffic burst -> live rollup (merged fleet TTFT p95 from bucket-wise
+# histogram sums, non-zero capacity headroom, burn-rate gauges on
+# /metrics), flight ring readable on demand, then one replica killed ->
+# stale + outlier(stale) within a probe window with the dead replica's
+# mirrored gauges RETRACTED from the router's /metrics (stale-mirror
+# rule; docs/telemetry.md)
+telemetry-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
+
+# telemetry rollup overhead bench: synthetic fleet scrapes driven through
+# FleetTelemetry.ingest (no sockets) — per-cycle rollup cost gated
+# < 5 ms mean. Writes BENCH_TELEM_<tag>.json.
+serve-bench-telemetry:
+	JAX_PLATFORMS=cpu python scripts/serve_bench.py --telemetry --tag r16
 
 # fleet affinity bench: 2 replicas + router, conversational follow-up
 # traffic with prefix-affinity routing vs round-robin — affinity must

@@ -1,6 +1,7 @@
 """Rule `metric-registry`: every Counter/Gauge/Histogram name constructed
 under cake_tpu/ must appear in the generated metric catalog
-(docs/observability.md).
+(docs/observability.md) — and SLO-semantic histograms must share bucket
+boundaries.
 
 The knob-registry rule's shape, pointed at instruments: the catalog is
 generated from the canonical declarations in cake_tpu/obs/__init__.py
@@ -10,6 +11,15 @@ anywhere else — or added to obs/__init__.py without regenerating the doc
 hand-written observability page three subsystems stale. Registration is
 idempotent by design, so nothing STOPS a module minting its own series;
 this rule is what makes that visible.
+
+The bucket-consistency half exists for the fleet telemetry plane: it
+merges per-replica SLO histograms BUCKET-WISE (fleet/telemetry.py), and
+summing misaligned buckets silently produces garbage percentiles. So
+every `cake_*_seconds` histogram carrying an SLO semantic (ttft / itl /
+e2e in its name) must use the shared LATENCY_BUCKETS boundaries —
+either by omitting `buckets` (the default), naming LATENCY_BUCKETS, or
+spelling out a literal equal to it; and two same-semantic histograms in
+one file must agree with each other.
 
 Only literal `cake_*` first arguments to `.counter(` / `.gauge(` /
 `.histogram(` calls are checked: dynamic names cannot be verified
@@ -28,6 +38,12 @@ _CATALOG_REL = os.path.join("docs", "observability.md")
 _NAME_RE = re.compile(r"`(cake_[a-z0-9_]+)`")
 _REGISTRY_METHODS = ("counter", "gauge", "histogram")
 
+# SLO semantics whose histograms the fleet tier merges bucket-wise
+_SLO_SEM_RE = re.compile(r"(?:^|_)(ttft|itl|e2e)_seconds$")
+
+# the one sanctioned boundary set for SLO-semantic histograms
+_CANONICAL_SIG = "default"
+
 
 def catalog_names() -> frozenset:
     """Metric names the generated catalog documents (backticked
@@ -42,11 +58,47 @@ def catalog_names() -> frozenset:
         return frozenset()
 
 
+def _bucket_signature(call: ast.Call) -> str | None:
+    """Stable string signature of a histogram call's bucket boundaries:
+    "default" for an omitted kwarg or the LATENCY_BUCKETS name (possibly
+    attribute-qualified), the literal values for a constant tuple/list,
+    None when unverifiable (a computed expression)."""
+    buckets = None
+    for kw in call.keywords:
+        if kw.arg == "buckets":
+            buckets = kw.value
+            break
+    if buckets is None and len(call.args) >= 4:
+        buckets = call.args[3]
+    if buckets is None:
+        return "default"
+    if isinstance(buckets, ast.Name) and buckets.id == "LATENCY_BUCKETS":
+        return "default"
+    if isinstance(buckets, ast.Attribute) \
+            and buckets.attr == "LATENCY_BUCKETS":
+        return "default"
+    if isinstance(buckets, (ast.Tuple, ast.List)):
+        vals = []
+        for el in buckets.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, (int, float))):
+                return None
+            vals.append(float(el.value))
+        from ..obs.metrics import LATENCY_BUCKETS
+        if tuple(sorted(vals)) == tuple(float(b) for b in LATENCY_BUCKETS):
+            return "default"
+        return "(" + ",".join(repr(v) for v in vals) + ")"
+    return None
+
+
 class MetricRegistryChecker(Checker):
     name = "metric-registry"
     doc = ("Counter/Gauge/Histogram names constructed under cake_tpu/ "
            "must appear in the generated metric catalog "
-           "(docs/observability.md; regenerate with `make metrics-doc`)")
+           "(docs/observability.md; regenerate with `make metrics-doc`), "
+           "and SLO-semantic (ttft/itl/e2e) *_seconds histograms must "
+           "share the LATENCY_BUCKETS boundaries so fleet-level "
+           "bucket-wise merges stay sound")
 
     def __init__(self):
         self._catalog: frozenset | None = None
@@ -57,6 +109,10 @@ class MetricRegistryChecker(Checker):
     def check(self, sf: SourceFile):
         if self._catalog is None:
             self._catalog = catalog_names()
+        # per-semantic bucket signatures seen in THIS file (same-file
+        # drift is the realistic failure: the canonical declarations all
+        # live in obs/__init__.py)
+        seen_sigs: dict[str, tuple[str, int]] = {}
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
@@ -75,6 +131,44 @@ class MetricRegistryChecker(Checker):
                     f"metric {arg.value!r} is not in the generated "
                     "catalog — declare it in cake_tpu/obs/__init__.py "
                     "and run `make metrics-doc`")
+            if fn.attr == "histogram":
+                yield from self._check_buckets(sf, node, arg.value,
+                                               seen_sigs)
+
+    def _check_buckets(self, sf: SourceFile, node: ast.Call, name: str,
+                       seen_sigs: dict):
+        """SLO-semantic histograms (ttft/itl/e2e *_seconds) must share
+        boundaries: the fleet telemetry plane sums their buckets across
+        replicas, and a mismatched declaration makes those percentiles
+        silently wrong."""
+        m = _SLO_SEM_RE.search(name)
+        if not m:
+            return
+        sem = m.group(1)
+        sig = _bucket_signature(node)
+        if sig is None:
+            yield Violation(
+                self.name, sf.rel, node.lineno,
+                f"SLO histogram {name!r} ({sem}) passes buckets this "
+                "rule cannot verify statically — use the shared "
+                "LATENCY_BUCKETS (fleet rollups merge these bucket-wise)")
+            return
+        if sig != _CANONICAL_SIG:
+            yield Violation(
+                self.name, sf.rel, node.lineno,
+                f"SLO histogram {name!r} ({sem}) declares buckets "
+                f"{sig} != the shared LATENCY_BUCKETS — fleet-level "
+                "bucket-wise merging of same-semantic histograms "
+                "produces garbage percentiles on mismatched boundaries")
+        prior = seen_sigs.get(sem)
+        if prior is not None and prior[0] != sig:
+            yield Violation(
+                self.name, sf.rel, node.lineno,
+                f"SLO histogram {name!r} ({sem}) buckets differ from "
+                f"the same-semantic declaration at line {prior[1]} — "
+                "same-semantic histograms must be bucket-identical")
+        else:
+            seen_sigs.setdefault(sem, (sig, node.lineno))
 
 
 register(MetricRegistryChecker)

@@ -166,6 +166,35 @@ async def slo(request: web.Request) -> web.Response:
     return web.json_response(out)
 
 
+async def flight(request: web.Request) -> web.Response:
+    """Flight-recorder-on-demand: the serve engine's scheduler-iteration
+    ring as JSON, WITHOUT waiting for a wedge/DOWN dump — a read-only
+    snapshot (the recorder's own lock, no scheduler pause) so `cake top`
+    and the profiling workflow can inspect a live engine. 409 when no
+    engine (or no recorder) is attached to this process."""
+    state: ApiState = request.app["state"]
+    engine = getattr(state, "engine", None)
+    recorder = getattr(engine, "flight", None) if engine is not None \
+        else None
+    if recorder is None:
+        return web.json_response(
+            {"error": "no serve engine (or flight recorder) in this "
+                      "process — flight records scheduler iterations"},
+            status=409)
+    iterations = recorder.snapshot()
+    n = request.query.get("n")
+    if n is not None:
+        try:
+            iterations = iterations[-max(int(n), 0):]
+        except ValueError:
+            pass
+    return web.json_response({
+        "capacity": recorder.capacity,
+        "count": len(iterations),
+        "iterations": iterations,
+    })
+
+
 async def health(request: web.Request) -> web.Response:
     state: ApiState = request.app["state"]
     workers = worker_health(state.model)

@@ -17,6 +17,7 @@ Routes:
   GET  /api/v1/requests/{rid}   one request's lifecycle timeline
                                 (?format=perfetto for Chrome-trace)
   GET  /api/v1/slo              TTFT/ITL/e2e histograms + exemplar ids
+  GET  /api/v1/flight           flight recorder ring on demand (?n=K)
   GET  /                        embedded web UI
 """
 from __future__ import annotations
@@ -107,6 +108,7 @@ def create_app(state: ApiState, basic_auth: str | None = None) -> web.Applicatio
     app.router.add_get("/api/v1/requests/{rid}",
                        obs_routes.request_timeline)
     app.router.add_get("/api/v1/slo", obs_routes.slo)
+    app.router.add_get("/api/v1/flight", obs_routes.flight)
     app.router.add_get("/", ui_routes.index)
     return app
 

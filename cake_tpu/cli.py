@@ -6,6 +6,7 @@ run | serve | pull | list | chat | rm | split | worker).
     cake-tpu worker --name w0 --cluster-key K     worker node
     cake-tpu serve MODEL [--port 8000]            OpenAI-compatible API + UI
     cake-tpu chat MODEL | --api URL               terminal chat
+    cake-tpu top [--api URL]                      live fleet dashboard
     cake-tpu pull/list/rm                          model cache management
     cake-tpu split MODEL TOPOLOGY OUT             per-worker weight bundles
 """
@@ -272,6 +273,17 @@ def cmd_route(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """Live fleet dashboard: render the router's telemetry rollup
+    (burn rates, headroom, per-replica SLO rows) in the terminal."""
+    from .fleet.top import run_top
+    url = args.api
+    if "://" not in url:
+        url = "http://" + url
+    return run_top(url, interval_s=args.interval, once=args.once,
+                   plain=args.plain, timeout_s=args.timeout)
+
+
 def cmd_worker(args) -> int:
     from .cluster import run_worker
     if not args.cluster_key:
@@ -446,6 +458,21 @@ def main(argv=None) -> int:
                    help="PSK for UDP discovery of `cake serve --announce` "
                         "replicas (CAKE_CLUSTER_KEY also works)")
     p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser("top", help="live fleet dashboard (telemetry "
+                                   "rollup from a `route` process)")
+    p.add_argument("--api", default="127.0.0.1:8100",
+                   help="fleet router base URL (default 127.0.0.1:8100)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    p.add_argument("--once", action="store_true",
+                   help="print one plain-text snapshot and exit")
+    p.add_argument("--plain", action="store_true",
+                   help="plain text instead of curses (implied when "
+                        "stdout is not a tty)")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="per-fetch HTTP timeout in seconds")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("worker", help="run as a cluster worker")
     p.add_argument("--name", default=os.uname().nodename)

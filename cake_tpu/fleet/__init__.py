@@ -5,18 +5,22 @@ One engine survives crashes (serve/supervisor.py) and worker death
 health-driven membership with a gray-failure eject -> half-open ->
 readmit machine (registry.py), prefix-affinity routing with
 deterministic failover (routing.py), router-level overload control and
-the `cake route` process itself (router.py), and the chaos drill seam
-(faults.py). docs/fleet.md is the operator guide.
+the `cake route` process itself (router.py), the chaos drill seam
+(faults.py), and the telemetry plane that rolls per-replica signals up
+into burn rates / headroom / anomaly flags (telemetry.py — the feed the
+autoscaler and `cake top` consume). docs/fleet.md and docs/telemetry.md
+are the operator guides.
 """
 from .registry import (EJECTED, HALF_OPEN, HEALTHY, MembershipPolicy,
                        Replica, ReplicaRegistry, discover_replicas)
 from .router import FleetRouter, create_router_app, serve_router
 from .routing import (AFFINITY_BLOCK, affinity_key, conversation_head,
                       rank_replicas)
+from .telemetry import FleetTelemetry
 
 __all__ = [
     "Replica", "ReplicaRegistry", "MembershipPolicy", "discover_replicas",
     "HEALTHY", "EJECTED", "HALF_OPEN",
-    "FleetRouter", "create_router_app", "serve_router",
+    "FleetRouter", "create_router_app", "serve_router", "FleetTelemetry",
     "affinity_key", "conversation_head", "rank_replicas", "AFFINITY_BLOCK",
 ]

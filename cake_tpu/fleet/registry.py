@@ -46,7 +46,8 @@ from dataclasses import dataclass
 from .. import knobs
 from ..obs import (FLEET_EJECTS, FLEET_READMITS, FLEET_REPLICAS,
                    FLEET_REPLICA_INFLIGHT, FLEET_REPLICA_OCCUPANCY,
-                   FLEET_REPLICA_QUEUE_DEPTH, now)
+                   FLEET_REPLICA_OUTLIER, FLEET_REPLICA_QUEUE_DEPTH,
+                   FLEET_REPLICA_STALE, now)
 
 __all__ = ["Replica", "ReplicaRegistry", "MembershipPolicy",
            "discover_replicas", "HEALTHY", "EJECTED", "HALF_OPEN"]
@@ -126,6 +127,10 @@ class Replica:
         self.last_probe_ok = None       # guarded-by: self._lock
         self.ejects = 0                 # guarded-by: self._lock
         self.readmits = 0               # guarded-by: self._lock
+        # telemetry-plane anomaly flag (fleet/telemetry.py writes it
+        # once per rollup cycle; /fleet surfaces it without ejecting)
+        self.outlier = False            # guarded-by: self._lock
+        self.outlier_reason = None      # guarded-by: self._lock
 
     # -- capacity -----------------------------------------------------------
 
@@ -262,6 +267,17 @@ class Replica:
                 self.last_probe_ok = False
                 self.probe_ok_streak = 0
                 self.consec_fails += 1
+                # stale-mirror retraction: the queue-depth / occupancy
+                # gauges mirror a /health body that no longer exists —
+                # delete the labelsets (a scrape sees the series
+                # DISAPPEAR, not freeze) and raise the companion stale
+                # flag so rollups/dashboards exclude this replica
+                # instead of averaging its last numbers forever. The
+                # inflight gauge stays: it counts the router's OWN
+                # proxied requests, which are real until they fail.
+                FLEET_REPLICA_QUEUE_DEPTH.remove(replica=self.name)
+                FLEET_REPLICA_OCCUPANCY.remove(replica=self.name)
+                FLEET_REPLICA_STALE.set(1, replica=self.name)
                 if self.state == HALF_OPEN:
                     return self._eject("health")
                 if (self.state == HEALTHY
@@ -278,6 +294,7 @@ class Replica:
             FLEET_REPLICA_QUEUE_DEPTH.set(self.queue_depth,
                                           replica=self.name)
             FLEET_REPLICA_OCCUPANCY.set(self.occupancy, replica=self.name)
+            FLEET_REPLICA_STALE.set(0, replica=self.name)
             sick = bool(engine.get("down") or engine.get("wedged")
                         or engine.get("alive") is False)
             self.last_probe_ok = not sick
@@ -347,6 +364,16 @@ class Replica:
             self._transition(HEALTHY)
         FLEET_READMITS.inc(replica=self.name)
 
+    def set_outlier(self, flag: bool, reason: str | None = None) -> None:
+        """Telemetry-plane anomaly flag (fleet/telemetry.py, once per
+        rollup cycle): surfaced in /fleet and the outlier gauge, but
+        NEVER a membership input — flagging is advisory, ejection stays
+        the state machine's call."""
+        with self._lock:
+            self.outlier = bool(flag)
+            self.outlier_reason = reason if flag else None
+        FLEET_REPLICA_OUTLIER.set(1 if flag else 0, replica=self.name)
+
     # -- views ---------------------------------------------------------------
 
     def routable(self) -> bool:
@@ -374,6 +401,9 @@ class Replica:
                 "ejects": self.ejects,
                 "readmits": self.readmits,
                 "last_probe_ok": self.last_probe_ok,
+                "stale": self.last_probe_ok is False,
+                "outlier": self.outlier,
+                "outlier_reason": self.outlier_reason,
             }
 
 
@@ -406,9 +436,15 @@ class ReplicaRegistry:
         return rep
 
     def remove(self, name: str) -> bool:
-        """Leave: drop the replica from routing entirely."""
+        """Leave: drop the replica from routing entirely, retracting its
+        per-replica labelsets so scrapes don't carry a ghost forever."""
         with self._lock:
             gone = self._replicas.pop(name, None) is not None
+        if gone:
+            for gauge in (FLEET_REPLICA_QUEUE_DEPTH,
+                          FLEET_REPLICA_OCCUPANCY, FLEET_REPLICA_INFLIGHT,
+                          FLEET_REPLICA_STALE, FLEET_REPLICA_OUTLIER):
+                gauge.remove(replica=name)
         self.publish()
         return gone
 

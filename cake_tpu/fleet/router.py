@@ -55,6 +55,7 @@ from ..obs import (FLEET_HEDGES, FLEET_PROXIED, FLEET_RETRIES, FLEET_SHEDS,
 from . import faults
 from .registry import ReplicaRegistry, discover_replicas
 from .routing import affinity_key, conversation_head, rank_replicas
+from .telemetry import FleetTelemetry
 
 log = logging.getLogger("cake_tpu.fleet")
 
@@ -208,6 +209,9 @@ class FleetRouter:
         # (tests, smokes, embedded topologies) must keep its
         # replica-tier timeline distinct from the router's
         self.timelines = TimelineStore()
+        # telemetry plane: fed by the probe loop, served by
+        # /api/v1/fleet/telemetry (and the `cake top` dashboard)
+        self.telemetry = FleetTelemetry(registry)
         self._tasks: list = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -269,6 +273,15 @@ class FleetRouter:
         await asyncio.gather(*(probe(r)
                                for r in self.registry.replicas()))
         self.registry.publish()
+        # same cadence as the probes: scrape /metrics and roll up the
+        # telemetry plane (stale replicas were just flagged above, so
+        # this cycle's rollup already excludes them)
+        try:
+            await self.telemetry.step(self.session)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("telemetry rollup failed (cycle skipped)")
 
     async def _probe_loop(self):
         """Health-driven membership: every tick consumes each replica's
@@ -1230,6 +1243,12 @@ class FleetRouter:
     async def handle_fleet(self, request: web.Request) -> web.Response:
         return web.json_response(self.registry.snapshot())
 
+    async def handle_fleet_telemetry(self,
+                                     request: web.Request) -> web.Response:
+        """Decision-grade rollups (fleet/telemetry.py): series, burn
+        rates, headroom, outliers — the autoscaler/`cake top` feed."""
+        return web.json_response(self.telemetry.snapshot())
+
     async def handle_request_index(self,
                                    request: web.Request) -> web.Response:
         return web.json_response({"requests": self.timelines.ids()})
@@ -1293,6 +1312,8 @@ def create_router_app(router: FleetRouter) -> web.Application:
     app.router.add_get("/v1/models", router.handle_models)
     app.router.add_get("/health", router.handle_health)
     app.router.add_get("/fleet", router.handle_fleet)
+    app.router.add_get("/api/v1/fleet/telemetry",
+                       router.handle_fleet_telemetry)
     app.router.add_get("/api/v1/requests", router.handle_request_index)
     app.router.add_get("/api/v1/requests/{rid}",
                        router.handle_request_trace)

@@ -249,6 +249,33 @@ _knob("CAKE_FLEET_FAULT_PLAN", str, None, "fleet",
       'deterministic router fault injection (tests/drills only), e.g. '
       '"replica=r1;refuse_after_ops=3" — see fleet/faults.py')
 
+# -- telemetry (fleet rollups, SLO objectives) ----------------------------
+_knob("CAKE_SLO_TTFT_MS", float, 2000.0, "telemetry",
+      "fleet TTFT objective in milliseconds: a request whose serve-side "
+      "TTFT lands in a histogram bucket above this counts as BAD in the "
+      "burn-rate computation (alongside errored requests)")
+_knob("CAKE_SLO_ERR_RATE", float, 0.01, "telemetry",
+      "fleet error budget as a bad-request fraction: burn rate = "
+      "windowed bad fraction / this, so burn > 1 means the budget is "
+      "burning faster than it accrues and burn = 1 exactly spends it")
+_knob("CAKE_TELEM_FAST_WINDOW_S", float, 300.0, "telemetry",
+      "fast burn-rate window (page-worthy: a high burn here means the "
+      "budget dies in hours) — also the window for headroom token rates")
+_knob("CAKE_TELEM_SLOW_WINDOW_S", float, 3600.0, "telemetry",
+      "slow burn-rate window (ticket-worthy sustained burn); also the "
+      "retention window of every telemetry ring, so it bounds how much "
+      "history /api/v1/fleet/telemetry can return")
+_knob("CAKE_TELEM_RING", int, 4096, "telemetry",
+      "hard per-series sample cap backing the fixed-window rings — a "
+      "memory bound independent of probe rate x window length")
+_knob("CAKE_TELEM_OUTLIER_K", float, 3.0, "telemetry",
+      "anomaly threshold: a replica whose TTFT p95 or error rate sits "
+      "more than k robust standard deviations (MAD-scaled) from the "
+      "fleet median is flagged `outlier` in /fleet — never auto-ejected")
+_knob("CAKE_TELEM_OUTLIER_MIN_N", int, 3, "telemetry",
+      "minimum live replicas before outlier detection runs (a median "
+      "over 2 replicas cannot say which one is wrong)")
+
 # -- cluster --------------------------------------------------------------
 _knob("CAKE_CLUSTER_KEY", str, None, "cluster",
       "pre-shared key enabling distributed mode (mutual auth between "
@@ -307,6 +334,7 @@ _AREA_TITLES = (
     ("qos", "QoS (unified admission plane)"),
     ("spec", "Speculative decoding"),
     ("fleet", "Fleet (router tier over N serve replicas)"),
+    ("telemetry", "Telemetry (fleet rollups, SLO objectives)"),
     ("cluster", "Cluster (distributed pipeline + fault tolerance)"),
     ("obs", "Observability"),
     ("ops", "Ops / kernels"),

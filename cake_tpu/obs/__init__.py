@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from .metrics import (Counter, Gauge, Histogram, LATENCY_BUCKETS,
                       MetricsRegistry, REGISTRY)
+from .series import Series, SeriesBank
 from .spans import (RECORDER, SPAN_CATALOG, SpanRecorder,
                     current_request_id, jax_trace, new_request_id,
                     request_scope, set_request_id)
@@ -322,6 +323,40 @@ FLEET_STREAM_RESUMES = REGISTRY.counter(
     labelnames=("outcome",))        # ok | broken | error | exhausted |
                                     # overflow
 
+# -- fleet telemetry plane (rollups the autoscaler will consume) -------------
+# Computed once per probe cycle by fleet/telemetry.py from the in-process
+# time-series rings — these are the decision-grade reductions (burn rate,
+# headroom, anomaly flags), not raw mirrors.
+
+FLEET_SLO_BURN_RATE = REGISTRY.gauge(
+    "cake_fleet_slo_burn_rate",
+    "Fleet SLO burn rate per alerting window (fast ~5m, slow ~1h): the "
+    "windowed bad-request fraction (TTFT over CAKE_SLO_TTFT_MS, or "
+    "errored) divided by the CAKE_SLO_ERR_RATE error budget; > 1 means "
+    "the budget is burning faster than it accrues",
+    labelnames=("window",))         # fast | slow
+
+FLEET_HEADROOM_TOKENS = REGISTRY.gauge(
+    "cake_fleet_headroom_tokens_per_s",
+    "Estimated spare fleet decode capacity in tokens/s: per healthy "
+    "replica, observed per-slot token rate x free slots x KV-free "
+    "fraction, summed fleet-wide — the capacity signal the autoscaler "
+    "scales on")
+
+FLEET_REPLICA_OUTLIER = REGISTRY.gauge(
+    "cake_fleet_replica_outlier",
+    "1 while the replica's TTFT p95 or error rate diverges more than "
+    "CAKE_TELEM_OUTLIER_K robust standard deviations from the fleet "
+    "median (flagged in /fleet, never auto-ejected)",
+    labelnames=("replica",))
+
+FLEET_REPLICA_STALE = REGISTRY.gauge(
+    "cake_fleet_replica_stale",
+    "1 while the replica's last probe failed, so its mirrored gauges "
+    "(queue depth, occupancy) have been retracted and telemetry rollups "
+    "exclude it",
+    labelnames=("replica",))
+
 CLUSTER_STAGE_FAILURES = REGISTRY.counter(
     "cake_cluster_stage_failures_total",
     "Classified remote-hop failures observed by the master",
@@ -384,4 +419,7 @@ __all__ = [
     "FLEET_REPLICA_OCCUPANCY", "FLEET_REPLICA_INFLIGHT", "FLEET_SHEDS",
     "FLEET_EJECTS", "FLEET_READMITS", "FLEET_RETRIES", "FLEET_HEDGES",
     "FLEET_PROXIED", "FLEET_STREAM_RESUMES",
+    "FLEET_SLO_BURN_RATE", "FLEET_HEADROOM_TOKENS",
+    "FLEET_REPLICA_OUTLIER", "FLEET_REPLICA_STALE",
+    "Series", "SeriesBank",
 ]

@@ -45,6 +45,8 @@ cluster stages.
 | `GET /api/v1/requests` | recent request ids with retrievable timelines |
 | `GET /api/v1/requests/<id>` | one request's typed lifecycle timeline (`?format=perfetto` for Chrome-trace instant events); on the fleet router this view STITCHES the router tier's events onto the replica's |
 | `GET /api/v1/slo` | the serve TTFT / inter-token / e2e histograms by outcome as JSON, each bucket carrying its sampled exemplar request id |
+| `GET /api/v1/flight` | flight-recorder-on-demand: the scheduler-iteration ring as JSON without waiting for a wedge/DOWN dump (`?n=K` for the newest K; 409 without an engine) |
+| `GET /api/v1/fleet/telemetry` | ROUTER ONLY: the fleet telemetry rollup — time-series, burn rates, headroom, outliers (see [telemetry.md](telemetry.md)) |
 
 ## Request-scoped tracing
 
@@ -73,7 +75,23 @@ paged-pool free/used) into a ring of the last `CAKE_FLIGHT_RECORDER`
 iterations. The supervisor dumps the ring to `CAKE_TRACE_DIR` as JSON
 when the wedge watchdog flags a stuck dispatch or the rebuild budget
 puts the engine DOWN — the post-mortem for the wedge failure mode where
-the process usually gets killed with the evidence in memory.
+the process usually gets killed with the evidence in memory. The same
+ring is readable ON DEMAND at `GET /api/v1/flight` (a lock-protected
+read-only snapshot) — `cake top` and the profiling workflow inspect a
+live engine without waiting for a failure.
+
+## Fleet telemetry plane
+
+The router rolls per-replica signals up into decision-grade series once
+per probe cycle: fleet-merged SLO percentiles (bucket-wise histogram
+sums), multi-window burn rates (`cake_fleet_slo_burn_rate{window}`),
+capacity headroom (`cake_fleet_headroom_tokens_per_s`), and per-replica
+anomaly flags (`cake_fleet_replica_outlier`, with
+`cake_fleet_replica_stale` marking probe-dead replicas whose mirrored
+gauges were retracted). Served at `GET /api/v1/fleet/telemetry` and
+rendered live by `cake top`. [telemetry.md](telemetry.md) is the
+operator guide (series model, burn-rate formula, headroom model,
+outlier rule).
 
 ## SLO accounting
 

@@ -63,6 +63,15 @@ class _Metric:
         with self._lock:
             self._values.clear()
 
+    def remove(self, **labels) -> bool:
+        """Delete one labelset's samples (Prometheus client `remove()`
+        semantics). The fleet tier uses this to retract a dead replica's
+        mirrored gauges so scrapes see the series disappear instead of a
+        frozen last value. Returns whether the labelset existed."""
+        key = self._key(labels)
+        with self._lock:
+            return self._values.pop(key, None) is not None
+
     def labelsets(self) -> list[dict]:
         """The label combinations observed so far (empty dict for an
         unlabeled metric with samples) — lets JSON surfaces enumerate a
@@ -173,6 +182,12 @@ class Histogram(_Metric):
         with self._lock:
             self._values.clear()
             self._exemplars.clear()
+
+    def remove(self, **labels) -> bool:
+        key = self._key(labels)
+        with self._lock:
+            self._exemplars.pop(key, None)
+            return self._values.pop(key, None) is not None
 
     def count(self, **labels) -> int:
         slot = self._values.get(self._key(labels))

@@ -734,6 +734,91 @@ def bench_qos(model):
     }
 
 
+TELEM_GATE_MS = 5.0
+
+
+def bench_telemetry():
+    """Telemetry rollup overhead: synthetic fleet scrapes driven through
+    FleetTelemetry.ingest — the exact per-probe-cycle work the router
+    does (parse N expositions, fold histogram rings, recompute burn /
+    headroom / percentiles / outliers + export gauges) with the network
+    taken out. A fake clock steps one probe interval per cycle so the
+    windows behave like an hour of real probing; rollup_ms is measured
+    on the real clock inside ingest. Gate: mean < TELEM_GATE_MS."""
+    from cake_tpu.fleet import MembershipPolicy, ReplicaRegistry
+    from cake_tpu.fleet.telemetry import FleetTelemetry
+    from cake_tpu.obs.metrics import LATENCY_BUCKETS
+
+    n_rep, cycles, probe_s = 8, 120, 1.0
+    edges = [float(e) for e in LATENCY_BUCKETS]
+
+    def scrape_text(rep: int, cycle: int) -> str:
+        """One replica's /metrics as the rollup sees it: the three SLO
+        histograms on the shared bucket grid, every gauge/counter family
+        replica_signals() reduces, and padding families the parser must
+        walk past — sized like a real exposition (~200 sample lines)."""
+        c = cycle + 1
+        lines = []
+        for sem in ("ttft", "itl", "e2e"):
+            cum = 0
+            for j, e in enumerate(edges):
+                cum += (j % 5) + 1 + rep
+                lines.append(f'cake_serve_{sem}_seconds_bucket'
+                             f'{{le="{e}",outcome="ok"}} {cum * c}')
+            lines.append(f'cake_serve_{sem}_seconds_bucket'
+                         f'{{le="+Inf",outcome="ok"}} {(cum + 2) * c}')
+            lines.append(f'cake_serve_{sem}_seconds_count'
+                         f'{{outcome="ok"}} {(cum + 2) * c}')
+        lines.append(f'cake_serve_e2e_seconds_count{{outcome="error"}} {c}')
+        lines.append(f'cake_generated_tokens_total{{path="serve"}} '
+                     f'{40 * c * (rep + 1)}')
+        lines.append(f'cake_serve_queue_depth {rep % 3}')
+        lines.append(f'cake_serve_slots_busy {1 + rep % 3}')
+        lines.append('cake_serve_kv_blocks_free 48')
+        lines.append('cake_serve_kv_blocks_used 16')
+        lines.append(f'cake_serve_spec_proposed_total {30 * c}')
+        lines.append(f'cake_serve_spec_accepted_total {24 * c}')
+        for i in range(140):            # realistic non-signal bulk
+            lines.append(f'cake_api_requests_total{{endpoint="/e{i}",'
+                         f'status="200"}} {c * (i + 1)}')
+        return "\n".join(lines) + "\n"
+
+    reg = ReplicaRegistry(MembershipPolicy(
+        eject_fails=2, err_window=16, err_rate=0.5,
+        degraded_ttft_ms=0.0, eject_s=0.3))
+    for i in range(n_rep):
+        rep = reg.add(f"bench{i}", f"http://bench:{i + 1}")
+        rep.observe_health(200, {"engine": {"alive": True, "slots": 4,
+                                            "queue_depth": 1}})
+    fake_t = [1000.0]
+    tel = FleetTelemetry(reg, clock=lambda: fake_t[0],
+                         fast_window_s=300.0, slow_window_s=3600.0,
+                         outlier_min_n=3)
+    per_cycle_ms = []
+    for c in range(cycles):
+        fake_t[0] += probe_s
+        body = tel.ingest({f"bench{i}": scrape_text(i, c)
+                           for i in range(n_rep)})
+        per_cycle_ms.append(body["rollup_ms"]["last"])
+    # sanity: the synthetic fleet actually exercised the full rollup
+    assert body["percentiles"]["ttft"]["count"] > 0, body["percentiles"]
+    assert body["headroom_tokens_per_s"] is not None
+    assert body["burn_rate"]["fast"] is not None
+    warm = per_cycle_ms[2:]             # first cycles pay ring setup
+    mean_ms = statistics.mean(warm)
+    return {
+        "replicas": n_rep,
+        "cycles": cycles,
+        "exposition_lines": len(scrape_text(0, 0).splitlines()),
+        "rollup_ms_mean": round(mean_ms, 3),
+        "rollup_ms_p50": round(_pctl(warm, 0.50), 3),
+        "rollup_ms_p99": round(_pctl(warm, 0.99), 3),
+        "rollup_ms_max": round(max(per_cycle_ms), 3),
+        "gate_ms": TELEM_GATE_MS,
+        "gate_ok": mean_ms < TELEM_GATE_MS,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tag", default="local")
@@ -754,7 +839,32 @@ def main() -> int:
     ap.add_argument("--qos", action="store_true",
                     help="QoS mode: weighted-fair service shares + "
                     "interactive TTFT idle vs batch-job saturation")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="telemetry mode: per-probe-cycle rollup "
+                    "overhead through FleetTelemetry.ingest on "
+                    "synthetic fleet scrapes, gated < 5 ms mean")
     args = ap.parse_args()
+
+    if args.telemetry:
+        out = {
+            "bench": "fleet-telemetry",
+            "ts": int(time.time()),
+            "config": {"replicas": 8, "cycles": 120,
+                       "fast_window_s": 300.0, "slow_window_s": 3600.0,
+                       "platform": "cpu"},
+            "telemetry": bench_telemetry(),
+        }
+        path = args.out or f"BENCH_TELEM_{args.tag}.json"
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(json.dumps(out, indent=2))
+        print(f"\nwrote {path}", file=sys.stderr)
+        if not out["telemetry"]["gate_ok"]:
+            print(f"FAIL: telemetry rollup mean "
+                  f"{out['telemetry']['rollup_ms_mean']}ms >= "
+                  f"{TELEM_GATE_MS}ms per probe cycle", file=sys.stderr)
+            return 1
+        return 0
 
     if args.qos:
         model = TextModel(tiny_config("llama"), dtype=jnp.float32,

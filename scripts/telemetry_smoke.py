@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Telemetry-plane smoke: 2 real engine-backed serve replicas behind the
+fleet router, a traffic burst, then one replica killed — the telemetry
+plane (docs/telemetry.md) must turn the probe stream into decision-grade
+signals.
+
+Asserts, in order:
+  1. after a concurrent chat burst the rollup is LIVE: telemetry cycles
+     advance, the merged fleet TTFT percentiles carry the burst's
+     samples (count > 0, p95 > 0), and capacity headroom is non-zero
+     (per-slot token rate was learned from real traffic);
+  2. the same signals are on the router's /metrics as autoscaler food:
+     cake_fleet_slo_burn_rate{window="fast"|"slow"} present,
+     cake_fleet_headroom_tokens_per_s > 0;
+  3. the on-demand flight recorder is readable on a live replica
+     (GET /api/v1/flight: scheduler iterations from the burst);
+  4. killing one replica flags it `stale` + outlier reason "stale" in
+     the telemetry body within a probe window or two, and the
+     STALE-MIRROR rule holds on the router's /metrics: the dead
+     replica's queue-depth/occupancy gauges are RETRACTED (no frozen
+     labelsets averaging into fleet signals), with
+     cake_fleet_replica_stale{...} 1 + cake_fleet_replica_outlier 1
+     raised in their place while the survivor's mirrors stay live.
+
+Every phase polls WITH A DEADLINE (fixed sleeps flake on this
+container's slow CPU). Exits non-zero on any missing signal. Run via
+`make telemetry-smoke`.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import aiohttp                                             # noqa: E402
+import jax.numpy as jnp                                    # noqa: E402
+from aiohttp import web                                    # noqa: E402
+from aiohttp.test_utils import TestClient, TestServer      # noqa: E402
+
+from cake_tpu.api import ApiState, create_app              # noqa: E402
+from cake_tpu.fleet import (FleetRouter, MembershipPolicy,  # noqa: E402
+                            ReplicaRegistry, create_router_app)
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+
+CTX = 128
+N_REPLICAS = 2
+MAX_NEW = 8
+
+
+class SmokeTok:
+    """Word-hash for prose, round-trip for generated ids (same contract
+    as the fleet-chaos smoke's tokenizer)."""
+
+    def encode(self, text):
+        out = []
+        for w in text.split():
+            if w[:1] == "t" and w[1:].isdigit():
+                out.append(int(w[1:]))
+            else:
+                out.append(3 + (sum(w.encode()) % 200))
+        return out[:64] or [3]
+
+    def decode(self, ids):
+        return "".join(f" t{i}" for i in ids)
+
+
+class ReplicaProc:
+    """One in-process serve replica: real engine, real HTTP socket."""
+
+    def __init__(self, name: str, model):
+        self.name = name
+        self.engine = ServeEngine(model, slots=2, max_queue=16, ctx_len=CTX)
+        self.state = ApiState(model=model, tokenizer=SmokeTok(),
+                              model_id=f"tiny-{name}")
+        self.state.engine = self.engine
+        self.runner = None
+        self.port = None
+
+    async def start(self) -> str:
+        self.runner = web.AppRunner(create_app(self.state))
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", self.port or 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def kill(self):
+        """Sever the HTTP surface abruptly — scrapes and probes must see
+        connection resets, not graceful drains."""
+        server = self.runner.server
+        for proto in list(getattr(server, "connections", []) or []):
+            tr = getattr(proto, "transport", None)
+            if tr is not None:
+                tr.abort()
+        await self.runner.cleanup()
+        self.runner = None
+
+    def close(self):
+        self.engine.close()
+
+
+async def _chat(client, convo: int, turn: int):
+    return await client.post("/v1/chat/completions", json={
+        "messages": [
+            {"role": "system", "content": "telemetry smoke system prompt "
+                                          "shared by every conversation"},
+            {"role": "user", "content": f"conversation {convo} says "
+                                        f"hello at turn {turn}"}],
+        "max_tokens": MAX_NEW, "temperature": 0.0})
+
+
+async def _poll_telemetry(client, pred, deadline_s: float, what: str):
+    deadline = time.monotonic() + deadline_s
+    body = None
+    while time.monotonic() < deadline:
+        body = await (await client.get("/api/v1/fleet/telemetry")).json()
+        if pred(body):
+            return body
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}: "
+                         f"{json.dumps(body, default=str)[:2000]}")
+
+
+def _gauge(text: str, pattern: str) -> float | None:
+    m = re.search(pattern, text, re.M)
+    return float(m.group(1)) if m else None
+
+
+async def main_async() -> dict:
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=CTX)
+    model.tokenizer = SmokeTok()
+    out: dict = {}
+    replicas = [ReplicaProc(f"r{i}", model) for i in range(N_REPLICAS)]
+    registry = ReplicaRegistry(MembershipPolicy(
+        eject_fails=2, err_window=16, err_rate=0.5,
+        degraded_ttft_ms=0.0, eject_s=0.3))
+    router = FleetRouter(registry, retries=2, backoff_s=0.01,
+                         probe_s=0.15, hedge_ms=0.0, max_inflight=0)
+    urls: dict[str, str] = {}
+    client = None
+    try:
+        for rep in replicas:
+            urls[rep.name] = await rep.start()
+            registry.add(rep.name, urls[rep.name])
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()
+
+        # -- phase 1: traffic burst -> live rollup ------------------------
+        statuses: list[int] = []
+
+        async def worker(convo: int):
+            for turn in range(4):
+                r = await _chat(client, convo, turn)
+                statuses.append(r.status)
+                await r.read()
+
+        await asyncio.gather(*[worker(c) for c in range(6)])
+        failed = [s for s in statuses if s != 200]
+        assert not failed, f"burst requests failed: {failed}"
+        out["burst_requests"] = len(statuses)
+
+        body = await _poll_telemetry(
+            client,
+            lambda b: (b.get("cycles", 0) >= 2
+                       and b.get("percentiles", {}).get("ttft", {})
+                            .get("count", 0) > 0
+                       and (b["percentiles"]["ttft"].get("p95") or 0) > 0
+                       and (b.get("headroom_tokens_per_s") or 0) > 0),
+            20.0, "live rollup (cycles, merged ttft p95, headroom)")
+        pct = body["percentiles"]["ttft"]
+        out["cycles"] = body["cycles"]
+        out["merged_ttft_p95_ms"] = round(pct["p95"] * 1000, 2)
+        out["merged_ttft_count"] = pct["count"]
+        out["headroom_tokens_per_s"] = round(body["headroom_tokens_per_s"], 2)
+        assert body["mismatched_histograms_skipped"] == 0, body
+        assert not body["stale"], body["stale"]
+        assert set(body["replicas"]) == {r.name for r in replicas}, body
+        assert body["burn_rate"]["fast"] is not None
+        assert body["series"], "fleet series rings empty"
+        assert body["rollup_ms"]["mean"] is not None
+        out["rollup_ms_mean"] = round(body["rollup_ms"]["mean"], 3)
+
+        # -- phase 2: autoscaler signals on the router's /metrics ---------
+        mtext = await (await client.get("/metrics")).text()
+        for window in ("fast", "slow"):
+            assert _gauge(
+                mtext, rf'^cake_fleet_slo_burn_rate{{window="{window}"}}'
+                       rf'\s+([0-9.e+-]+)') is not None, \
+                f"burn-rate gauge missing for window={window}"
+        headroom = _gauge(mtext, r"^cake_fleet_headroom_tokens_per_s"
+                                 r"\s+([0-9.e+-]+)")
+        assert headroom is not None and headroom > 0, \
+            f"cake_fleet_headroom_tokens_per_s not live: {headroom}"
+        out["metrics_headroom"] = round(headroom, 2)
+
+        # -- phase 3: flight recorder readable on a live replica ----------
+        async with aiohttp.ClientSession() as s:
+            async with s.get(urls[replicas[0].name]
+                             + "/api/v1/flight?n=16") as r:
+                assert r.status == 200, await r.text()
+                flight = await r.json()
+        assert flight["count"] >= 1, flight
+        assert all("seq" in it and "t" in it
+                   for it in flight["iterations"]), flight
+        out["flight_iterations"] = flight["count"]
+
+        # -- phase 4: kill one replica -> stale + outlier + retraction ----
+        victim, survivor = replicas[1], replicas[0]
+        # both mirrors live before the kill
+        for rep in replicas:
+            assert _gauge(
+                mtext, rf'^cake_fleet_replica_queue_depth{{replica='
+                       rf'"{rep.name}"}}\s+([0-9.e+-]+)') is not None, \
+                f"queue-depth mirror missing for {rep.name} pre-kill"
+        await victim.kill()
+        out["killed"] = victim.name
+
+        t_kill = time.monotonic()
+        body = await _poll_telemetry(
+            client,
+            lambda b: (victim.name in b.get("stale", [])
+                       and b.get("outliers", {}).get(victim.name) == "stale"),
+            10.0, f"{victim.name} stale + outlier(stale)")
+        out["stale_detected_s"] = round(time.monotonic() - t_kill, 2)
+        row = body["replicas"][victim.name]
+        assert row["stale"] and row["outlier"], row
+        assert not body["replicas"][survivor.name]["stale"], body
+
+        # stale-mirror rule: frozen gauges RETRACTED, stale+outlier raised
+        mtext = await (await client.get("/metrics")).text()
+        for metric in ("cake_fleet_replica_queue_depth",
+                       "cake_fleet_replica_occupancy"):
+            assert not re.search(
+                rf'^{metric}{{replica="{victim.name}"}}', mtext, re.M), \
+                f"frozen gauge contamination: {metric} still exported " \
+                f"for dead {victim.name}"
+            assert re.search(
+                rf'^{metric}{{replica="{survivor.name}"}}', mtext, re.M), \
+                f"{metric} lost for live {survivor.name}"
+        assert _gauge(mtext, rf'^cake_fleet_replica_stale{{replica='
+                             rf'"{victim.name}"}}\s+([0-9.e+-]+)') == 1.0
+        assert _gauge(mtext, rf'^cake_fleet_replica_outlier{{replica='
+                             rf'"{victim.name}"}}\s+([0-9.e+-]+)') == 1.0
+        assert _gauge(mtext, rf'^cake_fleet_replica_stale{{replica='
+                             rf'"{survivor.name}"}}\s+([0-9.e+-]+)') == 0.0
+        out["stale_mirror_retracted"] = True
+
+        # the telemetry endpoint itself stays healthy on a 1-replica fleet
+        body = await (await client.get("/api/v1/fleet/telemetry")).json()
+        assert body["headroom_tokens_per_s"] is not None
+        out["post_kill_cycles"] = body["cycles"]
+        return out
+    finally:
+        if client is not None:
+            await client.close()
+        for rep in replicas:
+            if rep.runner is not None:
+                await rep.kill()
+            rep.close()
+
+
+def main() -> int:
+    out = asyncio.new_event_loop().run_until_complete(main_async())
+    print("telemetry-smoke OK:")
+    for k, v in out.items():
+        print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

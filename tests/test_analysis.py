@@ -234,6 +234,65 @@ def test_metric_rule_scoped_to_package_and_suppressible():
     assert len(got) == 1 and got[0].suppressed
 
 
+def test_metric_rule_slo_bucket_mismatch_fires():
+    """SLO-semantic (ttft/itl/e2e *_seconds) histograms must share the
+    LATENCY_BUCKETS boundaries — the fleet telemetry plane sums their
+    buckets across replicas, and mismatched edges make the merged
+    percentiles silently wrong."""
+    src = """
+from cake_tpu.obs import REGISTRY
+from cake_tpu.obs.metrics import LATENCY_BUCKETS
+
+A = REGISTRY.histogram("cake_serve_ttft_seconds", "doc", ("outcome",))
+B = REGISTRY.histogram("cake_serve_ttft_seconds", "doc", ("outcome",),
+                       buckets=(0.1, 0.5, 1.0))
+"""
+    got = fire(src, "metric-registry")
+    assert len(got) == 2
+    assert any("!= the shared LATENCY_BUCKETS" in v.msg for v in got)
+    # the same-file same-semantic check names the declaration it differs
+    # from
+    assert any("line 5" in v.msg for v in got)
+
+
+def test_metric_rule_slo_buckets_clean_forms():
+    """Omitted buckets, the LATENCY_BUCKETS name, and the
+    attribute-qualified form all mean 'the canonical boundaries'."""
+    src = """
+from cake_tpu.obs import REGISTRY, metrics
+from cake_tpu.obs.metrics import LATENCY_BUCKETS
+
+A = REGISTRY.histogram("cake_serve_ttft_seconds", "doc", ("outcome",))
+B = REGISTRY.histogram("cake_serve_itl_seconds", "doc", ("outcome",),
+                       buckets=LATENCY_BUCKETS)
+C = REGISTRY.histogram("cake_serve_e2e_seconds", "doc", ("outcome",),
+                       buckets=metrics.LATENCY_BUCKETS)
+"""
+    assert fire(src, "metric-registry") == []
+
+
+def test_metric_rule_slo_unverifiable_buckets_fire():
+    src = """
+from cake_tpu.obs import REGISTRY
+
+def mk(edges):
+    return REGISTRY.histogram("cake_serve_e2e_seconds", "doc",
+                              ("outcome",), buckets=edges)
+"""
+    got = fire(src, "metric-registry")
+    assert len(got) == 1 and "cannot verify statically" in got[0].msg
+
+
+def test_metric_rule_non_slo_histograms_unconstrained():
+    src = """
+from cake_tpu.obs import REGISTRY
+
+H = REGISTRY.histogram("cake_api_request_seconds", "doc", ("endpoint",),
+                       buckets=(0.1, 0.5, 1.0))
+"""
+    assert fire(src, "metric-registry") == []
+
+
 def test_observability_doc_generated_and_in_sync():
     """docs/observability.md is GENERATED (metric table from the obs
     registry, span table from SPAN_CATALOG, event table from

@@ -172,6 +172,42 @@ def test_metrics_endpoint_label_bounded():
     with_client(state, scenario)
 
 
+def test_flight_endpoint_on_demand():
+    """GET /api/v1/flight serves the scheduler-iteration ring read-only
+    (?n=K truncates to the newest K); 409 without an engine — the ring
+    must be inspectable without waiting for a wedge/DOWN dump."""
+    from cake_tpu.serve.flight import FlightRecorder
+
+    state = ApiState(model=None)
+
+    async def scenario(client):
+        r = await client.get("/api/v1/flight")
+        assert r.status == 409              # no engine -> explicit error
+
+        class FakeEngine:
+            flight = FlightRecorder(capacity=8)
+        for i in range(12):                 # overflow the ring
+            FakeEngine.flight.record(iteration=i, occupancy=0.5)
+        state.engine = FakeEngine()
+        try:
+            r = await client.get("/api/v1/flight")
+            assert r.status == 200
+            body = await r.json()
+            assert body["capacity"] == 8 and body["count"] == 8
+            assert [it["iteration"] for it in body["iterations"]] == \
+                list(range(4, 12))          # oldest evicted, order kept
+            r = await client.get("/api/v1/flight?n=3")
+            body = await r.json()
+            assert [it["iteration"] for it in body["iterations"]] == \
+                [9, 10, 11]
+            r = await client.get("/api/v1/flight?n=bogus")
+            assert (await r.json())["count"] == 8   # tolerated
+        finally:
+            state.engine = None
+
+    with_client(state, scenario)
+
+
 def test_worker_health_reports_last_ok_age():
     from cake_tpu.api.obs_routes import STALE_WORKER_S, worker_health
     from cake_tpu.cluster.client import RemoteStage

@@ -1,0 +1,549 @@
+"""Fleet telemetry plane (ISSUE 16 acceptance pins).
+
+Units drive the pure-math layer with a FAKE CLOCK — no sleeps: Series
+increase/rate (reset-safe), the Prometheus text parser, bucket-wise
+histogram merging (merged p95 within 10% of the true pooled percentile
+on synthetic data — the acceptance bar), multi-window burn rates,
+capacity headroom, and the MAD outlier rule, each pinned to hand-computed
+values through FleetTelemetry.ingest(). The HTTP-level test probes a
+real router app over fake replicas serving canned /metrics text and
+pins the stale-mirror semantics: a dead replica's mirrored gauges are
+RETRACTED (labelsets deleted, stale companion set) and its frozen
+numbers never enter the rollup. `cake top`'s renderer is pure
+text-from-dict and is pinned over a canned body.
+"""
+import asyncio
+import json
+import math
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from cake_tpu.fleet import (FleetRouter, MembershipPolicy, ReplicaRegistry,
+                            create_router_app)
+from cake_tpu.fleet.telemetry import (FleetTelemetry, _HistRing,
+                                      bucket_quantile, detect_outliers,
+                                      merge_histograms, parse_prom_text,
+                                      replica_signals, ttft_over_slo)
+from cake_tpu.fleet.top import render_screen
+from cake_tpu.obs import Series, SeriesBank
+
+INF = float("inf")
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _policy(**kw):
+    base = dict(eject_fails=3, err_window=16, err_rate=0.5,
+                degraded_ttft_ms=0.0, eject_s=0.05, replica_inflight=0)
+    base.update(kw)
+    return MembershipPolicy(**base)
+
+
+def _le_str(e):
+    return "+Inf" if e == INF else repr(float(e))
+
+
+def prom_text(*, ttft=None, itl=None, e2e=None,
+              edges=(0.1, 0.25, 0.5, 1.0, INF),
+              ok=0.0, err=0.0, tokens=0.0, queue_depth=0.0,
+              slots_busy=None, kv_free=None, kv_used=None,
+              spec=(0.0, 0.0)) -> str:
+    """Synthetic replica /metrics text with exactly the families the
+    rollup consumes. ttft/itl/e2e are CUMULATIVE bucket vectors over
+    `edges` (outcome=ok)."""
+    lines = ["# HELP synthetic fixture", "# TYPE whatever counter"]
+    for sem, cum in (("ttft", ttft), ("itl", itl), ("e2e", e2e)):
+        if cum is None:
+            continue
+        for e, c in zip(edges, cum):
+            lines.append(f'cake_serve_{sem}_seconds_bucket'
+                         f'{{outcome="ok",le="{_le_str(e)}"}} {c}')
+        lines.append(f'cake_serve_{sem}_seconds_sum{{outcome="ok"}} 1.0')
+    lines.append(f'cake_serve_e2e_seconds_count{{outcome="ok"}} {ok}')
+    if err:
+        lines.append(f'cake_serve_e2e_seconds_count{{outcome="error"}} {err}')
+    lines.append(f'cake_generated_tokens_total{{path="serve"}} {tokens}')
+    lines.append(f"cake_serve_queue_depth {queue_depth}")
+    if slots_busy is not None:
+        lines.append(f"cake_serve_slots_busy {slots_busy}")
+    if kv_free is not None:
+        lines.append(f"cake_serve_kv_blocks_free {kv_free}")
+    if kv_used is not None:
+        lines.append(f"cake_serve_kv_blocks_used {kv_used}")
+    lines.append(f"cake_serve_spec_proposed_total {spec[0]}")
+    lines.append(f"cake_serve_spec_accepted_total {spec[1]}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# series rings
+# ---------------------------------------------------------------------------
+
+
+def test_series_increase_rate_and_reset():
+    clk = FakeClock()
+    s = Series("x", window_s=120.0, clock=clk)
+    s.record(0.0, t=0.0)
+    s.record(100.0, t=10.0)
+    assert s.increase(120.0) == 100.0
+    assert s.rate(120.0) == 10.0            # 100 over a 10s span
+    # counter reset mid-window (replica restart): the drop contributes
+    # nothing, counting resumes from the new baseline
+    s.record(10.0, t=20.0)
+    s.record(30.0, t=30.0)
+    assert s.increase(120.0) == 120.0       # 100 + 0 + 20
+    assert s.latest() == 30.0 and len(s) == 4
+
+
+def test_series_window_prunes_by_age():
+    clk = FakeClock()
+    s = Series("x", window_s=50.0, clock=clk)
+    for i in range(10):
+        s.record(float(i), t=i * 10.0)
+    # samples older than t=90-50 are pruned on append
+    assert all(t >= 40.0 for t, _ in s.samples())
+    # sub-window read narrows further
+    assert s.values(20.0) == [7.0, 8.0, 9.0]
+
+
+def test_series_bank_namespacing_and_drop():
+    bank = SeriesBank(60.0, clock=FakeClock())
+    bank.record("req/r0", 1.0, t=0.0)
+    bank.record("req/r1", 2.0, t=0.0)
+    bank.record("fleet/headroom", 3.0, t=0.0)
+    assert bank.names() == ["fleet/headroom", "req/r0", "req/r1"]
+    assert bank.get("req/r0").latest() == 1.0
+    bank.drop("req/")
+    assert bank.names() == ["fleet/headroom"]
+    assert bank.get("req/r0") is None
+
+
+# ---------------------------------------------------------------------------
+# prometheus text parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_prom_text_labels_prefix_and_garbage():
+    text = (
+        '# HELP cake_x stuff\n'
+        'cake_x_total{a="1",b="with,comma",c="q\\"uote"} 3\n'
+        'cake_bare 2.5\n'
+        'other_family_total{a="1"} 9\n'        # foreign prefix: skipped
+        'cake_broken{unclosed 1\n'             # tolerated, skipped
+        'cake_nan_free notanumber\n')
+    got = parse_prom_text(text)
+    assert ("cake_x_total",
+            {"a": "1", "b": "with,comma", "c": 'q"uote'}, 3.0) in got
+    assert ("cake_bare", {}, 2.5) in got
+    assert len(got) == 2
+
+
+def test_replica_signals_reduction():
+    text = prom_text(ttft=(5, 8, 9, 10, 10), ok=9.0, err=1.0,
+                     tokens=1234.0, queue_depth=3, slots_busy=2,
+                     kv_free=60, kv_used=20, spec=(100, 80))
+    sig = replica_signals(text)
+    assert sig["hist"]["ttft"] == ((0.1, 0.25, 0.5, 1.0, INF),
+                                   (5.0, 8.0, 9.0, 10.0, 10.0))
+    assert sig["requests"] == 10.0 and sig["errors"] == 1.0
+    assert sig["tokens"] == 1234.0
+    assert sig["queue_depth"] == 3.0 and sig["slots_busy"] == 2.0
+    assert sig["kv_free"] == 60.0 and sig["kv_used"] == 20.0
+    assert sig["spec_proposed"] == 100.0 and sig["spec_accepted"] == 80.0
+
+
+# ---------------------------------------------------------------------------
+# histogram math
+# ---------------------------------------------------------------------------
+
+
+def _bucketize(samples, edges):
+    """Cumulative bucket vector of `samples` over `edges` (le
+    semantics), the shape a replica's /metrics exposes."""
+    cum = []
+    for e in edges:
+        cum.append(float(sum(1 for s in samples if s <= e)))
+    return tuple(cum)
+
+
+def test_merged_p95_within_10pct_of_true_percentile():
+    """The acceptance bar: merge three replicas' bucketized latency
+    histograms and the interpolated fleet p95 must sit within 10% of
+    the true percentile of the pooled samples."""
+    import random
+    rng = random.Random(16)
+    edges = tuple(round(0.05 * i, 2) for i in range(1, 25)) + (INF,)
+    per_replica = [
+        [rng.uniform(0.10, 0.50) for _ in range(400)],
+        [rng.uniform(0.20, 0.80) for _ in range(300)],
+        [rng.uniform(0.40, 1.00) for _ in range(300)],
+    ]
+    merged = merge_histograms(
+        [(edges, _bucketize(s, edges)) for s in per_replica])
+    assert merged is not None
+    got = bucket_quantile(*merged, 0.95)
+    pooled = sorted(x for s in per_replica for x in s)
+    true_p95 = pooled[math.ceil(0.95 * len(pooled)) - 1]
+    assert abs(got - true_p95) / true_p95 < 0.10, (got, true_p95)
+    # count conservation: the +Inf bucket is the pooled sample count
+    assert merged[1][-1] == float(len(pooled))
+
+
+def test_merge_skips_mismatched_edges():
+    a = ((0.1, 1.0, INF), (1.0, 2.0, 2.0))
+    b = ((0.2, 1.0, INF), (5.0, 5.0, 5.0))     # different boundaries
+    c = ((0.1, 1.0, INF), (0.0, 1.0, 3.0))
+    edges, counts = merge_histograms([a, b, c])
+    assert edges == (0.1, 1.0, INF)
+    assert counts == (1.0, 3.0, 5.0)           # b skipped, not summed
+    assert merge_histograms([]) is None
+
+
+def test_bucket_quantile_interpolation_and_inf_clamp():
+    edges = (1.0, 2.0, 4.0, INF)
+    # 10 obs <=1, 10 in (1,2], none in (2,4], 5 beyond the last edge
+    cum = (10.0, 20.0, 20.0, 25.0)
+    assert bucket_quantile(edges, cum, 0.5) == 1.25   # 12.5th obs
+    assert bucket_quantile(edges, cum, 0.95) == 4.0   # +Inf clamps
+    assert bucket_quantile(edges, (0.0,) * 4, 0.5) is None
+    assert bucket_quantile((), (), 0.5) is None
+
+
+def test_ttft_over_slo_bucket_resolution():
+    edges = (0.1, 0.5, 1.0, INF)
+    cum = (10.0, 60.0, 90.0, 100.0)
+    assert ttft_over_slo(edges, cum, 0.5) == 40.0     # exact boundary
+    assert ttft_over_slo(edges, cum, 0.6) == 10.0     # straddling: good
+    assert ttft_over_slo(edges, cum, 5.0) == 0.0
+    assert ttft_over_slo((), (), 0.5) == 0.0
+
+
+def test_hist_ring_window_delta_and_reset():
+    clk = FakeClock()
+    ring = _HistRing(window_s=100.0, max_samples=64, clock=clk)
+    edges = (0.5, INF)
+    assert ring.window_delta(100.0) is None
+    ring.record(edges, (10.0, 20.0), t=0.0)
+    # single sample: cumulative counts ARE the delta (implicit zero)
+    assert ring.window_delta(100.0) == (edges, (10.0, 20.0))
+    ring.record(edges, (15.0, 30.0), t=10.0)
+    assert ring.window_delta(100.0) == (edges, (5.0, 10.0))
+    # replica restart: totals drop, baseline restarts from zero
+    ring.record(edges, (2.0, 4.0), t=20.0)
+    assert ring.window_delta(100.0) == (edges, (7.0, 14.0))
+    # boundary change (rolling upgrade): incomparable, start over
+    ring.record((0.9, INF), (1.0, 1.0), t=30.0)
+    assert ring.edges == (0.9, INF)
+    assert ring.window_delta(100.0) == ((0.9, INF), (1.0, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# outlier rule
+# ---------------------------------------------------------------------------
+
+
+def test_outlier_divergent_ttft_flagged_jitter_not():
+    base = {f"r{i}": {"ttft_p95_s": 0.100 + 0.001 * i, "err_rate": 0.0}
+            for i in range(4)}
+    assert detect_outliers(base, k=3.0, min_n=3) == {}
+    bad = dict(base, r9={"ttft_p95_s": 1.5, "err_rate": 0.0})
+    assert detect_outliers(bad, k=3.0, min_n=3) == {"r9": "ttft_p95"}
+
+
+def test_outlier_err_rate_and_min_n():
+    stats = {"r0": {"ttft_p95_s": None, "err_rate": 0.00},
+             "r1": {"ttft_p95_s": None, "err_rate": 0.01},
+             "r2": {"ttft_p95_s": None, "err_rate": 0.50}}
+    assert detect_outliers(stats, k=3.0, min_n=3) == {"r2": "err_rate"}
+    # below min_n the median cannot say which side is wrong
+    two = {k: stats[k] for k in ("r0", "r2")}
+    assert detect_outliers(two, k=3.0, min_n=3) == {}
+
+
+# ---------------------------------------------------------------------------
+# FleetTelemetry.ingest — fake clock, hand-computed pins
+# ---------------------------------------------------------------------------
+
+
+def _plane(n=1, *, clock=None, slots=4, **kw):
+    reg = ReplicaRegistry(_policy())
+    for i in range(n):
+        rep = reg.add(f"t{i}", f"http://h:{i + 1}")
+        rep.observe_health(200, {"engine": {"alive": True, "slots": slots,
+                                            "queue_depth": 1}})
+    base = dict(fast_window_s=300.0, slow_window_s=3600.0,
+                slo_ttft_ms=2000.0, slo_err_rate=0.01,
+                outlier_k=3.0, outlier_min_n=3, ring=256)
+    base.update(kw)
+    return reg, FleetTelemetry(reg, clock=clock or FakeClock(), **base)
+
+
+def test_ingest_burn_rate_pinned():
+    clk = FakeClock()
+    reg, tel = _plane(1, clock=clk)
+    tel.ingest({"t0": prom_text(ok=100.0)}, t=0.0)
+    body = tel.ingest({"t0": prom_text(ok=195.0, err=5.0)}, t=60.0)
+    # 100 new requests, 5 bad -> 5% bad / 1% budget = 5x in both windows
+    assert body["burn_rate"] == {"fast": 5.0, "slow": 5.0}
+    from cake_tpu.obs import FLEET_SLO_BURN_RATE
+    assert FLEET_SLO_BURN_RATE.value(window="fast") == 5.0
+    assert body["replicas"]["t0"]["err_rate"] == 0.05
+
+
+def test_ingest_burn_counts_ttft_over_objective():
+    clk = FakeClock()
+    reg, tel = _plane(1, clock=clk, slo_ttft_ms=500.0)
+    edges = (0.1, 0.5, 1.0, INF)
+    tel.ingest({"t0": prom_text(ttft=(0, 0, 0, 0), edges=edges)}, t=0.0)
+    # 100 requests, none outcome=error, but 20 finished past 0.5s TTFT
+    body = tel.ingest(
+        {"t0": prom_text(ttft=(50, 80, 95, 100), edges=edges, ok=100.0)},
+        t=60.0)
+    # bad = 100 - cum(0.5) = 20 -> 20% / 1% budget
+    assert body["burn_rate"]["fast"] == 20.0
+
+
+def test_ingest_headroom_pinned():
+    clk = FakeClock()
+    reg, tel = _plane(1, clock=clk, slots=4)
+    tel.ingest({"t0": prom_text(tokens=0.0, slots_busy=2,
+                                kv_free=50, kv_used=50)}, t=0.0)
+    body = tel.ingest({"t0": prom_text(tokens=1000.0, slots_busy=2,
+                                       kv_free=50, kv_used=50)}, t=100.0)
+    row = body["replicas"]["t0"]
+    # 1000 tok over 100s = 10 tok/s on avg 2 busy slots -> 5 tok/s/slot;
+    # 2 free slots x 0.5 KV-free fraction -> 5 tok/s headroom
+    assert row["tokens_per_s"] == 10.0
+    assert row["headroom_tokens_per_s"] == 5.0
+    assert body["headroom_tokens_per_s"] == 5.0
+    from cake_tpu.obs import FLEET_HEADROOM_TOKENS
+    assert FLEET_HEADROOM_TOKENS.value() == 5.0
+    # headroom persists after the burst ends (learned per-slot rate
+    # applied to the now-idle replica's 4 free slots + full KV)
+    body = tel.ingest({"t0": prom_text(tokens=1000.0, slots_busy=0,
+                                       kv_free=100, kv_used=0)}, t=110.0)
+    assert body["headroom_tokens_per_s"] > 5.0
+
+
+def test_ingest_accept_rate_and_spec_counters():
+    clk = FakeClock()
+    reg, tel = _plane(1, clock=clk)
+    tel.ingest({"t0": prom_text(spec=(0, 0))}, t=0.0)
+    body = tel.ingest({"t0": prom_text(spec=(100, 75))}, t=60.0)
+    assert body["replicas"]["t0"]["accept_rate"] == 0.75
+
+
+def test_ingest_merged_percentiles_and_mismatch_counter():
+    clk = FakeClock()
+    reg, tel = _plane(3, clock=clk)
+    edges = (0.1, 0.5, 1.0, INF)
+    odd = (0.2, 0.5, 1.0, INF)                 # t2: mismatched boundaries
+    tel.ingest({"t0": prom_text(ttft=(0, 0, 0, 0), edges=edges),
+                "t1": prom_text(ttft=(0, 0, 0, 0), edges=edges),
+                "t2": prom_text(ttft=(0, 0, 0, 0), edges=odd)}, t=0.0)
+    body = tel.ingest(
+        {"t0": prom_text(ttft=(10, 20, 20, 20), edges=edges),
+         "t1": prom_text(ttft=(0, 20, 40, 40), edges=edges),
+         "t2": prom_text(ttft=(5, 5, 5, 5), edges=odd)}, t=60.0)
+    ttft = body["percentiles"]["ttft"]
+    # merged deltas: (10, 40, 60, 60) over the shared edges; t2 skipped
+    assert ttft["count"] == 60.0
+    assert body["mismatched_histograms_skipped"] == 1
+    assert ttft["p50"] == bucket_quantile(edges,
+                                          (10.0, 40.0, 60.0, 60.0), 0.5)
+
+
+def test_ingest_stale_excluded_and_flagged_as_outlier():
+    clk = FakeClock()
+    reg, tel = _plane(3, clock=clk)
+    good = prom_text(ok=50.0, queue_depth=1)
+    tel.ingest({"t0": good, "t1": good, "t2": good}, t=0.0)
+    # t2 dies: scrape fails this cycle
+    body = tel.ingest({"t0": prom_text(ok=60.0, queue_depth=1),
+                       "t1": prom_text(ok=60.0, queue_depth=1),
+                       "t2": None}, t=30.0)
+    assert body["stale"] == ["t2"]
+    assert body["outliers"]["t2"] == "stale"
+    row = body["replicas"]["t2"]
+    assert row["stale"] and row["outlier"]
+    assert row["outlier_reason"] == "stale"
+    # the membership view carries the advisory flag without ejecting
+    (rep,) = [r for r in reg.replicas() if r.name == "t2"]
+    snap = rep.snapshot()
+    assert snap["outlier"] and snap["outlier_reason"] == "stale"
+    assert rep.routable()                      # advisory, never membership
+    # fleet queue depth sums LIVE replicas only
+    assert body["fleet_queue_depth"] == 2
+
+
+def test_ingest_series_and_overhead_exposed():
+    clk = FakeClock()
+    reg, tel = _plane(1, clock=clk)
+    tel.ingest({"t0": prom_text(ok=10.0)}, t=0.0)
+    body = tel.ingest({"t0": prom_text(ok=20.0)}, t=30.0)
+    assert body["cycles"] == 2
+    assert set(body["series"]) >= {"fleet/headroom", "fleet/burn_fast",
+                                   "fleet/burn_slow", "fleet/queue_depth"}
+    ages = [a for a, _ in body["series"]["fleet/burn_fast"]]
+    assert ages == [30.0, 0.0]                 # ages, not raw clocks
+    assert body["rollup_ms"]["mean"] >= 0.0
+    assert body["rollup_ms"]["max"] >= body["rollup_ms"]["last"]
+
+
+def test_snapshot_before_first_cycle_is_typed_empty():
+    reg, tel = _plane(1)
+    body = tel.snapshot()
+    assert body["cycles"] == 0 and body["replicas"] == {}
+    assert body["burn_rate"] == {"fast": 0.0, "slow": 0.0}
+    assert body["slo"]["ttft_ms"] == 2000.0
+    json.dumps(body)                           # endpoint-serializable
+
+
+# ---------------------------------------------------------------------------
+# HTTP: router endpoint + stale-mirror retraction over fake replicas
+# ---------------------------------------------------------------------------
+
+
+class FakeTelemReplica:
+    """Canned `cake serve` stand-in for the telemetry path: /health with
+    an engine block and /metrics with mutable synthetic exposition."""
+
+    def __init__(self, name):
+        self.name = name
+        self.metrics_text = prom_text()
+        self.server = None
+
+    def app(self):
+        async def health(request):
+            return web.json_response({"engine": {
+                "alive": True, "slots": 4, "queue_depth": 2,
+                "kv_pool": {"occupancy": 0.25, "blocks": 100,
+                            "blocks_free": 75}}})
+
+        async def metrics(request):
+            return web.Response(text=self.metrics_text)
+
+        app = web.Application()
+        app.router.add_get("/health", health)
+        app.router.add_get("/metrics", metrics)
+        return app
+
+    async def start(self):
+        self.server = TestServer(self.app())
+        await self.server.start_server()
+        return str(self.server.make_url(""))
+
+    async def stop(self):
+        if self.server is not None:
+            await self.server.close()
+            self.server = None
+
+
+def test_router_telemetry_endpoint_and_stale_mirror_retraction():
+    fakes = [FakeTelemReplica("tm0"), FakeTelemReplica("tm1")]
+    registry = ReplicaRegistry(_policy())
+
+    async def run():
+        for f in fakes:
+            registry.add(f.name, await f.start())
+        router = FleetRouter(registry, retries=2, backoff_s=0.001,
+                             probe_s=30.0, hedge_ms=0.0)
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()     # on_startup probed once already
+        try:
+            edges = (0.1, 0.5, 1.0, INF)
+            fakes[0].metrics_text = prom_text(
+                ttft=(10, 18, 20, 20), edges=edges, ok=20.0,
+                tokens=100.0, queue_depth=2, slots_busy=1,
+                kv_free=75, kv_used=25)
+            fakes[1].metrics_text = prom_text(
+                ttft=(5, 9, 10, 10), edges=edges, ok=10.0,
+                tokens=60.0, queue_depth=2, slots_busy=1,
+                kv_free=75, kv_used=25)
+            await router._probe_once()
+            r = await client.get("/api/v1/fleet/telemetry")
+            assert r.status == 200
+            body = await r.json()
+            assert body["cycles"] >= 2
+            assert set(body["replicas"]) == {"tm0", "tm1"}
+            # merged fleet percentiles cover BOTH replicas' counts
+            assert body["percentiles"]["ttft"]["count"] == 30.0
+            # mirrored gauges live while the replica is
+            m = await (await client.get("/metrics")).text()
+            assert 'cake_fleet_replica_queue_depth{replica="tm1"} 2' in m
+            assert 'cake_fleet_replica_stale{replica="tm1"} 0' in m
+
+            # tm1 dies; one probe window later it is stale + outlier and
+            # its mirrored gauges are RETRACTED, not frozen
+            await fakes[1].stop()
+            await router._probe_once()
+            body = await (await client.get(
+                "/api/v1/fleet/telemetry")).json()
+            assert "tm1" in body["stale"]
+            assert body["outliers"].get("tm1") == "stale"
+            m = await (await client.get("/metrics")).text()
+            assert 'cake_fleet_replica_queue_depth{replica="tm1"}' not in m
+            assert 'cake_fleet_replica_occupancy{replica="tm1"}' not in m
+            assert 'cake_fleet_replica_stale{replica="tm1"} 1' in m
+            assert 'cake_fleet_replica_outlier{replica="tm1"} 1' in m
+            # the LIVE replica's mirror is untouched
+            assert 'cake_fleet_replica_queue_depth{replica="tm0"} 2' in m
+            # registry removal retracts the whole mirror
+            registry.remove("tm1")
+            m = await (await client.get("/metrics")).text()
+            assert 'replica="tm1"' not in m
+        finally:
+            await client.close()
+            for f in fakes:
+                await f.stop()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# cake top renderer
+# ---------------------------------------------------------------------------
+
+
+def test_top_render_screen_plain():
+    body = {
+        "cycles": 7,
+        "slo": {"ttft_ms": 2000.0, "err_rate": 0.01},
+        "burn_rate": {"fast": 1.25, "slow": 0.4},
+        "headroom_tokens_per_s": 123.4, "sheds_per_s": 0.5,
+        "fleet_queue_depth": 3,
+        "percentiles": {"ttft": {"p50": 0.2, "p95": 0.9, "p99": 1.4,
+                                 "count": 42}},
+        "replicas": {
+            "r0": {"state": "healthy", "stale": False, "queue_depth": 1,
+                   "occupancy": 0.25, "inflight": 2, "ttft_p95_ms": 850.0,
+                   "err_rate": 0.02, "tokens_per_s": 55.5,
+                   "accept_rate": 0.8, "headroom_tokens_per_s": 100.0,
+                   "outlier": False, "outlier_reason": None},
+            "r1": {"state": "ejected", "stale": True, "queue_depth": 0,
+                   "occupancy": None, "inflight": 0, "ttft_p95_ms": None,
+                   "err_rate": None, "tokens_per_s": None,
+                   "accept_rate": None, "headroom_tokens_per_s": 0.0,
+                   "outlier": True, "outlier_reason": "stale"},
+        },
+    }
+    lines = render_screen(body, "http://router:8100")
+    text = "\n".join(lines)
+    assert "burn fast 1.25x" in text and "slow 0.40x" in text
+    assert "headroom 123 tok/s" in text
+    assert "p95 900ms" in text
+    r0 = next(ln for ln in lines if ln.startswith("r0"))
+    assert "healthy" in r0 and "850" in r0 and "25%" in r0 and "80%" in r0
+    r1 = next(ln for ln in lines if ln.startswith("r1"))
+    assert "stale" in r1 and "outlier" in r1
+    # absent window data renders as dashes, not zeros
+    assert " - " in r1 or r1.rstrip().endswith("-") or "  -" in r1
+    # no-replica body still renders
+    empty = render_screen({"cycles": 0})
+    assert any("no replicas" in ln for ln in empty)

@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos serve-bench-telemetry paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke telemetry-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos serve-bench-telemetry paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke fleet-soak telemetry-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -93,6 +93,16 @@ serve-chaos-smoke: lint
 # must preserve the typed error event (now with resume_token).
 fleet-chaos-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/fleet_chaos_smoke.py
+
+# closed-loop elastic-fleet gate (tier-2: real multi-process soak, not
+# part of the tier-1 pytest run): a real router with the autoscaler on
+# bootstraps 0 -> min by spawning real serve child processes, a load
+# ramp scales 2 -> 4 on starved headroom, the ramp's end scales 4 -> 2
+# through graceful drains (every reap forced=False), a kill -9 victim
+# is swept and replaced via below_min — zero client-visible errors and
+# zero frozen-gauge contamination across all of it (docs/autoscaling.md)
+fleet-soak: lint
+	JAX_PLATFORMS=cpu python scripts/fleet_soak.py
 
 # fleet telemetry gate: 2 real engine-backed replicas behind the router,
 # a traffic burst -> live rollup (merged fleet TTFT p95 from bucket-wise

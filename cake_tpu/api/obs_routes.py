@@ -39,6 +39,13 @@ ENGINE_WEDGED_S = 120.0
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# process-start anchor on the MONOTONIC clock: /health's
+# started_at_age_s counts from here. A router watching the age move
+# BACKWARD knows a NEW process answers behind the same URL (wall-clock
+# uptime_s can't say that — NTP steps it), and resets that replica's
+# warm-up clock (fleet/registry.py, CAKE_SCALE_WARMUP_S).
+_STARTED_AT = now()
+
 
 async def metrics(request: web.Request) -> web.Response:
     return web.Response(body=REGISTRY.render().encode(),
@@ -202,6 +209,7 @@ async def health(request: web.Request) -> web.Response:
     degraded = bool(stale)
     body = {
         "uptime_s": max(int(time.time()) - state.created, 0),
+        "started_at_age_s": round(now() - _STARTED_AT, 3),
         "models": [m["id"] + ":" + m["kind"] for m in state.owned_models()],
         "workers": workers,
         "stale_workers": stale,

@@ -264,12 +264,16 @@ def cmd_route(args) -> int:
             url = "http://" + url
         replicas.append((name, url))
     key = args.cluster_key or knobs.get("CAKE_CLUSTER_KEY")
-    if not replicas and not key:
-        print("error: need --replica host:port entries or --cluster-key "
-              "for UDP discovery of announced replicas", file=sys.stderr)
+    scaling = bool(args.autoscale or knobs.get("CAKE_SCALE"))
+    if not replicas and not key and not (scaling and
+                                         knobs.get_str("CAKE_SCALE_SPAWN_CMD")):
+        print("error: need --replica host:port entries, --cluster-key "
+              "for UDP discovery, or --autoscale with CAKE_SCALE_SPAWN_CMD "
+              "to bootstrap an empty fleet", file=sys.stderr)
         return 2
     from .fleet import serve_router
-    serve_router(replicas, host=args.host, port=args.port, cluster_key=key)
+    serve_router(replicas, host=args.host, port=args.port, cluster_key=key,
+                 autoscale=True if args.autoscale else None)
     return 0
 
 
@@ -457,6 +461,11 @@ def main(argv=None) -> int:
     p.add_argument("--cluster-key", default=None,
                    help="PSK for UDP discovery of `cake serve --announce` "
                         "replicas (CAKE_CLUSTER_KEY also works)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the closed-loop autoscaler (scale replicas "
+                        "out/in from telemetry; needs "
+                        "CAKE_SCALE_SPAWN_CMD to scale out — same as "
+                        "CAKE_SCALE=1, see docs/autoscaling.md)")
     p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser("top", help="live fleet dashboard (telemetry "

@@ -6,11 +6,17 @@ health-driven membership with a gray-failure eject -> half-open ->
 readmit machine (registry.py), prefix-affinity routing with
 deterministic failover (routing.py), router-level overload control and
 the `cake route` process itself (router.py), the chaos drill seam
-(faults.py), and the telemetry plane that rolls per-replica signals up
+(faults.py), the telemetry plane that rolls per-replica signals up
 into burn rates / headroom / anomaly flags (telemetry.py — the feed the
-autoscaler and `cake top` consume). docs/fleet.md and docs/telemetry.md
-are the operator guides.
+autoscaler and `cake top` consume), and the closed loop that acts on
+that feed: the pure scaling controller (autoscale.py) and the replica
+lifecycle manager that spawns/drains/reaps real serve processes
+(lifecycle.py). docs/fleet.md, docs/telemetry.md and
+docs/autoscaling.md are the operator guides.
 """
+from .autoscale import (Autoscaler, Decision, DecisionLog, ScalePolicy,
+                        decide, select_victim)
+from .lifecycle import ManagedReplica, ReplicaLifecycle
 from .registry import (EJECTED, HALF_OPEN, HEALTHY, MembershipPolicy,
                        Replica, ReplicaRegistry, discover_replicas)
 from .router import FleetRouter, create_router_app, serve_router
@@ -23,4 +29,6 @@ __all__ = [
     "HEALTHY", "EJECTED", "HALF_OPEN",
     "FleetRouter", "create_router_app", "serve_router", "FleetTelemetry",
     "affinity_key", "conversation_head", "rank_replicas", "AFFINITY_BLOCK",
+    "Autoscaler", "Decision", "DecisionLog", "ScalePolicy", "decide",
+    "select_victim", "ManagedReplica", "ReplicaLifecycle",
 ]

@@ -127,6 +127,19 @@ class Replica:
         self.last_probe_ok = None       # guarded-by: self._lock
         self.ejects = 0                 # guarded-by: self._lock
         self.readmits = 0               # guarded-by: self._lock
+        # warm-up clock: when THIS router first saw this replica (reset
+        # on re-registration and on detected in-place restart). The
+        # autoscaler holds while any replica is younger than
+        # CAKE_SCALE_WARMUP_S — a cold replica's empty histograms would
+        # misread as zero headroom and re-trigger the scale-out that
+        # just ran.
+        self.first_seen = now()         # guarded-by: self._lock
+        self._last_started_age = None   # guarded-by: self._lock
+        # lifecycle cordon: the router stops routing NEW requests here
+        # while the lifecycle manager drains + reaps it (scale-in);
+        # unlike `draining` (mirrored from the replica's own /health)
+        # this is the ROUTER's decision and survives probe updates
+        self.cordoned = False           # guarded-by: self._lock
         # telemetry-plane anomaly flag (fleet/telemetry.py writes it
         # once per rollup cycle; /fleet surfaces it without ejecting)
         self.outlier = False            # guarded-by: self._lock
@@ -167,7 +180,7 @@ class Replica:
         keeps a pre-eject request's release from clearing the trial
         flag of a probation request still running."""
         with self._lock:
-            if self.draining:
+            if self.draining or self.cordoned:
                 return None
             if self.state == HEALTHY:
                 if self.inflight >= self._cap():
@@ -285,6 +298,22 @@ class Replica:
                     return self._eject("health")
                 return None
             engine = (body or {}).get("engine") or {}
+            # in-place restart detection: /health carries a monotonic
+            # process age (started_at_age_s); the age moving BACKWARD
+            # means a new process answers behind the same URL — reset
+            # the warm-up clock so the autoscaler grants it the same
+            # grace as a freshly spawned replica
+            age = (body or {}).get("started_at_age_s")
+            if age is not None:
+                try:
+                    age = float(age)
+                except (TypeError, ValueError):
+                    age = None
+            if age is not None:
+                if self._last_started_age is not None \
+                        and age < self._last_started_age:
+                    self.first_seen = now()
+                self._last_started_age = age
             self.draining = bool((body or {}).get("draining")
                                  or engine.get("draining"))
             if engine.get("slots"):
@@ -364,6 +393,45 @@ class Replica:
             self._transition(HEALTHY)
         FLEET_READMITS.inc(replica=self.name)
 
+    def history(self) -> dict:
+        """The membership reputation that outlives removal (registry
+        tombstones): eject counts, backoff streak, and any running
+        ejection hold — what restore_history re-applies on re-announce."""
+        with self._lock:
+            return {"ejects": self.ejects,
+                    "eject_streak": self.eject_streak,
+                    "readmits": self.readmits,
+                    "eject_until": self.eject_until}
+
+    def cordon(self) -> None:
+        """Router-side drain mark (lifecycle scale-in): stop routing NEW
+        requests here; in-flight ones finish. One-way — a cordoned
+        replica is on its way out of the registry."""
+        with self._lock:
+            self.cordoned = True
+
+    def warm_age_s(self) -> float:
+        """Seconds since this router first saw the replica (re-joins and
+        detected restarts reset it) — the autoscaler's warm-up input."""
+        with self._lock:
+            return now() - self.first_seen
+
+    def restore_history(self, hist: dict) -> None:
+        """Re-apply a removed replica's eject history on re-announce
+        (registry tombstones): counts and streak carry over so the
+        backoff ladder is not laundered, and a still-running ejection
+        hold is resumed — while first_seen stays FRESH (set by
+        __init__), because the warm-up clock is about this process
+        instance, not the name's reputation."""
+        with self._lock:
+            self.ejects = int(hist.get("ejects") or 0)
+            self.eject_streak = int(hist.get("eject_streak") or 0)
+            self.readmits = int(hist.get("readmits") or 0)
+            until = float(hist.get("eject_until") or 0.0)
+            if until > now():
+                self.eject_until = until
+                self._transition(EJECTED)
+
     def set_outlier(self, flag: bool, reason: str | None = None) -> None:
         """Telemetry-plane anomaly flag (fleet/telemetry.py, once per
         rollup cycle): surfaced in /fleet and the outlier gauge, but
@@ -380,12 +448,12 @@ class Replica:
         """Eligible for NEW requests right now (half-open counts — the
         acquire path limits it to one trial)."""
         with self._lock:
-            return (not self.draining
+            return (not self.draining and not self.cordoned
                     and self.state in (HEALTHY, HALF_OPEN))
 
     def snapshot(self) -> dict:
         with self._lock:
-            state = "draining" if (self.draining
+            state = "draining" if ((self.draining or self.cordoned)
                                    and self.state == HEALTHY) else self.state
             return {
                 "name": self.name,
@@ -402,6 +470,8 @@ class Replica:
                 "readmits": self.readmits,
                 "last_probe_ok": self.last_probe_ok,
                 "stale": self.last_probe_ok is False,
+                "warm_age_s": round(now() - self.first_seen, 3),
+                "cordoned": self.cordoned,
                 "outlier": self.outlier,
                 "outlier_reason": self.outlier_reason,
             }
@@ -418,28 +488,43 @@ class ReplicaRegistry:
         self._lock = threading.Lock()
         self._replicas: dict = {}       # guarded-by: self._lock
         self._rr = 0                    # guarded-by: self._lock
+        # eject-history tombstones: a replica that leaves and
+        # re-announces under the same name must NOT launder its
+        # membership reputation (the backoff ladder restarts otherwise)
+        self._history: dict = {}        # guarded-by: self._lock
 
     # -- membership ----------------------------------------------------------
 
     def add(self, name: str, base_url: str) -> Replica:
         """Join (idempotent on name: re-announcement refreshes the URL
         but keeps membership state — a re-registered replica does not
-        launder its ejection history)."""
+        launder its ejection history). A name that LEFT and re-announces
+        gets a fresh Replica whose eject history is restored from the
+        tombstone while its first-seen warm-up clock resets — the
+        reputation is the name's, the warm-up is the process's."""
         with self._lock:
             rep = self._replicas.get(name)
             if rep is not None:
                 rep.base_url = base_url.rstrip("/")
                 return rep
             rep = Replica(name, base_url, self.policy)
+            hist = self._history.pop(name, None)
             self._replicas[name] = rep
+        if hist:
+            rep.restore_history(hist)
         self.publish()
         return rep
 
     def remove(self, name: str) -> bool:
         """Leave: drop the replica from routing entirely, retracting its
-        per-replica labelsets so scrapes don't carry a ghost forever."""
+        per-replica labelsets so scrapes don't carry a ghost forever.
+        Its eject history is kept as a tombstone for a same-name
+        re-announce (no laundering)."""
         with self._lock:
-            gone = self._replicas.pop(name, None) is not None
+            rep = self._replicas.pop(name, None)
+            gone = rep is not None
+            if gone:
+                self._history[name] = rep.history()
         if gone:
             for gauge in (FLEET_REPLICA_QUEUE_DEPTH,
                           FLEET_REPLICA_OCCUPANCY, FLEET_REPLICA_INFLIGHT,

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import dataclasses
 import json
 import logging
 import random
@@ -53,6 +54,8 @@ from .. import knobs
 from ..obs import (FLEET_HEDGES, FLEET_PROXIED, FLEET_RETRIES, FLEET_SHEDS,
                    FLEET_STREAM_RESUMES, TRACE_HEADER, TimelineStore, now)
 from . import faults
+from .autoscale import Autoscaler, DecisionLog, ScalePolicy
+from .lifecycle import ReplicaLifecycle
 from .registry import ReplicaRegistry, discover_replicas
 from .routing import affinity_key, conversation_head, rank_replicas
 from .telemetry import FleetTelemetry
@@ -173,7 +176,8 @@ class FleetRouter:
                  cluster_key: str | None = None,
                  discover_s: float | None = None,
                  stream_resumes: int | None = None,
-                 resume_buffer_kb: int | None = None):
+                 resume_buffer_kb: int | None = None,
+                 autoscale: bool | None = None):
         self.registry = registry
         self.retries = retries if retries is not None \
             else knobs.get("CAKE_FLEET_RETRIES")
@@ -212,6 +216,22 @@ class FleetRouter:
         # telemetry plane: fed by the probe loop, served by
         # /api/v1/fleet/telemetry (and the `cake top` dashboard)
         self.telemetry = FleetTelemetry(registry)
+        # closed loop: the autoscaler consumes each cycle's rollup and
+        # executes through the lifecycle manager (both None when off —
+        # CAKE_SCALE gates the subsystem, the telemetry stays advisory)
+        enabled = autoscale if autoscale is not None \
+            else knobs.get("CAKE_SCALE")
+        self.lifecycle = None
+        self.autoscaler = None
+        if enabled:
+            decisions = DecisionLog()
+            self.lifecycle = ReplicaLifecycle(registry,
+                                              record=decisions.record)
+            policy = ScalePolicy.from_knobs()
+            if autoscale:       # explicit flag wins over the env knob
+                policy = dataclasses.replace(policy, enabled=True)
+            self.autoscaler = Autoscaler(registry, self.lifecycle,
+                                         policy=policy, log=decisions)
         self._tasks: list = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -234,6 +254,8 @@ class FleetRouter:
             except (asyncio.CancelledError, Exception):
                 pass
         self._tasks.clear()
+        if self.lifecycle is not None:
+            await self.lifecycle.close()
         if self.session is not None:
             await self.session.close()
             self.session = None
@@ -282,6 +304,17 @@ class FleetRouter:
             raise
         except Exception:
             log.exception("telemetry rollup failed (cycle skipped)")
+        # the closed loop rides the same cadence: reap unexpected
+        # deaths first (the controller must see the hole this cycle),
+        # then decide on the rollup just computed
+        if self.autoscaler is not None:
+            try:
+                self.lifecycle.sweep()
+                self.autoscaler.step(self.telemetry.snapshot())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("autoscale step failed (cycle skipped)")
 
     async def _probe_loop(self):
         """Health-driven membership: every tick consumes each replica's
@@ -336,11 +369,19 @@ class FleetRouter:
         FLEET_PROXIED.inc(outcome="failed")
         if rid:
             self.timelines.event(rid, "shed", reason="no_replica")
+        # during an in-flight scale-out the honest wait is the expected
+        # spawn-to-routable time, not the backlog formula — a client
+        # arriving mid cold start should wait the spawn out, not give up
+        eta = self.lifecycle.pending_spawn_eta() \
+            if self.lifecycle is not None else None
+        body = {"error": "no routable replica (all ejected, draining, or "
+                         "none registered)", "shed_by": "router"}
+        if eta is not None:
+            body["scale_out_pending"] = True
         return web.json_response(
-            {"error": "no routable replica (all ejected, draining, or "
-                      "none registered)", "shed_by": "router"},
-            status=503,
-            headers={"Retry-After": str(self._retry_after())})
+            body, status=503,
+            headers={"Retry-After": str(eta if eta is not None
+                                        else self._retry_after())})
 
     # -- candidate ordering --------------------------------------------------
 
@@ -1246,8 +1287,22 @@ class FleetRouter:
     async def handle_fleet_telemetry(self,
                                      request: web.Request) -> web.Response:
         """Decision-grade rollups (fleet/telemetry.py): series, burn
-        rates, headroom, outliers — the autoscaler/`cake top` feed."""
-        return web.json_response(self.telemetry.snapshot())
+        rates, headroom, outliers — the autoscaler/`cake top` feed.
+        With the closed loop on, the body carries the autoscaler's
+        compact summary so `cake top` renders its row from one GET."""
+        body = self.telemetry.snapshot()
+        if self.autoscaler is not None:
+            body = dict(body)
+            body["autoscale"] = self.autoscaler.summary()
+        return web.json_response(body)
+
+    async def handle_fleet_autoscale(self,
+                                     request: web.Request) -> web.Response:
+        """The decisions ring + policy + lifecycle process view
+        (fleet/autoscale.py); {"enabled": false} when the loop is off."""
+        if self.autoscaler is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(self.autoscaler.snapshot())
 
     async def handle_request_index(self,
                                    request: web.Request) -> web.Response:
@@ -1314,6 +1369,8 @@ def create_router_app(router: FleetRouter) -> web.Application:
     app.router.add_get("/fleet", router.handle_fleet)
     app.router.add_get("/api/v1/fleet/telemetry",
                        router.handle_fleet_telemetry)
+    app.router.add_get("/api/v1/fleet/autoscale",
+                       router.handle_fleet_autoscale)
     app.router.add_get("/api/v1/requests", router.handle_request_index)
     app.router.add_get("/api/v1/requests/{rid}",
                        router.handle_request_trace)
@@ -1325,18 +1382,21 @@ def create_router_app(router: FleetRouter) -> web.Application:
 
 
 def serve_router(replicas: list, host: str = "0.0.0.0", port: int = 8100,
-                 cluster_key: str | None = None):
+                 cluster_key: str | None = None,
+                 autoscale: bool | None = None):
     """Blocking router entry (ref: `cake route`). `replicas` is
     [(name, base_url), ...] from --replica flags; when a cluster key is
     given, announced replicas discovered over UDP join too (and keep
-    joining every CAKE_FLEET_DISCOVER_S)."""
+    joining every CAKE_FLEET_DISCOVER_S). `autoscale` turns the closed
+    loop on regardless of CAKE_SCALE (None defers to the knob)."""
     registry = ReplicaRegistry()
     for name, base_url in replicas:
         registry.add(name, base_url)
     if cluster_key:
         for name, base_url in discover_replicas(cluster_key):
             registry.add(name, base_url)
-    router = FleetRouter(registry, cluster_key=cluster_key)
+    router = FleetRouter(registry, cluster_key=cluster_key,
+                         autoscale=autoscale)
     app = create_router_app(router)
     log.info("fleet router on http://%s:%d fronting %d replicas",
              host, port, len(registry.names()))

@@ -115,11 +115,15 @@ def replica_signals(text: str) -> dict:
       tokens        = cake_generated_tokens_total summed over paths
       queue_depth / slots_busy / kv_free / kv_used   = gauges (or None)
       spec_proposed / spec_accepted                  = counters
+      qos_depth     = {class: queued depth} from the admission plane
+                      (the autoscaler's QoS view: batch backlog is
+                      visible but deliberately not a scale trigger)
     """
     sig = {"hist": {}, "requests": 0.0, "errors": 0.0, "tokens": 0.0,
            "queue_depth": None, "slots_busy": None,
            "kv_free": None, "kv_used": None,
-           "spec_proposed": 0.0, "spec_accepted": 0.0}
+           "spec_proposed": 0.0, "spec_accepted": 0.0,
+           "qos_depth": {}}
     buckets: dict[str, dict[float, float]] = {}
     # only two families feed the rollup — skipping the rest at the
     # startswith check keeps the per-cycle parse cost flat no matter how
@@ -139,6 +143,9 @@ def replica_signals(text: str) -> dict:
             sig["tokens"] += value
         elif name == "cake_serve_queue_depth":
             sig["queue_depth"] = value
+        elif name == "cake_serve_qos_queue_depth":
+            cls = labels.get("qos") or "?"
+            sig["qos_depth"][cls] = sig["qos_depth"].get(cls, 0.0) + value
         elif name == "cake_serve_slots_busy":
             sig["slots_busy"] = value
         elif name == "cake_serve_kv_blocks_free":
@@ -572,6 +579,16 @@ class FleetTelemetry:
             replicas_out[name]["outlier"] = reason is not None
             replicas_out[name]["outlier_reason"] = reason
 
+        # per-class backlog across usable replicas: the autoscaler reads
+        # this for its decision detail — batch backlog is VISIBLE here but
+        # never a scale trigger (interactive burn/headroom are; a deep
+        # batch queue is exactly what the batch class is for)
+        qos_backlog: dict[str, float] = {}
+        for name in usable:
+            sig = live.get(name)
+            for cls, depth in (sig.get("qos_depth") or {}).items():
+                qos_backlog[cls] = qos_backlog.get(cls, 0.0) + depth
+
         # fleet-level rings for dashboards (`cake top` sparklines)
         fleet_depth = sum(s["queue_depth"] for n, s in snaps.items()
                           if n not in stale)
@@ -602,6 +619,8 @@ class FleetTelemetry:
             "headroom_tokens_per_s": round(headroom, 3),
             "sheds_per_s": round(sheds_s, 4),
             "fleet_queue_depth": fleet_depth,
+            "qos_backlog": {c: round(v, 1)
+                            for c, v in sorted(qos_backlog.items())},
             "percentiles": percentiles,
             "mismatched_histograms_skipped": skipped_mismatched,
             "stale": sorted(stale),
@@ -624,6 +643,7 @@ class FleetTelemetry:
                             "slow_s": self.slow_window_s},
                 "burn_rate": {"fast": 0.0, "slow": 0.0},
                 "headroom_tokens_per_s": 0.0, "sheds_per_s": 0.0,
-                "fleet_queue_depth": 0, "percentiles": {}, "stale": [],
+                "fleet_queue_depth": 0, "qos_backlog": {},
+                "percentiles": {}, "stale": [],
                 "outliers": {}, "replicas": {}, "series": {},
                 "rollup_ms": {"last": 0.0, "mean": 0.0, "max": 0.0}}

@@ -67,6 +67,26 @@ def render_screen(body: dict, base_url: str = "",
             f"  (n={ttft.get('count', 0):.0f}, fast window)")
     else:
         lines.append("fleet ttft percentiles: no window data yet")
+    scale = body.get("autoscale")
+    if scale:
+        last = scale.get("last") or {}
+        if last:
+            age = last.get("age_s")
+            what = f"last {last.get('kind', '?')}"
+            if last.get("reason"):
+                what += f"({last['reason']})"
+            if last.get("replica"):
+                what += f" {last['replica']}"
+            if age is not None:
+                what += f" {age:.0f}s ago"
+        else:
+            what = "no decisions yet"
+        lines.append(
+            f"autoscale [{scale.get('min', '?')}"
+            f"..{scale.get('max', '?')}]"
+            f"   managed {scale.get('managed', 0)}"
+            f"   pending {scale.get('pending_spawns', 0)}"
+            f"   {what}")
     lines.append("")
     hdr = (f"{'REPLICA':<14} {'STATE':<9} {'DEPTH':>5} {'OCC':>5} "
            f"{'INFL':>5} {'TTFTp95':>8} {'ERR':>6} {'TOK/S':>8} "

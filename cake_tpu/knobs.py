@@ -276,6 +276,55 @@ _knob("CAKE_TELEM_OUTLIER_MIN_N", int, 3, "telemetry",
       "minimum live replicas before outlier detection runs (a median "
       "over 2 replicas cannot say which one is wrong)")
 
+# -- autoscale (closed-loop elastic fleet) --------------------------------
+_knob("CAKE_SCALE", bool, False, "autoscale",
+      "closed-loop autoscaling in the router: each probe/telemetry "
+      "cycle the controller (fleet/autoscale.py) decides scale-out / "
+      "scale-in / hold and the lifecycle manager executes it; off = "
+      "the telemetry plane stays advisory")
+_knob("CAKE_SCALE_SPAWN_CMD", str, None, "autoscale",
+      'scale-out spawn template, e.g. "cake serve model.safetensors '
+      '--announce --port {port}" — {port} and {name} are filled per '
+      "spawn; the replica is admitted to routing only after its "
+      "/health answers 200 (UDP discovery admits announced replicas "
+      "too); unset disables scale-out execution (decisions still log)")
+_knob("CAKE_SCALE_BURN_FAST", float, 2.0, "autoscale",
+      "scale-out trigger on the FAST-window SLO burn rate: burn above "
+      "this means interactive TTFT/error budget is burning page-fast, "
+      "so capacity is added even while batch backlog absorbs")
+_knob("CAKE_SCALE_HEADROOM_MIN", float, 0.0, "autoscale",
+      "scale-out trigger on fleet capacity headroom (tokens/s): "
+      "headroom below this floor adds a replica before saturation "
+      "turns into burn; 0 disables the headroom trigger")
+_knob("CAKE_SCALE_HEADROOM_HIGH", float, 0.0, "autoscale",
+      "scale-in high-water mark (tokens/s): only when headroom sits "
+      "ABOVE this continuously for a full CAKE_SCALE_COOLDOWN_S with "
+      "clean fast+slow burn does the controller retire a replica; "
+      "0 disables scale-in entirely (scale-out-only autoscaling)")
+_knob("CAKE_SCALE_COOLDOWN_S", float, 60.0, "autoscale",
+      "hysteresis clock: minimum spacing between scale actions, AND "
+      "how long the scale-in conditions must hold continuously before "
+      "one fires (restoring the CAKE_SCALE_MIN floor is exempt)")
+_knob("CAKE_SCALE_MIN", int, 1, "autoscale",
+      "replica floor: scale-in never drops below it, and a fleet found "
+      "under it (replica died, kill -9) is topped back up immediately, "
+      "cooldown or not")
+_knob("CAKE_SCALE_MAX", int, 8, "autoscale",
+      "replica ceiling: scale-out (pending spawns included) never "
+      "exceeds it no matter how hard the burn/headroom triggers pull")
+_knob("CAKE_SCALE_WARMUP_S", float, 30.0, "autoscale",
+      "warm-up grace after a replica is first seen (or restarts): "
+      "while any replica is this young the controller holds — a cold "
+      "replica's empty histograms would misread as zero headroom and "
+      "re-trigger the very scale-out that just ran")
+_knob("CAKE_SCALE_SPAWN_TIMEOUT_S", float, 180.0, "autoscale",
+      "spawn-to-healthy admission deadline: a spawned replica whose "
+      "/health never answers 200 within this is killed and the spawn "
+      "recorded spawn_failed (model load + XLA compile budget)")
+_knob("CAKE_SCALE_DECISIONS", int, 256, "autoscale",
+      "decisions-ring capacity: typed controller/lifecycle events kept "
+      "for GET /api/v1/fleet/autoscale (oldest dropped first)")
+
 # -- cluster --------------------------------------------------------------
 _knob("CAKE_CLUSTER_KEY", str, None, "cluster",
       "pre-shared key enabling distributed mode (mutual auth between "
@@ -335,6 +384,7 @@ _AREA_TITLES = (
     ("spec", "Speculative decoding"),
     ("fleet", "Fleet (router tier over N serve replicas)"),
     ("telemetry", "Telemetry (fleet rollups, SLO objectives)"),
+    ("autoscale", "Autoscale (closed-loop elastic fleet)"),
     ("cluster", "Cluster (distributed pipeline + fault tolerance)"),
     ("obs", "Observability"),
     ("ops", "Ops / kernels"),

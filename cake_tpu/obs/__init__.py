@@ -357,6 +357,30 @@ FLEET_REPLICA_STALE = REGISTRY.gauge(
     "exclude it",
     labelnames=("replica",))
 
+# -- fleet autoscale (the closed loop consuming the telemetry plane) ---------
+# Written by fleet/autoscale.py (controller) and fleet/lifecycle.py
+# (executor) inside the router process; CAKE_SCALE gates the whole loop.
+
+FLEET_SCALE_ACTIONS = REGISTRY.counter(
+    "cake_fleet_scale_actions_total",
+    "Autoscaler actions EXECUTED (holds are not counted — the decisions "
+    "ring at /api/v1/fleet/autoscale carries those): direction out/in, "
+    "reason the trigger that fired (burn_fast / headroom_low / "
+    "below_min / headroom_high)",
+    labelnames=("direction", "reason"))
+
+FLEET_SCALE_PENDING_SPAWNS = REGISTRY.gauge(
+    "cake_fleet_scale_pending_spawns",
+    "Replica processes spawned by the lifecycle manager still waiting "
+    "for their /health to answer 200 (spawn-to-routable window; feeds "
+    "the no-replica Retry-After during a cold start)")
+
+FLEET_SCALE_MANAGED_REPLICAS = REGISTRY.gauge(
+    "cake_fleet_scale_managed_replicas",
+    "Replica processes whose OS lifetime the router's lifecycle manager "
+    "owns (spawned by scale-out; retired by scale-in or reaped on "
+    "unexpected death)")
+
 CLUSTER_STAGE_FAILURES = REGISTRY.counter(
     "cake_cluster_stage_failures_total",
     "Classified remote-hop failures observed by the master",
@@ -421,5 +445,7 @@ __all__ = [
     "FLEET_PROXIED", "FLEET_STREAM_RESUMES",
     "FLEET_SLO_BURN_RATE", "FLEET_HEADROOM_TOKENS",
     "FLEET_REPLICA_OUTLIER", "FLEET_REPLICA_STALE",
+    "FLEET_SCALE_ACTIONS", "FLEET_SCALE_PENDING_SPAWNS",
+    "FLEET_SCALE_MANAGED_REPLICAS",
     "Series", "SeriesBank",
 ]

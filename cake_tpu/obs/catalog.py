@@ -47,6 +47,7 @@ cluster stages.
 | `GET /api/v1/slo` | the serve TTFT / inter-token / e2e histograms by outcome as JSON, each bucket carrying its sampled exemplar request id |
 | `GET /api/v1/flight` | flight-recorder-on-demand: the scheduler-iteration ring as JSON without waiting for a wedge/DOWN dump (`?n=K` for the newest K; 409 without an engine) |
 | `GET /api/v1/fleet/telemetry` | ROUTER ONLY: the fleet telemetry rollup — time-series, burn rates, headroom, outliers (see [telemetry.md](telemetry.md)) |
+| `GET /api/v1/fleet/autoscale` | ROUTER ONLY: the autoscaler's decision ring, policy, and managed-replica lifecycle state (see [autoscaling.md](autoscaling.md); `{"enabled": false}` when the loop is off) |
 
 ## Request-scoped tracing
 
@@ -91,7 +92,13 @@ anomaly flags (`cake_fleet_replica_outlier`, with
 gauges were retracted). Served at `GET /api/v1/fleet/telemetry` and
 rendered live by `cake top`. [telemetry.md](telemetry.md) is the
 operator guide (series model, burn-rate formula, headroom model,
-outlier rule).
+outlier rule). With `CAKE_SCALE=1` the rollup also FEEDS the
+closed-loop autoscaler: scale actions are counted in
+`cake_fleet_scale_actions_total{direction,reason}` with spawn/drain
+progress in `cake_fleet_scale_pending_spawns` /
+`cake_fleet_scale_managed_replicas`, and the typed decision ring is
+served at `GET /api/v1/fleet/autoscale`
+([autoscaling.md](autoscaling.md) is the operator guide).
 
 ## SLO accounting
 

@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""Multi-process fleet soak: the closed loop against REAL processes.
+
+The driver runs a real fleet router (TCP socket, autoscaler on) with
+ZERO replicas and lets the control loop do everything else: the
+below-min rule bootstraps the fleet by spawning real `--serve` child
+processes (this same file; tiny CPU model, real ServeEngine, real HTTP),
+a load ramp starves headroom until the loop scales 2 -> 4, the ramp
+ends and the clean-window dwell scales 4 -> 2 through graceful drains,
+and a kill -9 of a managed replica is swept and replaced through the
+same below-min rule that bootstrapped the fleet.
+
+Asserts, in order:
+  1. bootstrap: 0 -> CAKE_SCALE_MIN via below_min decisions, replicas
+     admitted only after their /health answers;
+  2. scale-OUT under ramp: saturated slots drive fleet headroom under
+     CAKE_SCALE_HEADROOM_MIN and the fleet reaches CAKE_SCALE_MAX, one
+     spawn per decision (pending spawns hold further triggers);
+  3. scale-IN after the ramp: burn clean + headroom above the
+     high-water for a full cooldown retires replicas back to min —
+     every reap is graceful (forced=False: drained, never SIGKILLed);
+  4. kill -9: a managed replica killed outright is reaped by the sweep
+     (`died` on the decisions ring) and replaced via below_min;
+  5. ZERO client-visible errors across every phase (transparent
+     failover absorbs the kill; cordons absorb the drains);
+  6. zero frozen-gauge contamination: every retired/died replica's
+     per-replica labelsets are retracted from router /metrics and gone
+     from the telemetry rollup.
+
+Every phase polls WITH A DEADLINE (fixed sleeps flake on this
+container's slow CPU — spawns here are real JAX-importing processes).
+Run via `make fleet-soak` (tier-2; not part of the tier-1 pytest run).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import re
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CTX = 96
+MAX_NEW = 12
+
+# the whole policy the soak runs under, applied BEFORE the router (and
+# its telemetry plane) is constructed. Windows are short so the loop
+# reacts in seconds; the TTFT SLO is parked out of reach so burn stays
+# clean and HEADROOM is the scaling driver (robust on a slow CPU where
+# queue-wait TTFT is noise, saturation is not).
+SOAK_KNOBS = {
+    "CAKE_SCALE": "1",
+    "CAKE_SCALE_MIN": "2",
+    "CAKE_SCALE_MAX": "4",
+    "CAKE_SCALE_COOLDOWN_S": "12",
+    "CAKE_SCALE_WARMUP_S": "8",
+    "CAKE_SCALE_HEADROOM_MIN": "2",
+    "CAKE_SCALE_HEADROOM_HIGH": "10",
+    "CAKE_SCALE_SPAWN_TIMEOUT_S": "300",
+    "CAKE_SLO_TTFT_MS": "600000",
+    "CAKE_TELEM_FAST_WINDOW_S": "8",
+    "CAKE_TELEM_SLOW_WINDOW_S": "24",
+    "CAKE_DRAIN_TIMEOUT_S": "15",
+}
+
+
+# ---------------------------------------------------------------------------
+# --serve: one replica child process (real engine, real socket)
+# ---------------------------------------------------------------------------
+
+
+class SmokeTok:
+    """Word-hash prose, round-trip for generated ids (decode emits
+    " t<id>", encode parses them back) — the fleet smokes' tokenizer."""
+
+    def encode(self, text):
+        out = []
+        for w in text.split():
+            if w[:1] == "t" and w[1:].isdigit():
+                out.append(int(w[1:]))
+            else:
+                out.append(3 + (sum(w.encode()) % 200))
+        return out[:64] or [3]
+
+    def decode(self, ids):
+        return "".join(f" t{i}" for i in ids)
+
+
+def serve_child(name: str, port: int, step_delay_ms: int) -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from cake_tpu.api import ApiState
+    from cake_tpu.api.server import serve
+    from cake_tpu.models import TextModel, tiny_config
+    from cake_tpu.serve import ServeEngine
+    from cake_tpu.serve import faults as serve_faults
+
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=CTX)
+    model.tokenizer = SmokeTok()
+    if step_delay_ms > 0:
+        # stretch decode so a handful of concurrent clients genuinely
+        # saturates the slots (the scale-out pressure the soak ramps)
+        serve_faults.install(f"delay_ms={step_delay_ms}")
+    state = ApiState(model=model, tokenizer=SmokeTok(),
+                     model_id=f"soak-{name}")
+    state.engine = ServeEngine(model, slots=2, max_queue=32, ctx_len=CTX)
+    # blocking; SIGTERM -> aiohttp on_shutdown -> graceful_drain (the
+    # lifecycle manager's scale-in counts on exactly this path)
+    serve(state, host="127.0.0.1", port=port)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+async def _poll(fn, pred, deadline_s: float, what: str, interval=0.25):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        last = await fn()
+        if pred(last):
+            return last
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out after {deadline_s:.0f}s waiting for "
+                         f"{what}; last: {json.dumps(last)[:600]}")
+
+
+class LoadGroup:
+    """N looping chat workers sharing one stop event."""
+
+    def __init__(self, load: "Load", n: int, pause_s: float):
+        self._stop = asyncio.Event()
+        self._tasks = [asyncio.create_task(load._worker(self._stop,
+                                                        pause_s))
+                       for _ in range(n)]
+
+    async def stop(self):
+        self._stop.set()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+
+
+class Load:
+    """Chat workers against the router; every status (or transport
+    failure) is recorded — the zero-client-errors ledger. Groups start
+    and stop independently (a trickle can outlive the heavy ramp)."""
+
+    def __init__(self, session, base):
+        self.session = session
+        self.base = base
+        self.statuses: list = []
+        self._convo = 0
+        self._groups: list = []
+
+    def group(self, n: int, pause_s: float = 0.0) -> LoadGroup:
+        g = LoadGroup(self, n, pause_s)
+        self._groups.append(g)
+        return g
+
+    async def stop_all(self):
+        for g in self._groups:
+            await g.stop()
+        self._groups = []
+
+    async def _one(self, convo: int):
+        try:
+            async with self.session.post(
+                    self.base + "/v1/chat/completions",
+                    json={"messages": [
+                        {"role": "user",
+                         "content": f"soak conversation {convo} says "
+                                    f"hello t{3 + convo % 200}"}],
+                        "max_tokens": MAX_NEW, "temperature": 0.0}) as r:
+                await r.read()
+                self.statuses.append(r.status)
+        except Exception as e:
+            self.statuses.append(f"{type(e).__name__}: {e}")
+
+    async def _worker(self, stop, pause_s: float):
+        while not stop.is_set():
+            self._convo += 1
+            await self._one(self._convo)
+            if pause_s:
+                await asyncio.sleep(pause_s)
+
+    def errors(self) -> list:
+        return [s for s in self.statuses if s != 200]
+
+
+async def main_async(args) -> dict:
+    os.environ.update(SOAK_KNOBS)
+    os.environ["CAKE_SCALE_SPAWN_CMD"] = (
+        f"{sys.executable} {os.path.abspath(__file__)} --serve "
+        f"--name {{name}} --port {{port}} "
+        f"--step-delay-ms {args.step_delay_ms}")
+
+    import aiohttp
+    from aiohttp import web
+
+    from cake_tpu.fleet import (FleetRouter, MembershipPolicy,
+                                ReplicaRegistry, create_router_app)
+
+    registry = ReplicaRegistry(MembershipPolicy(
+        eject_fails=3, err_window=16, err_rate=0.9,
+        degraded_ttft_ms=0.0, eject_s=0.5))
+    router = FleetRouter(registry, retries=2, backoff_s=0.05,
+                         probe_s=0.5, hedge_ms=0.0, max_inflight=0,
+                         autoscale=True)
+    out: dict = {}
+    runner = web.AppRunner(create_router_app(router))
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    print(f"fleet-soak: router on {base}, scale "
+          f"[{SOAK_KNOBS['CAKE_SCALE_MIN']}..{SOAK_KNOBS['CAKE_SCALE_MAX']}]")
+
+    session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=120))
+    load = Load(session, base)
+
+    async def fleet():
+        async with session.get(base + "/fleet") as r:
+            return await r.json()
+
+    async def autoscale():
+        async with session.get(base + "/api/v1/fleet/autoscale") as r:
+            return await r.json()
+
+    async def metrics_text():
+        async with session.get(base + "/metrics") as r:
+            return await r.text()
+
+    def ring_kinds(snap) -> list:
+        return [(e["kind"], e.get("reason")) for e in snap["decisions"]]
+
+    try:
+        # -- phase 1: bootstrap 0 -> min via below_min --------------------
+        t0 = time.monotonic()
+        snap = await _poll(
+            autoscale, lambda s: len(s["lifecycle"]["managed"]) >= 2,
+            60.0, "below_min spawned 2 replicas")
+        assert ("scale_out", "below_min") in ring_kinds(snap), \
+            ring_kinds(snap)
+        snap = await _poll(fleet, lambda s: s["routable"] >= 2, 300.0,
+                           "bootstrap replicas admitted")
+        out["bootstrap_s"] = round(time.monotonic() - t0, 1)
+        out["bootstrap"] = sorted(r["name"] for r in snap["replicas"])
+        # light trickle teaches the telemetry plane per-slot throughput
+        # (idle headroom would otherwise read 0 and mimic saturation);
+        # runs for the whole soak so signals never go dark
+        load.group(1, pause_s=0.5)
+        await _poll(autoscale,
+                    lambda s: not s["lifecycle"]["pending_spawns"]
+                    and len(s["lifecycle"]["managed"]) == 2,
+                    120.0, "fleet settled at min")
+
+        # -- phase 2: ramp -> scale out to max ----------------------------
+        t0 = time.monotonic()
+        heavy = load.group(6)           # saturate 2 replicas x 2 slots
+        snap = await _poll(
+            fleet, lambda s: s["routable"] >= 4, 600.0,
+            "scale-out to max under ramp")
+        out["scale_out_s"] = round(time.monotonic() - t0, 1)
+        snap = await autoscale()
+        reasons = [r for k, r in ring_kinds(snap) if k == "scale_out"]
+        assert "headroom_low" in reasons, reasons
+        out["scale_out_reasons"] = reasons
+        # one spawn per decision: never more pending than one at a time
+        # once past bootstrap (pending spawns hold further triggers)
+        assert snap["lifecycle"]["pending_spawns"] == 0
+
+        # -- phase 3: ramp down -> scale in to min ------------------------
+        t0 = time.monotonic()
+        await heavy.stop()              # the trickle keeps signals live
+        snap = await _poll(
+            autoscale,
+            lambda s: len(s["lifecycle"]["managed"]) == 2
+            and not any(m["retiring"] for m in s["lifecycle"]["managed"]),
+            600.0, "scale-in back to min")
+        out["scale_in_s"] = round(time.monotonic() - t0, 1)
+        kinds = ring_kinds(snap)
+        assert ("scale_in", "headroom_high") in kinds, kinds
+        # drained replicas finish in flight: every reap was graceful
+        reaps = [e for e in snap["decisions"] if e["kind"] == "reaped"]
+        assert reaps and all(e.get("forced") is False for e in reaps), \
+            reaps
+        out["graceful_reaps"] = len(reaps)
+        retired = {e["replica"] for e in snap["decisions"]
+                   if e["kind"] in ("retire", "reaped")}
+
+        # -- phase 4: kill -9 -> sweep + below_min replacement ------------
+        snap = await autoscale()
+        victim = snap["lifecycle"]["managed"][0]
+        os.kill(victim["pid"], signal.SIGKILL)
+        out["killed"] = {"name": victim["name"], "pid": victim["pid"]}
+        t0 = time.monotonic()
+        snap = await _poll(
+            autoscale,
+            lambda s: any(e["kind"] == "died"
+                          and e.get("replica") == victim["name"]
+                          for e in s["decisions"]),
+            60.0, "sweep reaped the kill -9")
+        snap = await _poll(
+            fleet,
+            lambda s: s["routable"] >= 2
+            and victim["name"] not in [r["name"] for r in s["replicas"]],
+            300.0, "below_min replacement admitted")
+        out["replace_s"] = round(time.monotonic() - t0, 1)
+        snap = await autoscale()
+        after_died = False
+        for kind, reason in ring_kinds(snap):
+            if kind == "died":
+                after_died = True
+            if after_died and (kind, reason) == ("scale_out", "below_min"):
+                break
+        else:
+            raise AssertionError(f"no below_min replacement after died: "
+                                 f"{ring_kinds(snap)}")
+        retired.add(victim["name"])
+
+        # -- phase 5: ledgers --------------------------------------------
+        await load.stop_all()
+        errors = load.errors()
+        assert not errors, f"client-visible errors: {errors[:10]} " \
+                           f"({len(errors)} of {len(load.statuses)})"
+        out["requests"] = len(load.statuses)
+        out["client_errors"] = 0
+
+        mtext = await metrics_text()
+        for direction, floor in (("out", 3), ("in", 2)):
+            total = sum(int(m) for m in re.findall(
+                rf'^cake_fleet_scale_actions_total{{[^}}]*'
+                rf'direction="{direction}"[^}}]*}}\s+(\d+)', mtext, re.M))
+            assert total >= floor, (direction, total, floor)
+            out[f"scale_actions_{direction}"] = total
+        # frozen-gauge contamination: every retired/died replica's
+        # labelsets are retracted, and the rollup no longer knows them
+        for name in retired:
+            stale = [ln for ln in mtext.splitlines()
+                     if f'replica="{name}"' in ln
+                     and ("queue_depth" in ln or "occupancy" in ln)]
+            assert not stale, stale
+        async with session.get(base + "/api/v1/fleet/telemetry") as r:
+            roll = await r.json()
+        assert not retired & set(roll.get("replicas") or {}), \
+            (retired, list(roll["replicas"]))
+        out["retired_names_retracted"] = sorted(retired)
+        return out
+    finally:
+        try:
+            await load.stop_all()
+        except Exception:
+            pass
+        await session.close()
+        await runner.cleanup()          # drains router, closes lifecycle
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true",
+                    help="internal: run as one replica child process")
+    ap.add_argument("--name", default="soak")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--step-delay-ms", type=int, default=25)
+    args = ap.parse_args()
+    if args.serve:
+        return serve_child(args.name, args.port, args.step_delay_ms)
+    out = asyncio.new_event_loop().run_until_complete(main_async(args))
+    print("fleet-soak OK:")
+    for k, v in out.items():
+        print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

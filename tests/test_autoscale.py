@@ -1,0 +1,562 @@
+"""Closed-loop fleet autoscaler (ISSUE 17 acceptance pins).
+
+Units drive the PURE controller (fleet/autoscale.py decide()) with a
+fake clock — no sleeps, no processes: scale-out on fast-burn breach,
+scale-in only after a clean slow window has dwelled a full cooldown,
+hysteresis on a synthetic oscillating trace (no flapping), the HARD
+RULE that outlier/stale flags never change WHETHER the fleet scales
+(only WHICH replica drains), min/max bounds, one-action-per-cooldown,
+warm-up holds, and batch backlog explicitly NOT being a trigger.
+
+The lifecycle manager (fleet/lifecycle.py) is driven through its
+injectable spawner/prober seams with stub processes: spawn -> admit ->
+registry join, graceful retire -> cordon -> reap, sweep on kill -9,
+spawn timeout, and the spawn-ETA estimate behind the router's
+cold-start Retry-After.
+"""
+import asyncio
+import json
+
+import pytest
+
+from cake_tpu.fleet import MembershipPolicy, ReplicaRegistry
+from cake_tpu.fleet.autoscale import (DECISION_KINDS, HOLD, SCALE_IN,
+                                      SCALE_OUT, Autoscaler,
+                                      ControllerState, DecisionLog,
+                                      ScalePolicy, decide, select_victim)
+from cake_tpu.fleet.lifecycle import (DEFAULT_SPAWN_ETA_S, ManagedReplica,
+                                      ReplicaLifecycle)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _policy(**kw):
+    base = dict(burn_fast=2.0, headroom_min=100.0, headroom_high=500.0,
+                cooldown_s=10.0, min_replicas=1, max_replicas=4,
+                warmup_s=5.0, enabled=True)
+    base.update(kw)
+    return ScalePolicy(**base)
+
+
+def _mpolicy(**kw):
+    base = dict(eject_fails=3, err_window=16, err_rate=0.5,
+                degraded_ttft_ms=0.0, eject_s=0.05, replica_inflight=0)
+    base.update(kw)
+    return MembershipPolicy(**base)
+
+
+def _rep(name, state="healthy", warm=1000.0, managed=True, outlier=False,
+         cordoned=False, headroom=0.0, mass=0.0):
+    return {"name": name, "state": state, "warm_age_s": warm,
+            "managed": managed, "outlier": outlier, "cordoned": cordoned,
+            "headroom_tokens_per_s": headroom, "affinity_mass": mass,
+            "inflight": 0, "stale": False}
+
+
+def _view(*reps, pending=0):
+    return {"replicas": list(reps), "pending_spawns": pending}
+
+
+def _rollup(fast=0.0, slow=0.0, headroom=1000.0, qos=None):
+    return {"burn_rate": {"fast": fast, "slow": slow},
+            "headroom_tokens_per_s": headroom,
+            "qos_backlog": qos or {}}
+
+
+# ---------------------------------------------------------------------------
+# decide(): scale-out
+# ---------------------------------------------------------------------------
+
+
+def test_scale_out_on_fast_burn_breach():
+    st = ControllerState()
+    d = decide(_rollup(fast=2.5), _view(_rep("a"), _rep("b")),
+               _policy(), st, t=100.0)
+    assert d.action == SCALE_OUT and d.reason == "burn_fast"
+    assert st.last_action_t == 100.0
+    assert d.detail["burn_fast"] == 2.5
+
+
+def test_scale_out_on_low_headroom():
+    d = decide(_rollup(headroom=50.0), _view(_rep("a"), _rep("b")),
+               _policy(headroom_min=100.0), ControllerState(), t=0.0)
+    assert d.action == SCALE_OUT and d.reason == "headroom_low"
+
+
+def test_headroom_trigger_off_when_zero():
+    d = decide(_rollup(headroom=0.0), _view(_rep("a"), _rep("b")),
+               _policy(headroom_min=0.0), ControllerState(), t=0.0)
+    assert d.action == HOLD and d.reason == "steady"
+
+
+def test_scale_out_capped_at_max():
+    reps = [_rep(f"r{i}") for i in range(4)]
+    d = decide(_rollup(fast=9.0), _view(*reps), _policy(max_replicas=4),
+               ControllerState(), t=0.0)
+    assert d.action == HOLD and d.reason == "at_max"
+    # pending spawns count against the bound too
+    d = decide(_rollup(fast=9.0), _view(*reps[:3], pending=1),
+               _policy(max_replicas=4), ControllerState(), t=0.0)
+    assert d.action == HOLD and d.reason == "at_max"
+
+
+def test_one_action_per_cooldown():
+    st = ControllerState()
+    pol = _policy(cooldown_s=10.0, max_replicas=8)
+    v = _view(_rep("a"), _rep("b"))
+    assert decide(_rollup(fast=5.0), v, pol, st, t=0.0).action == SCALE_OUT
+    d = decide(_rollup(fast=5.0), v, pol, st, t=5.0)
+    assert d.action == HOLD and d.reason == "cooldown"
+    assert decide(_rollup(fast=5.0), v, pol, st, t=10.0).action == SCALE_OUT
+
+
+def test_warming_replica_and_pending_spawn_hold_out_triggers():
+    pol = _policy(warmup_s=30.0)
+    # a freshly admitted replica is still materializing capacity: judging
+    # the trigger again now would double-spend on the same pressure
+    d = decide(_rollup(fast=5.0), _view(_rep("a"), _rep("new", warm=3.0)),
+               pol, ControllerState(), t=100.0)
+    assert d.action == HOLD and d.reason == "warmup"
+    d = decide(_rollup(fast=5.0), _view(_rep("a"), pending=1),
+               pol, ControllerState(), t=100.0)
+    assert d.action == HOLD and d.reason == "warmup"
+
+
+def test_below_min_bypasses_cooldown_and_warmup():
+    st = ControllerState()
+    pol = _policy(min_replicas=2, cooldown_s=60.0, warmup_s=30.0)
+    # an action just fired and a survivor is mid-warm-up: the floor is
+    # not discretionary — kill -9 replacement cannot wait either hold out
+    st.last_action_t = 99.0
+    d = decide(_rollup(), _view(_rep("a", warm=1.0)), pol, st, t=100.0)
+    assert d.action == SCALE_OUT and d.reason == "below_min"
+    # ... but pending spawns count toward the floor (no double-replace)
+    d = decide(_rollup(), _view(_rep("a", warm=1.0), pending=1), pol, st,
+               t=101.0)
+    assert d.action != SCALE_OUT
+
+
+def test_disabled_policy_always_holds():
+    d = decide(_rollup(fast=9.0), _view(), _policy(enabled=False),
+               ControllerState(), t=0.0)
+    assert d.action == HOLD and d.reason == "disabled"
+
+
+# ---------------------------------------------------------------------------
+# decide(): scale-in dwell + hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_scale_in_requires_continuous_dwell():
+    st = ControllerState()
+    pol = _policy(cooldown_s=10.0, min_replicas=1)
+    v = _view(_rep("a", headroom=300.0, mass=50.0),
+              _rep("b", headroom=200.0, mass=10.0))
+    high = _rollup(headroom=800.0)
+    # high-water starts the dwell clock; nothing fires before a full
+    # cooldown has elapsed CONTINUOUSLY
+    assert decide(high, v, pol, st, t=100.0).reason == "steady"
+    assert decide(high, v, pol, st, t=105.0).reason == "steady"
+    d = decide(high, v, pol, st, t=110.0)
+    assert d.action == SCALE_IN and d.reason == "headroom_high"
+    assert d.victim == "b"          # least affinity mass
+    assert st.high_since is None    # dwell re-arms after the action
+
+
+def test_scale_in_dwell_resets_on_burn_or_dip():
+    st = ControllerState()
+    pol = _policy(cooldown_s=10.0)
+    v = _view(_rep("a", headroom=300.0), _rep("b", headroom=200.0))
+    assert decide(_rollup(headroom=800.0), v, pol, st, t=0.0).action == HOLD
+    # headroom dips below the high-water mid-dwell: clock resets
+    decide(_rollup(headroom=400.0), v, pol, st, t=5.0)
+    assert st.high_since is None
+    decide(_rollup(headroom=800.0), v, pol, st, t=6.0)
+    assert decide(_rollup(headroom=800.0), v, pol, st,
+                  t=15.0).action == HOLD          # only 9s of dwell
+    # a dirty slow window mid-dwell resets it too
+    decide(_rollup(headroom=800.0, slow=1.5), v, pol, st, t=16.0)
+    assert st.high_since is None
+
+
+def test_scale_in_holds_at_min_and_without_victim():
+    st = ControllerState()
+    pol = _policy(cooldown_s=0.0, min_replicas=2)
+    v = _view(_rep("a", headroom=400.0), _rep("b", headroom=400.0))
+    d = decide(_rollup(headroom=900.0), v, pol, st, t=0.0)
+    assert d.action == HOLD and d.reason == "at_min"
+    # above min but nothing managed: the router never retires a process
+    # it did not spawn
+    v = _view(_rep("a", managed=False), _rep("b", managed=False),
+              _rep("c", managed=False))
+    st = ControllerState()
+    d = decide(_rollup(headroom=900.0), v, _policy(cooldown_s=0.0), st,
+               t=0.0)
+    assert d.action == HOLD and d.reason == "no_victim"
+
+
+def test_scale_in_hysteresis_guard():
+    # removing the victim would drop headroom below the scale-out floor:
+    # the loop must hold, or it would flap out <-> in forever
+    st = ControllerState()
+    pol = _policy(cooldown_s=0.0, headroom_min=300.0, headroom_high=500.0)
+    v = _view(_rep("a", headroom=100.0, mass=50.0),
+              _rep("b", headroom=450.0, mass=1.0))   # victim: least mass
+    d = decide(_rollup(headroom=550.0), v, pol, st, t=0.0)
+    assert d.action == HOLD and d.reason == "hysteresis"
+    assert d.detail["predicted_headroom_tokens_per_s"] == 100.0
+
+
+def test_oscillating_trace_does_not_flap():
+    """Synthetic oscillating load: burn alternates dirty/clean every
+    cycle and headroom swings around the high-water mark. The loop may
+    scale out at most once per cooldown and must never scale in (the
+    dwell clock resets on every dirty cycle)."""
+    st = ControllerState()
+    pol = _policy(cooldown_s=10.0, max_replicas=16, warmup_s=0.0)
+    v = _view(*[_rep(f"r{i}", headroom=100.0) for i in range(6)])
+    actions = []
+    for i in range(60):
+        t = float(i)
+        fast = 3.0 if i % 2 == 0 else 0.2
+        headroom = 900.0 if i % 2 else 120.0
+        d = decide(_rollup(fast=fast, headroom=headroom), v, pol, st, t)
+        if d.action != HOLD:
+            actions.append((t, d.action))
+    assert all(a == SCALE_OUT for _, a in actions)
+    times = [t for t, _ in actions]
+    assert all(b - a >= pol.cooldown_s for a, b in zip(times, times[1:]))
+    assert len(actions) <= 6            # 60s / 10s cooldown
+
+
+# ---------------------------------------------------------------------------
+# decide(): outliers advisory, batch not a trigger
+# ---------------------------------------------------------------------------
+
+
+def test_outlier_flags_never_change_direction_only_victim():
+    pol = _policy(cooldown_s=0.0)
+    plain = [_rep("a", headroom=300.0, mass=5.0),
+             _rep("b", headroom=300.0, mass=50.0)]
+    flagged = [dict(r, outlier=(r["name"] == "b")) for r in plain]
+    for rollup in (_rollup(fast=5.0),              # scale-out pressure
+                   _rollup(headroom=900.0),        # scale-in comfort
+                   _rollup(headroom=300.0)):       # steady
+        d0 = decide(rollup, _view(*plain), pol, ControllerState(), t=100.0)
+        d1 = decide(rollup, _view(*flagged), pol, ControllerState(),
+                    t=100.0)
+        # HARD RULE: same rollup with and without flags -> same action
+        assert (d0.action, d0.reason) == (d1.action, d1.reason)
+    # ... but when a scale-in fires, the flag picks the victim: "b" is
+    # outlier-flagged and outranks "a" despite 10x the affinity mass
+    d1 = decide(_rollup(headroom=900.0), _view(*flagged), pol,
+                ControllerState(), t=100.0)
+    assert d1.action == SCALE_IN and d1.victim == "b"
+    d0 = decide(_rollup(headroom=900.0), _view(*plain), pol,
+                ControllerState(), t=100.0)
+    assert d0.victim == "a"             # unflagged: least mass wins
+
+
+def test_batch_backlog_is_visible_but_not_a_trigger():
+    # a mountain of batch backlog with clean burn and adequate headroom
+    # holds: batch absorbs by design (interactive pressure pages through
+    # the burn rate, which IS a trigger)
+    d = decide(_rollup(headroom=300.0, qos={"batch": 50000.0}),
+               _view(_rep("a"), _rep("b")), _policy(), ControllerState(),
+               t=0.0)
+    assert d.action == HOLD and d.reason == "steady"
+    assert d.detail["qos_backlog"] == {"batch": 50000.0}
+    # interactive TTFT burn with the same batch mountain DOES trigger
+    d = decide(_rollup(fast=3.0, qos={"batch": 50000.0}),
+               _view(_rep("a"), _rep("b")), _policy(), ControllerState(),
+               t=0.0)
+    assert d.action == SCALE_OUT and d.reason == "burn_fast"
+
+
+def test_select_victim_ordering_and_eligibility():
+    reps = [_rep("big", mass=100.0), _rep("small", mass=1.0),
+            _rep("bad", mass=999.0, outlier=True),
+            _rep("foreign", mass=0.0, managed=False),
+            _rep("leaving", mass=0.0, cordoned=True),
+            _rep("downed", mass=0.0, state="ejected")]
+    v = select_victim(reps)
+    assert v["name"] == "bad"           # outlier first, mass ignored
+    v = select_victim([r for r in reps if r["name"] != "bad"])
+    assert v["name"] == "small"         # then least affinity mass
+    assert select_victim([_rep("x", managed=False)]) is None
+
+
+# ---------------------------------------------------------------------------
+# decisions ring
+# ---------------------------------------------------------------------------
+
+
+def test_decision_log_rejects_unknown_kinds_and_rings():
+    clk = FakeClock()
+    log = DecisionLog(cap=8, clock=clk)
+    with pytest.raises(ValueError):
+        log.record("resize")            # not in the closed catalog
+    for i in range(12):
+        log.record("hold", t=float(i), reason=f"h{i}")
+    evs = log.events(t=20.0)
+    assert len(evs) == 8                # ring capped
+    assert evs[-1]["reason"] == "h11" and evs[-1]["age_s"] == 9.0
+    assert "t" not in evs[-1]           # rendered as age, never raw t
+    log.record("scale_out", t=15.0, reason="burn_fast")
+    assert log.last("scale_out")["reason"] == "burn_fast"
+    assert log.last("scale_in") is None
+    assert set(DECISION_KINDS) >= {"scale_out", "scale_in", "hold",
+                                   "spawned", "admitted", "spawn_failed",
+                                   "retire", "reaped", "died"}
+
+
+class _StubLifecycle:
+    def __init__(self):
+        self.spawns, self.retires = [], []
+
+    def pending_count(self):
+        return 0
+
+    def is_managed(self, name):
+        return True
+
+    def managed_names(self):
+        return []
+
+    def spawn(self, reason=""):
+        self.spawns.append(reason)
+
+    def retire(self, name, reason=""):
+        self.retires.append((name, reason))
+        return True
+
+    def snapshot(self):
+        return {"managed": [], "pending_spawns": 0,
+                "spawn_eta_s": None, "spawn_cmd_set": False}
+
+
+def test_autoscaler_step_dedups_holds_and_executes():
+    clk = FakeClock(100.0)
+    reg = ReplicaRegistry(_mpolicy())
+    reg.add("a", "http://h:1")
+    reg.add("b", "http://h:2")
+    lc = _StubLifecycle()
+    log = DecisionLog(cap=64, clock=clk)
+    a = Autoscaler(reg, lc, policy=_policy(warmup_s=0.0, cooldown_s=10.0),
+                   log=log, clock=clk)
+    steady = _rollup(headroom=200.0)
+    for _ in range(5):                  # identical holds: ONE ring event
+        a.step(steady)
+        clk.t += 1.0
+    assert [e["kind"] for e in log.events()] == ["hold"]
+    a.step(_rollup(fast=5.0))           # breach -> scale_out + spawn
+    assert lc.spawns == ["burn_fast"]
+    kinds = [e["kind"] for e in log.events()]
+    assert kinds == ["hold", "scale_out"]
+    clk.t += 20.0                       # past cooldown
+    a.step(steady)                      # back to hold: recorded again
+    assert [e["kind"] for e in log.events()] == ["hold", "scale_out",
+                                                 "hold"]
+    s = a.summary()
+    assert s["min"] == 1 and s["max"] == 4 and s["enabled"]
+    assert s["last"]["kind"] == "hold"
+    snap = a.snapshot()
+    assert snap["policy"]["cooldown_s"] == 10.0
+    assert len(snap["decisions"]) == 3 and "lifecycle" in snap
+
+
+# ---------------------------------------------------------------------------
+# lifecycle manager (stub processes, fake prober)
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    """Popen-like stub: poll/terminate/kill/wait against a settable
+    returncode; pid points at nothing (os.getpgid fails -> the kill
+    path falls back to .kill())."""
+
+    def __init__(self, pid=4_190_000):
+        self.pid = pid
+        self.returncode = None
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self):
+        self.terminated = True
+        self.returncode = -15
+
+    def kill(self):
+        self.killed = True
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+def _lifecycle(clk, reg, events, *, prober, spawner=None, **kw):
+    return ReplicaLifecycle(
+        reg, spawn_cmd="serve --name {name} --port {port}",
+        spawn_timeout_s=30.0, drain_timeout_s=1.0,
+        record=lambda kind, **f: events.append((kind, f)),
+        clock=clk, spawner=spawner or (lambda cmd: FakeProc()),
+        prober=prober, **kw)
+
+
+def test_lifecycle_spawn_admit_and_eta():
+    async def run():
+        clk = FakeClock(100.0)
+        reg = ReplicaRegistry(_mpolicy())
+        events = []
+        seen = []
+
+        async def prober(url):
+            seen.append(url)
+            clk.t += 4.0                # spawn takes 4s on the fake clock
+            return True
+
+        lc = _lifecycle(clk, reg, events, prober=prober)
+        name = lc.spawn(reason="burn_fast")
+        assert name == "scale-1" and lc.pending_count() == 1
+        # cold-start ETA before any completed spawn: the default
+        assert lc.pending_spawn_eta() == int(DEFAULT_SPAWN_ETA_S)
+        await asyncio.sleep(0)          # let the admission task run
+        await asyncio.sleep(0)
+        assert lc.pending_count() == 0 and lc.is_managed("scale-1")
+        assert reg.names() == ["scale-1"]       # admitted AFTER healthy
+        assert [k for k, _ in events] == ["spawned", "admitted"]
+        assert events[1][1]["spawn_s"] == 4.0
+        assert seen and seen[0].startswith("http://127.0.0.1:")
+        # next spawn's ETA comes from the completed spawn's duration
+        lc.spawn(reason="headroom_low")
+        assert lc.pending_spawn_eta() == 4
+        await lc.close()
+    asyncio.run(run())
+
+
+def test_lifecycle_spawn_timeout_kills_and_drops():
+    async def run():
+        clk = FakeClock(0.0)
+        reg = ReplicaRegistry(_mpolicy())
+        events = []
+        proc = FakeProc()
+
+        async def prober(url):
+            clk.t += 31.0               # blow the spawn deadline
+            return False
+
+        lc = _lifecycle(clk, reg, events, prober=prober,
+                        spawner=lambda cmd: proc)
+        lc.spawn(reason="burn_fast")
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        assert [k for k, _ in events] == ["spawned", "spawn_failed"]
+        assert proc.killed and not lc.managed_names()
+        assert reg.names() == []        # never admitted
+    asyncio.run(run())
+
+
+def test_lifecycle_retire_cordons_drains_reaps():
+    async def run():
+        clk = FakeClock(0.0)
+        reg = ReplicaRegistry(_mpolicy())
+        events = []
+        proc = FakeProc()
+
+        async def prober(url):
+            return True
+
+        lc = _lifecycle(clk, reg, events, prober=prober,
+                        spawner=lambda cmd: proc)
+        lc.spawn()
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        rep = reg.get("scale-1")
+        assert rep is not None and rep.routable()
+        assert lc.retire("scale-1", reason="headroom_high")
+        # cordon lands IMMEDIATELY: no new routing while the drain runs
+        assert not rep.routable() and rep.try_acquire() is None
+        assert rep.snapshot()["state"] == "draining"
+        assert not lc.retire("scale-1")         # idempotent
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        assert proc.terminated                  # SIGTERM, not SIGKILL
+        assert [k for k, _ in events] == ["spawned", "admitted", "retire",
+                                          "reaped"]
+        assert events[3][1]["forced"] is False
+        assert reg.names() == [] and not lc.managed_names()
+        assert lc.retire("ghost") is False      # unmanaged name guarded
+    asyncio.run(run())
+
+
+def test_lifecycle_sweep_reaps_unexpected_death():
+    async def run():
+        clk = FakeClock(0.0)
+        reg = ReplicaRegistry(_mpolicy())
+        events = []
+        proc = FakeProc()
+
+        async def prober(url):
+            return True
+
+        lc = _lifecycle(clk, reg, events, prober=prober,
+                        spawner=lambda cmd: proc)
+        lc.spawn()
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        assert reg.names() == ["scale-1"]
+        assert lc.sweep() == []                 # alive: nothing to reap
+        proc.returncode = -9                    # kill -9 from outside
+        assert lc.sweep() == ["scale-1"]
+        assert events[-1][0] == "died"
+        assert events[-1][1]["exit_code"] == -9
+        # removed from routing: gauges retract, below-min sees the hole
+        assert reg.names() == [] and not lc.managed_names()
+    asyncio.run(run())
+
+
+def test_lifecycle_without_template_declines_to_spawn():
+    reg = ReplicaRegistry(_mpolicy())
+    events = []
+    lc = ReplicaLifecycle(reg, spawn_cmd=None,
+                          record=lambda k, **f: events.append(k))
+    assert lc.spawn(reason="burn_fast") is None
+    assert events == [] and not lc.managed_names()
+    assert lc.snapshot()["spawn_cmd_set"] is False
+
+
+# ---------------------------------------------------------------------------
+# router integration: cold-start Retry-After
+# ---------------------------------------------------------------------------
+
+
+def test_no_replica_503_carries_spawn_eta_retry_after():
+    from cake_tpu.fleet.router import FleetRouter
+    reg = ReplicaRegistry(_mpolicy())
+    router = FleetRouter(reg, autoscale=True)
+    assert router.autoscaler is not None and router.lifecycle is not None
+    assert router.autoscaler.policy.enabled      # flag wins over env knob
+    # an in-flight scale-out: the honest wait is the spawn ETA, not the
+    # backlog formula
+    clk = FakeClock(100.0)
+    router.lifecycle._clock = clk
+    router.lifecycle._managed["scale-1"] = ManagedReplica(
+        "scale-1", 18080, FakeProc(), spawned_at=97.0)
+    resp = router._no_replica()
+    assert resp.status == 503
+    eta = int(resp.headers["Retry-After"])
+    assert eta == int(DEFAULT_SPAWN_ETA_S - 3.0)  # 3s already elapsed
+    assert json.loads(resp.body)["scale_out_pending"] is True
+    # no pending spawn: back to the backlog-proportional hint
+    router.lifecycle._managed.clear()
+    resp = router._no_replica()
+    assert "scale_out_pending" not in json.loads(resp.body)
+    assert int(resp.headers["Retry-After"]) >= 1

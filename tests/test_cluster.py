@@ -702,6 +702,7 @@ def test_worker_error_keeps_connection_alive(cluster_model_dir):
         t.join(timeout=5)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_master_setup_partial_failure_closes_connections(cluster_model_dir):
     """If a later worker fails during master_setup, the already-connected
     workers' sockets must be closed, not leaked (the worker would keep

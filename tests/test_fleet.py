@@ -246,6 +246,69 @@ def test_unreachable_probes_eject():
 
 
 # ---------------------------------------------------------------------------
+# churn: leave + re-announce (ISSUE 17 pins)
+# ---------------------------------------------------------------------------
+
+
+def test_churn_keeps_eject_history_but_resets_warmup():
+    # a replica that leaves and re-announces under the same name must
+    # NOT launder its eject record (the backoff ladder carries over),
+    # but its warm-up clock IS fresh — a new process instance
+    reg = ReplicaRegistry(_policy(eject_fails=2, eject_s=60.0))
+    rep = reg.add("r0", "http://h:1")
+    rep.observe_health(None, None)
+    rep.observe_health(None, None)
+    assert rep.snapshot()["state"] == EJECTED and rep.ejects == 1
+    assert reg.remove("r0")
+    back = reg.add("r0", "http://h:1")
+    assert back is not rep                       # a NEW replica object
+    snap = back.snapshot()
+    assert snap["ejects"] == 1 and snap["eject_streak"] == 1
+    # the 60s ejection hold was still running at removal: re-applied
+    assert snap["state"] == EJECTED
+    assert snap["warm_age_s"] < 1.0              # warm-up clock reset
+
+
+def test_churn_expired_hold_rejoins_healthy_with_history():
+    reg = ReplicaRegistry(_policy(eject_fails=2, eject_s=0.0))
+    rep = reg.add("r0", "http://h:1")
+    rep.observe_health(None, None)
+    rep.observe_health(None, None)
+    assert rep.ejects == 1
+    reg.remove("r0")
+    back = reg.add("r0", "http://h:1")
+    snap = back.snapshot()
+    # hold already expired: joins routable, but the record survives
+    assert snap["state"] == HEALTHY and snap["ejects"] == 1
+
+
+def test_started_age_moving_backward_resets_warmup():
+    import time
+    rep = Replica("r0", "http://h:1", _policy())
+    body = {"engine": {"alive": True, "slots": 4}}
+    rep.observe_health(200, dict(body, started_at_age_s=100.0))
+    rep.first_seen -= 50.0          # backdate: long-warm replica
+    assert rep.warm_age_s() > 49.0
+    # age moves FORWARD: same process, warm-up untouched
+    rep.observe_health(200, dict(body, started_at_age_s=101.0))
+    assert rep.warm_age_s() > 49.0
+    # age moves BACKWARD: a new process answers behind the same URL
+    rep.observe_health(200, dict(body, started_at_age_s=2.0))
+    assert rep.warm_age_s() < 1.0
+
+
+def test_cordon_stops_new_routing_one_way():
+    rep = Replica("r0", "http://h:1", _policy())
+    assert rep.routable() and rep.try_acquire() is not None
+    rep.release()
+    rep.cordon()
+    assert not rep.routable() and rep.try_acquire() is None
+    snap = rep.snapshot()
+    assert snap["state"] == "draining" and snap["cordoned"]
+    assert rep.ejects == 0          # cordon is lifecycle, not membership
+
+
+# ---------------------------------------------------------------------------
 # affinity units
 # ---------------------------------------------------------------------------
 

@@ -160,6 +160,7 @@ def test_load_fp8_transformer(tmp_path):
     assert np.isfinite(np.asarray(img)).all()
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_fp8_native_scaled_variant(tmp_path):
     """Comfy scaled-fp8 bundles (per-tensor scale_weight): the native path
     must broadcast the scalar into its blockwise scale_inv — identical

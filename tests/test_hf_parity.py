@@ -127,6 +127,7 @@ def test_llama():
                  LlamaConfig(attention_bias=False, **_TINY_HF))
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_llama3_rope_scaling():
     scaling = dict(rope_type="llama3", factor=8.0, high_freq_factor=4.0,
                    low_freq_factor=1.0, original_max_position_embeddings=32)

@@ -178,6 +178,7 @@ def test_wav_roundtrip(rng):
 
 # ------------------------------------------------------------------- sd
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_sd_unet_shapes_and_conditioning(rng):
     from cake_tpu.models.image.sd import (init_unet_params, tiny_sd_config,
                                           unet_forward)
@@ -195,6 +196,7 @@ def test_sd_unet_shapes_and_conditioning(rng):
     assert not np.allclose(np.asarray(e1), np.asarray(e3), atol=1e-5)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_sd_generate_and_img2img():
     from cake_tpu.models.image.sd import SDImageModel, tiny_sd_config
     model = SDImageModel(tiny_sd_config())
@@ -236,6 +238,7 @@ def test_sd_intermediate_images_and_trace(tmp_path):
     np.testing.assert_array_equal(np.asarray(img), np.asarray(img_plain))
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_vibevoice_clone_prefill_bucketed():
     """Voice-clone conditioning pads the reference to 8-frame buckets so
     the jitted LM prefill compiles per bucket, not per clip length — and

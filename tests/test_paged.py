@@ -202,6 +202,7 @@ P_B = [100, 2, 5, 9, 11, 40]
 SYS = [3 + (i * 7) % 200 for i in range(40)]        # 2 full share units
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_paged_engine_greedy_matches_contiguous(model, engine):
     """Concurrent greedy requests through the paged pool reproduce the
     contiguous sequential path bit-for-bit — the gathered block view has
@@ -356,6 +357,7 @@ def gdn_model():
                      max_cache_len=CTX)
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_paged_gdn_parity_and_prefix_snapshot(gdn_model):
     """GDN hybrid (3 linear + 1 full layer): the paged pool pages only
     the full-attention layer; linear conv/recurrent state stays per-slot

@@ -131,6 +131,7 @@ def test_prefix_cache_match_requires_live_suffix(model):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_engine_prefix_hit_matches_miss(model):
     """The tentpole acceptance pin on the HIT side: greedy output is
     bit-identical whether the prefix was spliced from cache or computed,
@@ -165,6 +166,7 @@ def test_engine_prefix_hit_matches_miss(model):
         eng.close()
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_engine_prefix_eviction_under_pressure(model):
     """A capacity small enough for ~2 blocks forces LRU evictions while
     distinct prefixes stream through; outputs stay correct before, during

@@ -172,6 +172,7 @@ def test_sd_load_and_generate(tmp_path):
     assert np.isfinite(np.asarray(img)).all()
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_sd_img2img(tmp_path):
     synth_sd_dir(tmp_path)
     model = load_sd_image_model(str(tmp_path), dtype=jnp.float32)

@@ -424,6 +424,7 @@ def test_prefill_chunk_matches_monolithic_logits(model):
         assert float(jnp.abs(lc["k"][2]).max()) == 0.0
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_engine_chunked_long_prompt_parity(model):
     """Greedy output with a multi-chunk admission is bit-identical to the
     sequential (monolithic-prefill) path — the tentpole acceptance pin on

@@ -124,6 +124,7 @@ def _ref(model, prompt, n, sampling=GREEDY):
     return toks
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_step_crash_one_rebuild_bit_identical(model):
     """THE acceptance pin: an injected mid-generation step crash with 3
     concurrent requests costs exactly one rebuild-by-replay and every

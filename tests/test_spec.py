@@ -190,7 +190,9 @@ def test_filtered_probs_matches_softmax():
 # -- bit-identity with the plain decode path ----------------------------------
 
 
-@pytest.mark.parametrize("fam", ["llama", "gdn"])
+@pytest.mark.parametrize(
+    "fam", ["llama", pytest.param("gdn", marks=pytest.mark.slow)]  # tier-2 spec smokes cover gdn; 870s cap
+)
 def test_greedy_spec_bit_identical(fam, llama, gdn):
     m = {"llama": llama, "gdn": gdn}[fam]
     base, _ = m.generate(REP_PROMPT, max_new_tokens=24, sampling=GREEDY,
@@ -218,6 +220,7 @@ def test_greedy_spec_streaming_matches(llama):
     assert got == spec          # every token streamed, first included
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_draft_model_drafter_perfect_draft(llama):
     """Draft model == target model -> every proposal accepts (the
     strongest end-to-end check of verify + rollback + re-proposal)."""
@@ -367,6 +370,7 @@ def test_engine_spec_e2e_multi_token_accept(llama):
         eng.close()
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_engine_spec_sampled_slots_speculate(llama):
     """Sampled slots ride the batched verify too (each slot verifies
     with its own traced sampling params; spec_accept preserves the

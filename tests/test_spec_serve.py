@@ -83,7 +83,11 @@ def _ref(model, prompt, n, sampling=GREEDY):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("paged", [False, True], ids=["contig", "paged"])
+@pytest.mark.parametrize(
+    "paged",
+    [pytest.param(False, marks=pytest.mark.slow), pytest.param(True, marks=pytest.mark.slow)],  # tier-2 spec smokes cover llama; gdn[contig] is the tier-1 representative (870s cap)
+    ids=["contig", "paged"],
+)
 def test_batched_spec_greedy_parity_llama(model, paged):
     """Concurrent greedy requests through the batched-spec engine —
     one slot with live drafts, one whose drafter abstains — reproduce
@@ -108,7 +112,11 @@ def test_batched_spec_greedy_parity_llama(model, paged):
         eng.close()
 
 
-@pytest.mark.parametrize("paged", [False, True], ids=["contig", "paged"])
+@pytest.mark.parametrize(
+    "paged",
+    [False, pytest.param(True, marks=pytest.mark.slow)],  # tier-1 keeps one family per KV layout (llama covers paged)
+    ids=["contig", "paged"],
+)
 def test_batched_spec_greedy_parity_gdn(gdn_model, paged):
     """GDN hybrid (linear + full attention): the rejected-suffix
     rollback is the valid_len-masked state commit, per slot inside the

@@ -210,6 +210,7 @@ def test_api_adopts_trace_header_into_engine_timeline(model):
     assert rid in exemplars
 
 
+@pytest.mark.slow      # tier-2 covers it; tier-1 runs under the 870s cap
 def test_timeline_preempted_and_replayed_request_is_complete(model):
     """A request preempted under paged-pool pressure (recompute mode)
     keeps one coherent timeline: enqueue -> admit -> prefill ->

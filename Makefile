@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos serve-bench-telemetry paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke fleet-soak telemetry-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos serve-bench-telemetry paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke partition-smoke fleet-soak telemetry-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -93,6 +93,17 @@ serve-chaos-smoke: lint
 # must preserve the typed error event (now with resume_token).
 fleet-chaos-smoke: lint
 	JAX_PLATFORMS=cpu python scripts/fleet_chaos_smoke.py
+
+# partition-tolerance gate (tier-2): real serve replicas behind the
+# router with a REAL network chaos layer (fleet/netem.ChaosProxy) on
+# the victim's wire. Full partition, asymmetric probe-alive/data-dead
+# (flipped via the proxy's control socket), and a delay brownout each
+# eject within a bounded window with ZERO client-visible errors; the
+# asymmetric eject carries evidence=data, probes alone never readmit
+# it, the failed trial re-ejects with a doubled hold, and only the
+# healed network's data-path trial readmits (docs/fleet.md)
+partition-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/partition_smoke.py
 
 # closed-loop elastic-fleet gate (tier-2: real multi-process soak, not
 # part of the tier-1 pytest run): a real router with the autoscaler on

@@ -11,12 +11,15 @@ into burn rates / headroom / anomaly flags (telemetry.py — the feed the
 autoscaler and `cake top` consume), and the closed loop that acts on
 that feed: the pure scaling controller (autoscale.py) and the replica
 lifecycle manager that spawns/drains/reaps real serve processes
-(lifecycle.py). docs/fleet.md, docs/telemetry.md and
-docs/autoscaling.md are the operator guides.
+(lifecycle.py), and the userspace network chaos layer that partitions
+real router->replica sockets for soaks/smokes (netem.py). docs/fleet.md,
+docs/telemetry.md and docs/autoscaling.md are the operator guides.
 """
 from .autoscale import (Autoscaler, Decision, DecisionLog, ScalePolicy,
                         decide, select_victim)
 from .lifecycle import ManagedReplica, ReplicaLifecycle
+from .netem import ChaosProxy, NetemPlan
+from .netem import parse_plan as parse_netem_plan
 from .registry import (EJECTED, HALF_OPEN, HEALTHY, MembershipPolicy,
                        Replica, ReplicaRegistry, discover_replicas)
 from .router import FleetRouter, create_router_app, serve_router
@@ -31,4 +34,5 @@ __all__ = [
     "affinity_key", "conversation_head", "rank_replicas", "AFFINITY_BLOCK",
     "Autoscaler", "Decision", "DecisionLog", "ScalePolicy", "decide",
     "select_victim", "ManagedReplica", "ReplicaLifecycle",
+    "ChaosProxy", "NetemPlan", "parse_netem_plan",
 ]

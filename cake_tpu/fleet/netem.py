@@ -1,0 +1,506 @@
+"""Userspace network chaos layer: a TCP proxy that misbehaves on plan.
+
+The fleet's fault injection so far is entirely in-process —
+fleet/faults.py raises `InjectedFleetFault` inside the router, so no
+socket ever misbehaves and the router's OWN network stack (connect
+timeouts, half-open TCP, black holes) is never exercised. This module
+closes that gap without needing root or iptables: :class:`ChaosProxy`
+is an asyncio TCP proxy that fronts a replica's port, and soaks/smokes
+point the router at the PROXY so every router->replica byte crosses a
+socket the drill controls.
+
+A netem plan is one `key[=val][;key=val...]` clause, the
+`CAKE_FLEET_FAULT_PLAN` grammar pointed at the wire:
+
+    partition           hard partition: refuse new connections and
+                        sever live ones (connection reset — the
+                        kill -9 / cable-pull shape)
+    partition_in        asymmetric: client->server bytes are black-holed
+                        (requests never reach the replica; the
+                        connection stays open and silent)
+    partition_out       asymmetric: server->client bytes are black-holed
+                        (the replica answers into the void — the
+                        probe-alive/data-dead gray failure)
+    blackhole           accept new connections, then never relay a byte
+                        in either direction (SYN-accepted-then-silence:
+                        the failure mode an unbounded attempt timeout
+                        hangs on forever)
+    delay_ms=N          delay every relayed chunk by N ms (brownout)
+    jitter_ms=N         add uniform [0, N] ms on top of delay_ms
+    reset_after_bytes=N sever the connection after N server->client
+                        bytes have been relayed (mid-response reset)
+    heal_after_s=S      auto-heal the plan S seconds after it applies
+    match=SUBSTR        restrict the fault to connections whose client
+                        bytes contain SUBSTR (e.g. `match=/v1/chat`) —
+                        unmatched connections relay clean. The sniff is
+                        CONTINUOUS, not first-bytes-only: a kept-alive
+                        connection that first carried a probe and later
+                        carries matching data traffic becomes subject
+                        the moment the match crosses (routers pool
+                        connections; classifying only the first request
+                        would let data ride probe-classified sockets).
+                        This is what makes the asymmetric
+                        probe-alive/data-dead drill real through one
+                        port: /health traffic passes, data traffic dies.
+
+Plans are runtime-controllable: `apply()`/`heal()` in-process, or the
+tiny line-oriented CONTROL SOCKET (`SET <plan>` / `HEAL` / `STATUS`,
+one JSON reply per line) so a multi-process soak flips faults
+mid-traffic against real router->replica connections. Mid-plan flips
+affect LIVE connections too: the relay pumps consult the current plan
+per chunk, and applying `partition` severs everything in flight.
+
+Like every drill plane in-tree (serve/faults.py, cluster/faults.py,
+fleet/faults.py) this is test/soak tooling: deterministic, stdlib-only,
+and safe to import anywhere — nothing activates without an explicit
+start().
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+from dataclasses import dataclass, field
+
+from ..obs import now
+
+log = logging.getLogger("cake_tpu.fleet.netem")
+
+__all__ = ["ChaosProxy", "NetemPlan", "parse_plan", "control_send"]
+
+# relay chunk size: small enough that delay_ms paces a stream rather
+# than one giant buffered burst, big enough to not dominate CPU
+_CHUNK = 16384
+
+# bare flag keys: `partition` alone means partition=1
+_FLAG_KEYS = ("partition", "partition_in", "partition_out", "blackhole")
+_FLOAT_KEYS = ("delay_ms", "jitter_ms", "heal_after_s")
+_INT_KEYS = ("reset_after_bytes",)
+
+
+@dataclass
+class NetemPlan:
+    """One parsed plan clause. The zero plan (all defaults) relays
+    clean — `ChaosProxy.heal()` just installs it."""
+
+    partition: bool = False
+    partition_in: bool = False
+    partition_out: bool = False
+    blackhole: bool = False
+    delay_ms: float = 0.0
+    jitter_ms: float = 0.0
+    reset_after_bytes: int | None = None
+    heal_after_s: float | None = None
+    match: str = ""
+
+    @classmethod
+    def parse(cls, clause: str) -> "NetemPlan":
+        plan = cls()
+        for part in filter(None, (p.strip() for p in clause.split(";"))):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k in _FLAG_KEYS:
+                setattr(plan, k, v in ("", "1", "true", "on"))
+            elif k in _FLOAT_KEYS:
+                if not v:
+                    raise ValueError(f"netem key {k!r} needs a value")
+                setattr(plan, k, float(v))
+            elif k in _INT_KEYS:
+                if not v:
+                    raise ValueError(f"netem key {k!r} needs a value")
+                setattr(plan, k, int(v))
+            elif k == "match":
+                plan.match = v
+            else:
+                raise ValueError(f"unknown netem key {k!r}")
+        return plan
+
+    def faulty(self) -> bool:
+        """Whether this plan misbehaves at all (the zero plan = healed)."""
+        return bool(self.partition or self.partition_in
+                    or self.partition_out or self.blackhole
+                    or self.delay_ms or self.jitter_ms
+                    or self.reset_after_bytes is not None)
+
+    def snapshot(self) -> dict:
+        out = {}
+        for k in _FLAG_KEYS:
+            if getattr(self, k):
+                out[k] = True
+        for k in _FLOAT_KEYS + _INT_KEYS:
+            v = getattr(self, k)
+            if v:
+                out[k] = v
+        if self.match:
+            out["match"] = self.match
+        return out
+
+
+def parse_plan(spec: str) -> NetemPlan:
+    """Exactly one clause, like faults.parse_plan (a proxy fronts ONE
+    replica; run one proxy per victim)."""
+    clauses = [c for c in (s.strip() for s in spec.split(",")) if c]
+    if len(clauses) != 1:
+        raise ValueError("netem plans take exactly one clause")
+    return NetemPlan.parse(clauses[0])
+
+
+@dataclass(eq=False)            # identity hash: _Conn lives in a set
+class _Conn:
+    """One proxied connection's state (event-loop-confined)."""
+
+    down_w: asyncio.StreamWriter              # towards the client
+    up_w: asyncio.StreamWriter | None = None  # towards the replica
+    out_bytes: int = 0                        # server->client relayed
+    matched: bool = False   # has carried bytes matching a plan's `match`
+                            # (sticky: once data traffic crossed, the
+                            # connection stays classified as data)
+    tasks: list = field(default_factory=list)
+
+    def abort(self) -> None:
+        for w in (self.down_w, self.up_w):
+            if w is None:
+                continue
+            try:
+                w.transport.abort()     # RST, not FIN: a real partition
+            except Exception:
+                pass
+
+
+class ChaosProxy:
+    """TCP proxy fronting one replica port, executing the current
+    :class:`NetemPlan`. All state is event-loop-confined to the loop
+    that start()ed it; the control socket serializes onto the same
+    loop."""
+
+    def __init__(self, target_host: str, target_port: int, *,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0,
+                 control: bool = True, clock=now):
+        self.target_host = target_host
+        self.target_port = int(target_port)
+        self.listen_host = listen_host
+        self._listen_port = int(listen_port)
+        self._want_control = control
+        self._clock = clock
+        self.plan = NetemPlan()
+        self.plan_applied_at: float | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._control: asyncio.AbstractServer | None = None
+        self._conns: set[_Conn] = set()
+        self._heal_task: asyncio.Task | None = None
+        # drill ledger (status() reports it; smokes assert on it)
+        self.accepted = 0
+        self.refused = 0
+        self.severed = 0
+        self.relayed_in = 0
+        self.relayed_out = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.listen_host, self._listen_port)
+        if self._want_control:
+            self._control = await asyncio.start_server(
+                self._handle_control, self.listen_host, 0)
+        log.info("chaos proxy %s:%d -> %s:%d (control %s)",
+                 self.listen_host, self.port,
+                 self.target_host, self.target_port,
+                 self.control_port or "off")
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.listen_host}:{self.port}"
+
+    @property
+    def control_port(self) -> int | None:
+        if self._control is None:
+            return None
+        return self._control.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._heal_task is not None:
+            self._heal_task.cancel()
+            self._heal_task = None
+        for srv in (self._server, self._control):
+            if srv is not None:
+                srv.close()
+                await srv.wait_closed()
+        self._sever_all()
+        self._server = self._control = None
+
+    # -- plan control --------------------------------------------------------
+
+    def apply(self, plan: "NetemPlan | str") -> NetemPlan:
+        """Install a plan. `partition` severs live connections NOW;
+        everything else takes effect per-chunk on live pumps and at
+        accept/first-data on new connections. heal_after_s arms an
+        auto-heal timer (replacing any previous one)."""
+        if isinstance(plan, str):
+            plan = parse_plan(plan)
+        self.plan = plan
+        self.plan_applied_at = self._clock()
+        if self._heal_task is not None:
+            self._heal_task.cancel()
+            self._heal_task = None
+        if plan.partition:
+            # sever every live connection the new plan applies to
+            # (all of them for an unmatched partition; the ones whose
+            # traffic already matched for a `match` partition)
+            self._sever_subject()
+        if plan.heal_after_s is not None:
+            self._heal_task = asyncio.ensure_future(
+                self._auto_heal(plan.heal_after_s))
+        log.warning("netem plan applied: %s", plan.snapshot() or "{}")
+        return plan
+
+    def heal(self) -> None:
+        """Clear the plan: new connections relay clean. Live connections
+        that were black-holed stay broken (a healed network does not
+        resurrect a dead TCP stream) — sever them so both ends notice."""
+        if self._heal_task is not None:
+            self._heal_task.cancel()
+            self._heal_task = None
+        self._sever_subject()
+        self.plan = NetemPlan()
+        self.plan_applied_at = self._clock()
+        log.warning("netem plan healed")
+
+    async def _auto_heal(self, after_s: float) -> None:
+        try:
+            await asyncio.sleep(after_s)
+        except asyncio.CancelledError:
+            return
+        self._heal_task = None
+        self.heal()
+
+    def status(self) -> dict:
+        return {"target": f"{self.target_host}:{self.target_port}",
+                "listen": f"{self.listen_host}:{self.port}",
+                "plan": self.plan.snapshot(),
+                "plan_age_s": round(self._clock() - self.plan_applied_at,
+                                    3)
+                if self.plan_applied_at is not None else None,
+                "live_conns": len(self._conns),
+                "accepted": self.accepted, "refused": self.refused,
+                "severed": self.severed,
+                "relayed_in": self.relayed_in,
+                "relayed_out": self.relayed_out}
+
+    def _sever_all(self) -> None:
+        for conn in list(self._conns):
+            conn.abort()
+            self.severed += 1
+        self._conns.clear()
+
+    def _subject(self, conn: _Conn, plan: NetemPlan | None = None) -> bool:
+        """Whether `plan` (current by default) applies to this
+        connection: every connection for an unmatched plan, only ones
+        whose traffic has carried the match substring otherwise."""
+        plan = plan if plan is not None else self.plan
+        return plan.faulty() and (not plan.match or conn.matched)
+
+    def _sever_subject(self) -> None:
+        """Sever live connections the CURRENT plan applies to — on
+        apply (a partition kills in-flight streams) and on heal (a
+        healed network does not resurrect a black-holed TCP stream;
+        sever so both ends notice and retry clean)."""
+        for conn in list(self._conns):
+            if not self._subject(conn):
+                continue
+            conn.abort()
+            self.severed += 1
+            self._conns.discard(conn)
+
+    # -- data path -----------------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.accepted += 1
+        conn = _Conn(down_w=writer)
+        plan = self.plan
+        if plan.partition and not plan.match:
+            # refuse at accept: the OS already completed the handshake
+            # (we are userspace), so the closest honest shape is an
+            # immediate RST before any byte moves
+            self.refused += 1
+            conn.abort()
+            return
+        # first-data sniff: `match` plans decide per connection from the
+        # first client bytes; unmatched plans fault every connection
+        try:
+            first = await reader.read(_CHUNK)
+        except Exception:
+            first = b""
+        if not first:
+            conn.abort()
+            return
+        plan = self.plan          # re-read: it may have flipped mid-sniff
+        if plan.match and plan.match.encode() in first:
+            conn.matched = True
+        self._conns.add(conn)
+        try:
+            if self._subject(conn, plan) and plan.partition:
+                self.refused += 1
+                return
+            if self._subject(conn, plan) and plan.blackhole:
+                # accept then never respond: drain the client into the
+                # void until the plan changes or the client gives up
+                await self._drain(reader, conn)
+                return
+            try:
+                up_r, up_w = await asyncio.open_connection(
+                    self.target_host, self.target_port)
+            except OSError:
+                return
+            conn.up_w = up_w
+            pump_in = asyncio.ensure_future(
+                self._pump(reader, up_w, conn, inbound=True, first=first))
+            pump_out = asyncio.ensure_future(
+                self._pump(up_r, writer, conn, inbound=False))
+            conn.tasks = [pump_in, pump_out]
+            await asyncio.wait(conn.tasks)
+        finally:
+            self._conns.discard(conn)
+            conn.abort()
+
+    async def _drain(self, reader: asyncio.StreamReader,
+                     conn: _Conn) -> None:
+        while True:
+            try:
+                data = await reader.read(_CHUNK)
+            except Exception:
+                return
+            if not data:
+                return
+            if not self.plan.blackhole:
+                # plan flipped mid-hole: this connection is already a
+                # dead end (its early bytes went nowhere) — sever so
+                # the client retries on a clean one
+                conn.abort()
+                return
+
+    async def _pump(self, reader: asyncio.StreamReader,
+                    writer: asyncio.StreamWriter, conn: _Conn,
+                    inbound: bool, first: bytes = b"") -> None:
+        """One relay direction; consults the live plan per chunk so a
+        mid-stream SET takes effect without reconnecting."""
+        pending = first
+        try:
+            while True:
+                data = pending or await reader.read(_CHUNK)
+                pending = b""
+                if not data:
+                    break
+                plan = self.plan
+                # continuous sniff: a kept-alive connection becomes
+                # subject the moment matching (data) traffic crosses it
+                if (inbound and plan.match and not conn.matched
+                        and plan.match.encode() in data):
+                    conn.matched = True
+                faulted = self._subject(conn, plan)
+                if faulted and plan.partition:
+                    conn.abort()
+                    return
+                if faulted and ((inbound and plan.partition_in)
+                                or (not inbound and plan.partition_out)):
+                    continue        # black hole: read and discard
+                if faulted and (plan.delay_ms or plan.jitter_ms):
+                    await asyncio.sleep(
+                        (plan.delay_ms
+                         + random.uniform(0.0, plan.jitter_ms)) / 1e3)
+                reset = (plan.reset_after_bytes
+                         if faulted and not inbound else None)
+                if reset is not None:
+                    # sever ON the byte budget, not after the chunk that
+                    # crosses it: relay only the remainder, then reset
+                    data = data[:max(reset - conn.out_bytes, 0)]
+                    if not data:
+                        self.severed += 1
+                        conn.abort()
+                        return
+                writer.write(data)
+                await writer.drain()
+                if inbound:
+                    self.relayed_in += len(data)
+                else:
+                    self.relayed_out += len(data)
+                    conn.out_bytes += len(data)
+                    if reset is not None and conn.out_bytes >= reset:
+                        self.severed += 1
+                        conn.abort()
+                        return
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.write_eof()
+            except Exception:
+                pass
+
+    # -- control socket ------------------------------------------------------
+
+    async def _handle_control(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        """Line protocol: `SET <plan>` / `HEAL` / `STATUS`, one JSON
+        object per reply line. Errors answer {"ok": false, ...} and
+        keep the session open — a soak driver's typo must not kill the
+        drill."""
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                cmd, _, arg = line.decode("utf-8",
+                                          "replace").strip().partition(" ")
+                cmd = cmd.upper()
+                try:
+                    if cmd == "SET":
+                        plan = self.apply(arg)
+                        reply = {"ok": True, "plan": plan.snapshot()}
+                    elif cmd == "HEAL":
+                        self.heal()
+                        reply = {"ok": True, "plan": {}}
+                    elif cmd == "STATUS":
+                        reply = {"ok": True, **self.status()}
+                    else:
+                        reply = {"ok": False,
+                                 "error": f"unknown command {cmd!r} "
+                                          "(SET/HEAL/STATUS)"}
+                except ValueError as e:
+                    reply = {"ok": False, "error": str(e)}
+                writer.write(json.dumps(reply).encode() + b"\n")
+                await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+async def control_send(host: str, port: int, command: str) -> dict:
+    """One control-socket round trip (soak drivers in OTHER processes
+    flip faults with this): send one command line, return the parsed
+    JSON reply."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(command.strip().encode() + b"\n")
+        await writer.drain()
+        line = await reader.readline()
+        if not line:
+            raise ConnectionError("netem control socket closed")
+        return json.loads(line)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass

@@ -27,8 +27,25 @@ State machine (docs/fleet.md has the diagram):
                or /health says down/wedged)--> EJECTED
     EJECTED --(hold expires AND a probe succeeds)--> HALF_OPEN
     HALF_OPEN --(one successful trial request,
-                 or two consecutive healthy probes)--> HEALTHY
+                 or two consecutive healthy probes
+                 *probe-evidence ejects only*)--> HEALTHY
     HALF_OPEN --(any failure)--> EJECTED (hold doubles, capped 8x)
+
+Every eject carries an EVIDENCE dimension: "data" when the router's own
+request path produced the evidence (transport failures, error rate,
+TTFB p95 — including the half-open trial failing), "probe" when only
+the /health probe path did. Data evidence is sticky for the episode and
+gates readmission: probe successes alone can NEVER clear a
+data-evidence eject — only the half-open data-path trial lease can.
+This kills the asymmetric-partition flap, where a replica whose probe
+path is alive but whose data path is partitioned would otherwise
+readmit on two healthy probes, fail its next real request, re-eject,
+and loop. While a data-evidence eject is open the replica is in a
+suspected-PARTITION episode (surfaced in /fleet, the replica
+pseudo-timeline, and cake_fleet_partition_seconds_total). Readmission
+does not reset the hold-doubling streak — repeated partition/heal
+cycles find their re-eject hold doubled each round (no reputation
+laundering); the streak expires only after a quiet forget window.
 
 DRAINING is orthogonal: a replica whose engine block says draining keeps
 its machine state but stops taking NEW requests (in-flight ones finish)
@@ -44,10 +61,10 @@ import threading
 from dataclasses import dataclass
 
 from .. import knobs
-from ..obs import (FLEET_EJECTS, FLEET_READMITS, FLEET_REPLICAS,
-                   FLEET_REPLICA_INFLIGHT, FLEET_REPLICA_OCCUPANCY,
-                   FLEET_REPLICA_OUTLIER, FLEET_REPLICA_QUEUE_DEPTH,
-                   FLEET_REPLICA_STALE, now)
+from ..obs import (FLEET_EJECTS, FLEET_PARTITION_SECONDS, FLEET_READMITS,
+                   FLEET_REPLICAS, FLEET_REPLICA_INFLIGHT,
+                   FLEET_REPLICA_OCCUPANCY, FLEET_REPLICA_OUTLIER,
+                   FLEET_REPLICA_QUEUE_DEPTH, FLEET_REPLICA_STALE, now)
 
 __all__ = ["Replica", "ReplicaRegistry", "MembershipPolicy",
            "discover_replicas", "HEALTHY", "EJECTED", "HALF_OPEN"]
@@ -69,6 +86,12 @@ MAX_EJECT_BACKOFF = 8
 # per-replica in-flight fallback before the first health probe reports a
 # slot count (auto cap = 2x slots once known)
 DEFAULT_INFLIGHT_CAP = 8
+
+# eject-streak forget window, in multiples of the FULLY BACKED-OFF hold:
+# a replica that stays out of trouble this long after its last eject has
+# its hold-doubling reputation expired (readmission alone never resets
+# the streak — see _readmit)
+EJECT_FORGET_HOLDS = 2
 
 
 @dataclass(frozen=True)
@@ -127,6 +150,21 @@ class Replica:
         self.last_probe_ok = None       # guarded-by: self._lock
         self.ejects = 0                 # guarded-by: self._lock
         self.readmits = 0               # guarded-by: self._lock
+        # evidence behind the OPEN eject episode: "data" (request-path
+        # transport/error evidence) or "probe" (/health evidence only);
+        # None = no open episode. Data evidence is sticky and gates
+        # readmission to the data-path trial (see module docstring).
+        self.eject_evidence = None      # guarded-by: self._lock
+        # suspected-partition episode (open while eject_evidence is
+        # "data"): wall-clock start + last accrual point feeding
+        # cake_fleet_partition_seconds_total incrementally
+        self.partition_since = None     # guarded-by: self._lock
+        self._partition_accrued_at = 0.0  # guarded-by: self._lock
+        # eject-streak decay clock (EJECT_FORGET_HOLDS)
+        self._last_eject_at = 0.0       # guarded-by: self._lock
+        # membership events pending pickup by the router probe loop
+        # into the replica:<name> pseudo-timelines
+        self._pending_events = []       # guarded-by: self._lock
         # warm-up clock: when THIS router first saw this replica (reset
         # on re-registration and on detected in-place restart). The
         # autoscaler holds while any replica is younger than
@@ -274,7 +312,12 @@ class Replica:
         cannot serve. Healthy probes drive the ejected -> half_open ->
         readmit side of the machine, so an idle fleet still readmits
         without waiting for live traffic to gamble on the replica.
+        A data-evidence (suspected-partition) eject is the exception:
+        healthy probes can advance it to HALF_OPEN but never readmit it
+        — the probe path answering says nothing about the data path
+        that produced the evidence; only the trial lease does.
         Returns an eject reason when the probe ejected, else None."""
+        self._accrue_partition()
         with self._lock:
             if status is None:
                 self.last_probe_ok = False
@@ -339,7 +382,8 @@ class Replica:
                 self.probe_ok_streak = 1
             elif self.state == HALF_OPEN:
                 self.probe_ok_streak += 1
-                if self.probe_ok_streak >= 2:
+                if (self.probe_ok_streak >= 2
+                        and self.eject_evidence != "data"):
                     self._readmit()
             return None
 
@@ -371,6 +415,18 @@ class Replica:
 
     def _eject(self, reason: str) -> str:
         with self._lock:
+            evidence = "probe" if reason == "health" else "data"
+            if self.eject_evidence == "data":
+                evidence = "data"   # sticky across the open episode: a
+                                    # probe-reason re-eject mid-episode
+                                    # must not downgrade the readmit gate
+            self.eject_evidence = evidence
+            forget_s = (self.policy.eject_s * MAX_EJECT_BACKOFF
+                        * EJECT_FORGET_HOLDS)
+            if (forget_s > 0 and self._last_eject_at
+                    and now() - self._last_eject_at > forget_s):
+                self.eject_streak = 0   # reputation expired: quiet since
+            self._last_eject_at = now()
             self.eject_streak += 1
             hold = self.policy.eject_s * min(2 ** (self.eject_streak - 1),
                                              MAX_EJECT_BACKOFF)
@@ -379,19 +435,63 @@ class Replica:
             self.trial_inflight = False
             self.results.clear()
             self.ejects += 1
+            if evidence == "data" and self.partition_since is None:
+                self.partition_since = now()
+                self._partition_accrued_at = self.partition_since
+                self._pending_events.append(
+                    ("replica_partition_suspected",
+                     {"replica": self.name, "reason": reason,
+                      "hold_s": round(hold, 3)}))
             self._transition(EJECTED)
-        FLEET_EJECTS.inc(replica=self.name, reason=reason)
+        FLEET_EJECTS.inc(replica=self.name, reason=reason,
+                         evidence=evidence)
         return reason
 
     def _readmit(self) -> None:
         with self._lock:
-            self.eject_streak = 0
+            # eject_streak intentionally SURVIVES readmission: a
+            # partition/heal flap must find its re-eject hold doubled
+            # each round; the streak expires only after the quiet
+            # forget window (_eject)
             self.consec_fails = 0
             self.probe_ok_streak = 0
             self.trial_inflight = False
             self.readmits += 1
+            if self.partition_since is not None:
+                self._accrue_partition()
+                self._pending_events.append(
+                    ("partition_healed",
+                     {"replica": self.name,
+                      "episode_s": round(now() - self.partition_since,
+                                         3)}))
+                self.partition_since = None
+            self.eject_evidence = None
             self._transition(HEALTHY)
         FLEET_READMITS.inc(replica=self.name)
+
+    def _accrue_partition(self) -> None:
+        """Feed the open partition episode's elapsed time into
+        cake_fleet_partition_seconds_total incrementally (each probe
+        cycle), so the counter climbs DURING an episode instead of
+        jumping at heal."""
+        with self._lock:
+            if self.partition_since is None:
+                return
+            t = now()
+            delta = t - self._partition_accrued_at
+            self._partition_accrued_at = t
+        if delta > 0:
+            FLEET_PARTITION_SECONDS.inc(delta, replica=self.name)
+
+    def drain_events(self) -> list:
+        """Pop pending membership events as (kind, attrs) tuples — the
+        router probe loop records them into the replica:<name>
+        pseudo-timelines so partition episodes show up in the stitched
+        two-tier timeline."""
+        with self._lock:
+            ev = self._pending_events
+            self._pending_events = []
+            return ev
 
     def history(self) -> dict:
         """The membership reputation that outlives removal (registry
@@ -401,7 +501,9 @@ class Replica:
             return {"ejects": self.ejects,
                     "eject_streak": self.eject_streak,
                     "readmits": self.readmits,
-                    "eject_until": self.eject_until}
+                    "eject_until": self.eject_until,
+                    "eject_evidence": self.eject_evidence,
+                    "last_eject_at": self._last_eject_at}
 
     def cordon(self) -> None:
         """Router-side drain mark (lifecycle scale-in): stop routing NEW
@@ -427,9 +529,16 @@ class Replica:
             self.ejects = int(hist.get("ejects") or 0)
             self.eject_streak = int(hist.get("eject_streak") or 0)
             self.readmits = int(hist.get("readmits") or 0)
+            self._last_eject_at = float(hist.get("last_eject_at") or 0.0)
             until = float(hist.get("eject_until") or 0.0)
             if until > now():
                 self.eject_until = until
+                # the evidence gate survives re-announce with the hold:
+                # a data-evidence eject still demands a data-path trial
+                self.eject_evidence = hist.get("eject_evidence")
+                if self.eject_evidence == "data":
+                    self.partition_since = now()
+                    self._partition_accrued_at = self.partition_since
                 self._transition(EJECTED)
 
     def set_outlier(self, flag: bool, reason: str | None = None) -> None:
@@ -468,6 +577,10 @@ class Replica:
                 "eject_streak": self.eject_streak,
                 "ejects": self.ejects,
                 "readmits": self.readmits,
+                "eject_evidence": self.eject_evidence,
+                "partition_s": (round(now() - self.partition_since, 3)
+                                if self.partition_since is not None
+                                else None),
                 "last_probe_ok": self.last_probe_ok,
                 "stale": self.last_probe_ok is False,
                 "warm_age_s": round(now() - self.first_seen, 3),
@@ -550,13 +663,25 @@ class ReplicaRegistry:
             self._rr += 1
             return self._rr - 1
 
+    def drain_events(self) -> list:
+        """Collect every replica's pending membership events (see
+        Replica.drain_events)."""
+        out = []
+        for r in self.replicas():
+            out.extend(r.drain_events())
+        return out
+
     # -- fleet views ---------------------------------------------------------
 
     def routable_count(self) -> int:
         return sum(1 for r in self.replicas() if r.routable())
 
     def total_capacity(self) -> int:
-        return sum(r.cap() for r in self.replicas())
+        """Admission capacity of the fleet = sum of ROUTABLE replicas'
+        caps: an ejected (e.g. partitioned) or draining replica
+        contributes nothing — counting it would let the router admit
+        load the remaining replicas cannot carry."""
+        return sum(r.cap() for r in self.replicas() if r.routable())
 
     def total_queue_depth(self) -> int:
         return sum(r.snapshot()["queue_depth"] for r in self.replicas())

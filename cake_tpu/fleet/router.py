@@ -98,6 +98,33 @@ def _transport_errors():
         return _TRANSPORT_ERRORS
 
 
+async def _deadline(aw, seconds):
+    """Await `aw` under a deadline WITHOUT asyncio.wait_for: wait_for
+    runs the awaitable in a child task, and the extra loop ticks that
+    costs lose races against data that is already buffered — a replica
+    that streams chunks and severs in the same breath would have its
+    connection_lost exception processed before the relay loop reads the
+    buffered chunks, turning a resumable post-commit break into a
+    from-scratch retry. A call_later watchdog cancels in place instead;
+    an overrun raises asyncio.TimeoutError (a classified transport
+    failure), an external cancellation passes through untouched."""
+    if not seconds:
+        return await aw
+    task = asyncio.current_task()
+    fired = []
+    handle = asyncio.get_running_loop().call_later(
+        seconds, lambda: (fired.append(True), task.cancel()))
+    try:
+        return await aw
+    except asyncio.CancelledError:
+        if fired:
+            raise asyncio.TimeoutError(
+                f"no response within {seconds}s") from None
+        raise
+    finally:
+        handle.cancel()
+
+
 class _ClientGone(Exception):
     """Our DOWNSTREAM client vanished mid-relay. Distinct from upstream
     transport failures so a disconnecting client is never recorded as a
@@ -172,6 +199,8 @@ class FleetRouter:
                  affinity: bool | None = None,
                  affinity_blocks: int | None = None,
                  attempt_timeout_s: float | None = None,
+                 connect_timeout_s: float | None = None,
+                 first_byte_timeout_s: float | None = None,
                  probe_s: float | None = None,
                  cluster_key: str | None = None,
                  discover_s: float | None = None,
@@ -194,6 +223,18 @@ class FleetRouter:
         self.attempt_timeout_s = attempt_timeout_s \
             if attempt_timeout_s is not None \
             else knobs.get("CAKE_FLEET_ATTEMPT_TIMEOUT_S")
+        # split deadlines (non-zero defaults): connect bounds the
+        # refused/black-holed-SYN shapes, first-byte bounds
+        # accept-then-silence — both classify as retryable transport
+        # failures, converting a partition into bounded failover instead
+        # of an attempt that hangs forever (the deprecated 0.0=forever
+        # attempt timeout left both unbounded by default)
+        self.connect_timeout_s = connect_timeout_s \
+            if connect_timeout_s is not None \
+            else knobs.get("CAKE_FLEET_CONNECT_TIMEOUT_S")
+        self.first_byte_timeout_s = first_byte_timeout_s \
+            if first_byte_timeout_s is not None \
+            else knobs.get("CAKE_FLEET_FIRST_BYTE_TIMEOUT_S")
         self.probe_s = probe_s if probe_s is not None \
             else knobs.get("CAKE_FLEET_PROBE_S")
         self.cluster_key = cluster_key
@@ -295,6 +336,14 @@ class FleetRouter:
         await asyncio.gather(*(probe(r)
                                for r in self.registry.replicas()))
         self.registry.publish()
+        # membership events (partition suspected/healed) land in
+        # per-replica pseudo-timelines (rid "replica:<name>") on the
+        # router-tier store, so an episode is visible in the stitched
+        # timeline view next to the requests it disrupted
+        for kind, attrs in self.registry.drain_events():
+            rid = f"replica:{attrs.get('replica', '?')}"
+            self.timelines.begin(rid, tier="fleet")
+            self.timelines.event(rid, kind, **attrs)
         # same cadence as the probes: scrape /metrics and roll up the
         # telemetry plane (stale replicas were just flagged above, so
         # this cycle's rollup already excludes them)
@@ -436,8 +485,15 @@ class FleetRouter:
                 if stall:
                     await asyncio.sleep(stall)
             import aiohttp
+            # split deadlines: connect bounds the handshake, sock_read
+            # bounds every read GAP — which covers waiting for response
+            # headers, so a black-holed replica (SYN accepted, nothing
+            # ever sent) fails in bounded time; the deprecated total
+            # attempt deadline still rides on top when set
             tmo = aiohttp.ClientTimeout(
-                total=self.attempt_timeout_s or None)
+                total=self.attempt_timeout_s or None,
+                connect=self.connect_timeout_s or None,
+                sock_read=self.first_byte_timeout_s or None)
             t0 = now()
             async with self.session.post(
                     rep.base_url + "/v1/chat/completions",
@@ -1066,11 +1122,21 @@ class FleetRouter:
                 if stall:
                     await asyncio.sleep(stall)
             import aiohttp
-            tmo = aiohttp.ClientTimeout(total=None)
-            async with self.session.post(
-                    rep.base_url + "/v1/chat/completions",
-                    json=body, timeout=tmo,
-                    headers=self._trace_headers(rid, fwd)) as r:
+            # streams: connect deadline on the handshake, first-byte
+            # deadline on the wait for response HEADERS — a replica
+            # streams headers at prepare time, before its first token,
+            # so the accept-then-silence black hole fails here in
+            # bounded time as a retryable transport failure. The body
+            # relay stays UNBOUNDED: generation time is open-ended and
+            # the stream-resume plane owns mid-body breaks.
+            tmo = aiohttp.ClientTimeout(
+                total=None, connect=self.connect_timeout_s or None)
+            hdrs_aw = self.session.post(
+                rep.base_url + "/v1/chat/completions",
+                json=body, timeout=tmo,
+                headers=self._trace_headers(rid, fwd))
+            async with await _deadline(
+                    hdrs_aw, self.first_byte_timeout_s) as r:
                 if r.status != 200:
                     data = await r.read()
                     if r.status in (500, 502, 503):

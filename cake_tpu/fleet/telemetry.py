@@ -459,7 +459,13 @@ class FleetTelemetry:
         # marked it (either way its numbers must not enter the rollup)
         stale = {name for name in reps
                  if scrapes.get(name) is None or snaps[name].get("stale")}
-        usable = [n for n in live if n not in stale]
+        # ejected replicas drop out of the rollup like dead ones even
+        # when their scrape/probe path still answers — the asymmetric
+        # partition case (probe-alive, data-dead) would otherwise keep
+        # contributing headroom the router cannot actually route to
+        ejected = {name for name in reps
+                   if snaps[name].get("state") == "ejected"}
+        usable = [n for n in live if n not in stale and n not in ejected]
 
         # fleet percentiles: bucket-wise sums of windowed deltas
         percentiles: dict[str, dict] = {}
@@ -515,10 +521,13 @@ class FleetTelemetry:
                    "queue_depth": snap["queue_depth"],
                    "occupancy": snap["occupancy"],
                    "inflight": snap["inflight"],
+                   "eject_evidence": snap.get("eject_evidence"),
+                   "partition_s": snap.get("partition_s"),
                    "ttft_p95_ms": None, "err_rate": None,
                    "tokens_per_s": None, "accept_rate": None,
                    "headroom_tokens_per_s": 0.0}
-            if sig is not None and name not in stale:
+            if sig is not None and name not in stale \
+                    and name not in ejected:
                 tok = self.bank.get(f"tok/{name}")
                 rate = tok.rate(self.fast_window_s) if tok else 0.0
                 row["tokens_per_s"] = round(rate, 3)

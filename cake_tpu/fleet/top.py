@@ -98,6 +98,8 @@ def render_screen(body: dict, base_url: str = "",
         flags = []
         if row.get("stale"):
             flags.append("stale")
+        if row.get("partition_s") is not None:
+            flags.append(f"partition({row['partition_s']:.0f}s)")
         if row.get("outlier"):
             reason = row.get("outlier_reason")
             flags.append(f"outlier({reason})" if reason

@@ -227,9 +227,24 @@ _knob("CAKE_FLEET_AFFINITY_BLOCKS", int, 64, "fleet",
       "window: it must comfortably cover the system prompt, or every "
       "conversation hashes to the same key and one replica goes hot")
 _knob("CAKE_FLEET_ATTEMPT_TIMEOUT_S", float, 0.0, "fleet",
-      "per-attempt deadline on one replica try (connect + response); an "
-      "overrun counts as a transport failure and the request fails over; "
-      "0 disables (generation time is unbounded by default)")
+      "DEPRECATED single per-attempt deadline on one replica try "
+      "(connect + full response); still honored when set > 0, but the "
+      "0.0=forever default is superseded by the split "
+      "CAKE_FLEET_CONNECT_TIMEOUT_S / CAKE_FLEET_FIRST_BYTE_TIMEOUT_S "
+      "deadlines, which bound the partition-shaped hangs this knob left "
+      "unbounded by default")
+_knob("CAKE_FLEET_CONNECT_TIMEOUT_S", float, 5.0, "fleet",
+      "per-attempt TCP connect deadline on one replica try; an overrun "
+      "counts as a transport failure and the request fails over — "
+      "bounds the refused/black-holed-SYN partition shapes; 0 disables "
+      "(not recommended: that re-opens the unbounded hang)")
+_knob("CAKE_FLEET_FIRST_BYTE_TIMEOUT_S", float, 120.0, "fleet",
+      "per-attempt first-byte deadline: time from request sent to the "
+      "first response byte (headers) on one replica try, covering the "
+      "accept-then-never-respond black hole; streamed bodies stay "
+      "unbounded after the first byte (the stream-resume plane handles "
+      "mid-body breaks); an overrun is a retryable transport failure; "
+      "0 disables")
 _knob("CAKE_FLEET_DISCOVER_S", float, 0.0, "fleet",
       "periodic UDP re-discovery interval: newly announced `cake serve "
       "--announce` replicas join the registry without a router restart; "

@@ -292,12 +292,23 @@ FLEET_SHEDS = REGISTRY.counter(
 FLEET_EJECTS = REGISTRY.counter(
     "cake_fleet_ejects_total",
     "Replica ejections from routing membership",
-    labelnames=("replica", "reason"))   # fails | error_rate | ttft_p95 |
-                                        # health
+    labelnames=("replica", "reason", "evidence"))
+                                        # reason: fails | error_rate |
+                                        #   ttft_p95 | health
+                                        # evidence: data (transport /
+                                        #   request-path) | probe
+                                        #   (health-probe-path only)
 
 FLEET_READMITS = REGISTRY.counter(
     "cake_fleet_readmits_total",
     "Replicas readmitted to routing after a half-open trial succeeded",
+    labelnames=("replica",))
+
+FLEET_PARTITION_SECONDS = REGISTRY.counter(
+    "cake_fleet_partition_seconds_total",
+    "Cumulative seconds replicas have spent in a suspected-partition "
+    "episode (ejected on data-path/transport evidence, not yet "
+    "readmitted through a data-path trial)",
     labelnames=("replica",))
 
 FLEET_RETRIES = REGISTRY.counter(
@@ -441,7 +452,8 @@ __all__ = [
     "SPEC_BUCKET_ACCEPTED",
     "FLEET_REPLICAS", "FLEET_REPLICA_QUEUE_DEPTH",
     "FLEET_REPLICA_OCCUPANCY", "FLEET_REPLICA_INFLIGHT", "FLEET_SHEDS",
-    "FLEET_EJECTS", "FLEET_READMITS", "FLEET_RETRIES", "FLEET_HEDGES",
+    "FLEET_EJECTS", "FLEET_READMITS", "FLEET_PARTITION_SECONDS",
+    "FLEET_RETRIES", "FLEET_HEDGES",
     "FLEET_PROXIED", "FLEET_STREAM_RESUMES",
     "FLEET_SLO_BURN_RATE", "FLEET_HEADROOM_TOKENS",
     "FLEET_REPLICA_OUTLIER", "FLEET_REPLICA_STALE",

@@ -108,6 +108,15 @@ EVENT_KINDS: dict[str, str] = {
                       "client: first spliced chunk relayed on the same "
                       "socket (`replica`, `overlap_chars` stripped)",
     "done": "terminal: router relayed the final response (`status`)",
+    "replica_partition_suspected": "membership ejected a replica on "
+                                   "data-path/transport evidence while "
+                                   "its probe path may still answer "
+                                   "(`replica`, `reason`, `hold_s`); "
+                                   "readmit now requires a data-path "
+                                   "trial",
+    "partition_healed": "a suspected-partition episode ended: the "
+                        "replica passed a data-path trial and rejoined "
+                        "routing (`replica`, `episode_s`)",
 }
 
 # terminal kinds bypass the per-timeline cap: a truncated timeline must

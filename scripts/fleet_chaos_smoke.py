@@ -21,7 +21,12 @@ Asserts, in order:
      stream_broken -> stream_resume -> resume_spliced -> done;
   5. with the resume budget at 0 the legacy typed error event is
      preserved — now carrying a resume_token + honest content
-     accounting so a client can finish via continuation mode.
+     accounting so a client can finish via continuation mode;
+  6. REAL network partition (fleet/netem.ChaosProxy on the wire): a
+     replica's traffic is rerouted through a chaos proxy and hard
+     partitioned — ZERO client-visible errors across the episode,
+     exactly ONE eject, and after heal the replica readmits through a
+     data-path trial (the deeper drills live in partition_smoke.py).
 
 Every phase polls WITH A DEADLINE (the serve-chaos lesson: fixed sleeps
 flake on this container's slow CPU). Exits non-zero on any missing
@@ -47,8 +52,9 @@ from aiohttp import web                                    # noqa: E402
 from aiohttp.test_utils import TestClient, TestServer      # noqa: E402
 
 from cake_tpu.api import ApiState, create_app              # noqa: E402
-from cake_tpu.fleet import (FleetRouter, MembershipPolicy,  # noqa: E402
-                            ReplicaRegistry, create_router_app)
+from cake_tpu.fleet import (ChaosProxy, FleetRouter,       # noqa: E402
+                            MembershipPolicy, ReplicaRegistry,
+                            create_router_app)
 from cake_tpu.fleet import faults as fleet_faults          # noqa: E402
 from cake_tpu.models import TextModel, tiny_config         # noqa: E402
 from cake_tpu.serve import ServeEngine                     # noqa: E402
@@ -141,6 +147,27 @@ async def _poll_fleet(client, pred, deadline_s: float, what: str):
     raise AssertionError(f"timed out waiting for {what}: {snap}")
 
 
+async def _pump_fleet(client, pred, deadline_s: float, what: str,
+                      statuses: list | None = None):
+    """_poll_fleet with chat traffic flowing: a replica ejected on DATA
+    evidence readmits only through a successful data-path trial request
+    — probes alone can never clear it, so an idle poll would wait
+    forever."""
+    deadline = time.monotonic() + deadline_s
+    snap, convo = None, 9000
+    while time.monotonic() < deadline:
+        convo += 1
+        r = await _chat(client, convo, 0)
+        await r.read()
+        if statuses is not None:
+            statuses.append(r.status)
+        snap = await (await client.get("/fleet")).json()
+        if pred(snap):
+            return snap
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}: {snap}")
+
+
 async def main_async() -> dict:
     model = TextModel(tiny_config("llama"), dtype=jnp.float32,
                       max_cache_len=CTX)
@@ -193,11 +220,15 @@ async def main_async() -> dict:
 
         # -- phase 2: restart the replica -> readmission ------------------
         await victim.start()            # same port, same name
-        snap = await _poll_fleet(
+        # the kill produced DATA evidence, so readmission needs a real
+        # data-path trial — pump traffic while polling
+        snap = await _pump_fleet(
             client, lambda s: any(r["name"] == victim.name
                                   and r["state"] == "healthy"
                                   for r in s["replicas"]),
-            15.0, f"{victim.name} readmitted")
+            20.0, f"{victim.name} readmitted", statuses)
+        failed = [s for s in statuses if s != 200]
+        assert not failed, f"readmit pump saw client errors: {failed}"
         out["readmitted_visible"] = True
         assert snap["routable"] == N_REPLICAS
 
@@ -345,6 +376,46 @@ async def main_async() -> dict:
         finally:
             fleet_faults.clear()
             router.stream_resumes = 1
+
+        # -- phase 6: REAL network partition via the chaos proxy ----------
+        pvict = replicas[0]
+        proxy = ChaosProxy("127.0.0.1", pvict.port)
+        await proxy.start()
+        registry.add(pvict.name, proxy.base_url)   # reroute over the wire
+        part_statuses: list = []
+
+        def prow(s):
+            return next(x for x in s["replicas"]
+                        if x["name"] == pvict.name)
+
+        try:
+            r = await _chat(client, 700, 0)        # crosses the proxy
+            await r.read()
+            assert r.status == 200
+            ej_before = prow(await (await client.get("/fleet")).json()
+                             )["ejects"]
+            proxy.apply("partition")
+            for i in range(8):                     # absorbed by failover
+                r = await _chat(client, 710 + i, 0)
+                await r.read()
+                part_statuses.append(r.status)
+            snap = await _poll_fleet(
+                client, lambda s: prow(s)["state"] == "ejected",
+                10.0, f"{pvict.name} partition-ejected")
+            assert prow(snap)["ejects"] == ej_before + 1, \
+                "a partition episode must cost exactly one eject"
+            proxy.heal()
+            snap = await _pump_fleet(
+                client, lambda s: prow(s)["state"] == "healthy",
+                30.0, f"{pvict.name} readmitted after heal",
+                part_statuses)
+            failed = [s for s in part_statuses if s != 200]
+            assert not failed, f"partition leg saw client errors: {failed}"
+            out["partition_leg"] = {"requests": len(part_statuses),
+                                    "errors": 0, "readmitted": True}
+        finally:
+            registry.add(pvict.name, f"http://127.0.0.1:{pvict.port}")
+            await proxy.close()
 
         # fleet health is clean again
         h = await client.get("/health")

@@ -21,9 +21,15 @@ Asserts, in order:
      every reap is graceful (forced=False: drained, never SIGKILLed);
   4. kill -9: a managed replica killed outright is reaped by the sweep
      (`died` on the decisions ring) and replaced via below_min;
-  5. ZERO client-visible errors across every phase (transparent
-     failover absorbs the kill; cordons absorb the drains);
-  6. zero frozen-gauge contamination: every retired/died replica's
+  5. NETWORK PARTITION (fleet/netem.ChaosProxy on the wire): a managed
+     replica whose PROCESS STAYS ALIVE is partitioned — it is ejected,
+     its headroom leaves the capacity rollup, and the same below_min
+     rule spawns a replacement; on heal the victim readmits through a
+     data-path trial exactly once (no capacity double-count);
+  6. ZERO client-visible errors across every phase (transparent
+     failover absorbs the kill and the partition; cordons absorb the
+     drains);
+  7. zero frozen-gauge contamination: every retired/died replica's
      per-replica labelsets are retracted from router /metrics and gone
      from the telemetry rollup.
 
@@ -330,7 +336,51 @@ async def main_async(args) -> dict:
                                  f"{ring_kinds(snap)}")
         retired.add(victim["name"])
 
-        # -- phase 5: ledgers --------------------------------------------
+        # -- phase 5: network partition -> below_min replacement + heal ---
+        from cake_tpu.fleet import ChaosProxy
+        snap = await fleet()
+        vrow = next(r for r in snap["replicas"] if r["state"] == "healthy")
+        vname, vurl = vrow["name"], vrow["base_url"]
+        proxy = ChaosProxy("127.0.0.1", int(vurl.rsplit(":", 1)[1]))
+        await proxy.start()
+        registry.add(vname, proxy.base_url)     # reroute over the wire
+        try:
+            t0 = time.monotonic()
+            proxy.apply("partition")
+            out["partitioned"] = vname
+            # the process is ALIVE but the network is gone: ejected
+            snap = await _poll(fleet, lambda s: any(
+                r["name"] == vname and r["state"] == "ejected"
+                for r in s["replicas"]), 60.0, "partitioned replica ejected")
+            # capacity honesty: the partitioned replica's headroom is out
+            # of the rollup the autoscaler reads
+            async with session.get(base + "/api/v1/fleet/telemetry") as r:
+                roll = await r.json()
+            vtel = (roll.get("replicas") or {}).get(vname) or {}
+            assert not vtel.get("headroom_tokens_per_s"), vtel
+            # the SAME below_min rule that replaces a dead process
+            # replaces a partitioned one — routable capacity is what
+            # counts, not process liveness
+            await _poll(autoscale,
+                        lambda s: len(s["lifecycle"]["managed"]) >= 3,
+                        90.0, "below_min spawned a partition replacement")
+            await _poll(fleet, lambda s: s["routable"] >= 2, 300.0,
+                        "partition replacement admitted")
+            out["partition_replace_s"] = round(time.monotonic() - t0, 1)
+            # heal: the victim readmits through a data-path trial (the
+            # trickle supplies it) and is counted exactly once
+            proxy.heal()
+            snap = await _poll(fleet, lambda s: any(
+                r["name"] == vname and r["state"] == "healthy"
+                for r in s["replicas"]), 180.0, "healed replica readmitted")
+            names = [r["name"] for r in snap["replicas"]]
+            assert names.count(vname) == 1, names
+            out["partition_heal_readmit"] = True
+        finally:
+            registry.add(vname, vurl)           # direct again
+            await proxy.close()
+
+        # -- phase 6: ledgers --------------------------------------------
         await load.stop_all()
         errors = load.errors()
         assert not errors, f"client-visible errors: {errors[:10]} " \

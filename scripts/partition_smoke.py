@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""Partition smoke: real serve replicas behind the fleet router with a
+REAL network chaos layer (fleet/netem.ChaosProxy — actual TCP relay,
+actual severs/black-holes/delays) in front of the victim. The partition
+shapes a health-checker is most often fooled by are drilled end to end:
+
+  1. FULL partition: the victim's port refuses + live connections are
+     severed — traffic stays clean via failover, the victim is ejected
+     within a bounded window, EXACTLY ONE eject for the whole episode,
+     and capacity drops out of total_capacity while it is gone;
+  2. ASYMMETRIC probe-alive/data-dead (flipped at runtime through the
+     proxy's CONTROL SOCKET): /health flows, /v1/chat dies — the eject
+     carries evidence="data", healthy probes park the replica in
+     half_open but may NEVER readmit it, the data-path trial fails and
+     re-ejects with a DOUBLED hold (damped flap), and only after the
+     network heals does a successful trial readmit it;
+  3. DELAY brownout: every byte is delayed past the router's
+     first-byte deadline — requests fail over in bounded time instead
+     of wedging, and the victim cycles eject -> heal -> readmit;
+  4. ledger: ZERO client-visible errors across every leg, the evidence
+     dimension is in /fleet and cake_fleet_ejects_total, the episode
+     accrued cake_fleet_partition_seconds_total, and the
+     replica_partition_suspected -> partition_healed event pair is in
+     the victim's replica:<name> pseudo-timeline.
+
+Every phase polls WITH A DEADLINE (fixed sleeps flake on this
+container's slow CPU). Exits non-zero on any missing signal. Run via
+`make partition-smoke` (tier-2; not part of the tier-1 pytest run).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                    # noqa: E402
+from aiohttp import web                                    # noqa: E402
+from aiohttp.test_utils import TestClient, TestServer      # noqa: E402
+
+from cake_tpu.api import ApiState, create_app              # noqa: E402
+from cake_tpu.fleet import (ChaosProxy, FleetRouter,       # noqa: E402
+                            MembershipPolicy, ReplicaRegistry,
+                            create_router_app)
+from cake_tpu.fleet.netem import control_send              # noqa: E402
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+
+CTX = 128
+N_REPLICAS = 3
+MAX_NEW = 8
+
+
+class SmokeTok:
+    """Word-hash prose, round-trip for generated ids (decode emits
+    " t<id>", encode parses them back) — the fleet smokes' tokenizer."""
+
+    def encode(self, text):
+        out = []
+        for w in text.split():
+            if w[:1] == "t" and w[1:].isdigit():
+                out.append(int(w[1:]))
+            else:
+                out.append(3 + (sum(w.encode()) % 200))
+        return out[:64] or [3]
+
+    def decode(self, ids):
+        return "".join(f" t{i}" for i in ids)
+
+
+class ReplicaProc:
+    """One in-process serve replica: real engine, real HTTP socket."""
+
+    def __init__(self, name: str, model):
+        self.name = name
+        self.engine = ServeEngine(model, slots=2, max_queue=16, ctx_len=CTX)
+        self.state = ApiState(model=model, tokenizer=SmokeTok(),
+                              model_id=f"tiny-{name}")
+        self.state.engine = self.engine
+        self.runner = None
+        self.port = None
+
+    async def start(self) -> str:
+        self.runner = web.AppRunner(create_app(self.state))
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", self.port or 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self):
+        if self.runner is not None:
+            await self.runner.cleanup()
+            self.runner = None
+
+    def close(self):
+        self.engine.close()
+
+
+async def _poll(fn, pred, deadline_s: float, what: str, interval=0.05):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        last = await fn()
+        if pred(last):
+            return last
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out after {deadline_s:.0f}s waiting for "
+                         f"{what}; last: {json.dumps(last)[:600]}")
+
+
+async def main_async() -> dict:
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=CTX)
+    model.tokenizer = SmokeTok()
+    out: dict = {}
+    statuses: list = []                     # the zero-client-errors ledger
+    replicas = [ReplicaProc(f"r{i}", model) for i in range(N_REPLICAS)]
+    victim = replicas[1]
+    registry = ReplicaRegistry(MembershipPolicy(
+        eject_fails=2, err_window=16, err_rate=0.5,
+        degraded_ttft_ms=0.0, eject_s=0.3))
+    # split data-path deadlines do the partition detection: connect
+    # bounded at 1s, first byte at 0.6s — a black-holed or browned-out
+    # attempt turns into a retryable transport failure, never a wedge
+    router = FleetRouter(registry, retries=2, backoff_s=0.01,
+                         probe_s=0.15, hedge_ms=0.0, max_inflight=0,
+                         connect_timeout_s=1.0, first_byte_timeout_s=0.6)
+    client = None
+    proxy = None
+    try:
+        import aiohttp
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=300)) as warm:
+            for rep in replicas:
+                url = await rep.start()
+                # warm the engine DIRECTLY (JAX compiles on the first
+                # request — minutes on this CPU, which would read as a
+                # first-byte timeout and eject a healthy replica)
+                async with warm.post(
+                        url + "/v1/chat/completions",
+                        json={"messages": [{"role": "user",
+                                            "content": "warm t7"}],
+                              "max_tokens": MAX_NEW,
+                              "temperature": 0.0}) as r:
+                    assert r.status == 200, await r.text()
+                if rep is victim:
+                    continue                # joins through the proxy
+                registry.add(rep.name, url)
+        proxy = ChaosProxy("127.0.0.1", victim.port)
+        await proxy.start()
+        registry.add(victim.name, proxy.base_url)
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()
+
+        convo = [0]
+
+        async def chat(stream=False) -> float:
+            """One chat request (fresh conversation id, so the fleet's
+            rendezvous placement keeps exercising every replica);
+            returns its wall time. Statuses land in the ledger."""
+            convo[0] += 1
+            t0 = time.monotonic()
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user",
+                              "content": f"partition convo {convo[0]} "
+                                         f"says t{3 + convo[0] % 200}"}],
+                "max_tokens": MAX_NEW, "temperature": 0.0,
+                "stream": stream})
+            body = await r.read()
+            if stream and r.status == 200:
+                assert b"[DONE]" in body, body[-200:]
+            statuses.append(r.status)
+            return time.monotonic() - t0
+
+        async def fleet():
+            return await (await client.get("/fleet")).json()
+
+        def row(snap, name=None):
+            name = name or victim.name
+            return next(r for r in snap["replicas"] if r["name"] == name)
+
+        async def pump_until(pred, deadline_s, what):
+            """Poll /fleet while keeping chat traffic flowing — readmit
+            needs a real data-path trial request, not just probes."""
+            async def step():
+                await chat()
+                return await fleet()
+            return await _poll(step, pred, deadline_s, what)
+
+        # -- phase 0: baseline — traffic flows through the proxy ----------
+        for _ in range(4):
+            await chat()
+        await chat(stream=True)
+        snap = await fleet()
+        assert row(snap)["state"] == "healthy"
+        capacity_full = registry.total_capacity()
+        assert capacity_full > 0
+        out["baseline"] = {"capacity": capacity_full}
+
+        # -- phase 1: FULL partition --------------------------------------
+        proxy.apply("partition")
+        ejects_before = row(await fleet())["ejects"]
+        for _ in range(8):                  # all absorbed by failover
+            await chat()
+        snap = await _poll(
+            fleet, lambda s: row(s)["state"] == "ejected",
+            10.0, "full partition ejected the victim")
+        assert row(snap)["ejects"] == ejects_before + 1
+        # a partitioned replica contributes NOTHING to capacity
+        assert registry.total_capacity() < capacity_full
+        # the episode never re-ejects while the fault persists: probes
+        # keep failing against an already-EJECTED replica
+        await asyncio.sleep(0.6)            # > eject hold, fault still on
+        snap = await fleet()
+        assert row(snap)["state"] == "ejected"
+        assert row(snap)["ejects"] == ejects_before + 1, \
+            "full partition must cost exactly one eject per episode"
+        proxy.heal()
+        snap = await pump_until(
+            lambda s: row(s)["state"] == "healthy",
+            20.0, "heal readmitted the victim")
+        # heal restores the capacity exactly once (no double-count)
+        assert registry.total_capacity() == capacity_full
+        out["full_partition"] = {
+            "ejects": row(snap)["ejects"] - ejects_before,
+            "readmitted": True}
+
+        # -- phase 2: ASYMMETRIC probe-alive/data-dead (control socket) ---
+        st = await control_send("127.0.0.1", proxy.control_port,
+                                "SET partition_out;match=/v1/chat")
+        assert st["ok"] and st["plan"]["partition_out"], st
+        streak0 = row(await fleet())["eject_streak"]
+        # detection NEEDS data traffic: the probe path is deliberately
+        # alive, so only the router's own failing requests can eject
+        snap = await pump_until(
+            lambda s: row(s)["state"] == "ejected",
+            20.0, "asymmetric partition ejected the victim")
+        assert row(snap)["eject_evidence"] == "data", row(snap)
+        assert row(snap)["partition_s"] is not None
+        out["asymmetric_evidence"] = "data"
+        # probes are ALIVE: the victim advances to half_open after the
+        # hold, but probes alone never readmit a data-evidence eject —
+        # the data-path trial fails against the live fault and re-ejects
+        # with the next hold on the backoff ladder (damped flap)
+        snap = await pump_until(
+            lambda s: (row(s)["state"] == "ejected"
+                       and row(s)["eject_streak"] >= streak0 + 2),
+            20.0, "failed trial re-ejected with a doubled hold")
+        assert row(snap)["eject_evidence"] == "data"
+        out["flap_damped_streak"] = row(snap)["eject_streak"]
+        # heal the network; only now may a trial readmit it
+        st = await control_send("127.0.0.1", proxy.control_port, "HEAL")
+        assert st["ok"] and st["plan"] == {}, st
+        snap = await pump_until(
+            lambda s: row(s)["state"] == "healthy",
+            30.0, "post-heal trial readmitted the victim")
+        assert row(snap)["eject_evidence"] is None
+        assert row(snap)["partition_s"] is None
+        out["asymmetric_readmit_after_heal"] = True
+
+        # -- phase 3: DELAY brownout vs the first-byte deadline -----------
+        proxy.apply("delay_ms=1200")        # >> first_byte_timeout_s
+        durs = [await chat() for _ in range(6)]
+        assert max(durs) < 8.0, f"brownout wedged a request: {durs}"
+        snap = await _poll(
+            fleet, lambda s: row(s)["state"] == "ejected",
+            15.0, "brownout ejected the victim")
+        proxy.heal()
+        snap = await pump_until(
+            lambda s: row(s)["state"] == "healthy",
+            30.0, "brownout heal readmitted the victim")
+        out["brownout"] = {"max_request_s": round(max(durs), 2),
+                           "readmitted": True}
+
+        # -- phase 4: ledgers ---------------------------------------------
+        failed = [s for s in statuses if s != 200]
+        assert not failed, f"client-visible errors: {failed} " \
+                           f"of {len(statuses)}"
+        out["requests"] = len(statuses)
+        out["client_errors"] = 0
+
+        mtext = await (await client.get("/metrics")).text()
+        m = re.search(rf'^cake_fleet_ejects_total{{replica="{victim.name}"'
+                      rf',reason="[a-z_]+",evidence="data"}}\s+(\d+)',
+                      mtext, re.M)
+        assert m and int(m.group(1)) >= 1, \
+            [ln for ln in mtext.splitlines() if "ejects_total" in ln]
+        m = re.search(rf'^cake_fleet_partition_seconds_total'
+                      rf'{{replica="{victim.name}"}}\s+([0-9.]+)',
+                      mtext, re.M)
+        assert m and float(m.group(1)) > 0, \
+            "cake_fleet_partition_seconds_total missing"
+        out["partition_seconds"] = float(m.group(1))
+
+        tl = router.timelines.get(f"replica:{victim.name}")
+        kinds = [e["kind"] for e in tl["events"]]
+        assert "replica_partition_suspected" in kinds, kinds
+        assert "partition_healed" in kinds, kinds
+        assert kinds.index("replica_partition_suspected") \
+            < kinds.index("partition_healed")
+        out["episode_timeline"] = True
+
+        h = await client.get("/health")
+        assert h.status == 200, await h.text()
+        out["health"] = 200
+        out["proxy"] = proxy.status()["plan"] == {} and "healed"
+        return out
+    finally:
+        if client is not None:
+            await client.close()
+        if proxy is not None:
+            await proxy.close()
+        for rep in replicas:
+            await rep.stop()
+            rep.close()
+
+
+def main() -> int:
+    out = asyncio.new_event_loop().run_until_complete(main_async())
+    print("partition-smoke OK:")
+    for k, v in out.items():
+        print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

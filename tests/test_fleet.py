@@ -116,8 +116,13 @@ def test_half_open_trial_and_readmit_cycle():
     assert not rep.try_acquire()
     rep.record_result(True, 5.0, lease=lease)    # trial succeeded
     rep.release(lease)
-    assert rep.snapshot()["state"] == HEALTHY
-    assert rep.snapshot()["eject_streak"] == 0
+    snap = rep.snapshot()
+    assert snap["state"] == HEALTHY
+    # readmission does NOT launder the backoff reputation: the streak
+    # survives the heal (it expires only after a quiet forget window),
+    # so a flap finds its next hold doubled
+    assert snap["eject_streak"] == 1
+    assert snap["eject_evidence"] is None        # episode closed
 
 
 def test_half_open_failure_re_ejects_with_backoff():
@@ -183,15 +188,122 @@ def test_stale_release_cannot_clear_trial_lease():
 
 
 def test_half_open_probe_only_readmit():
-    """An idle fleet still readmits: two consecutive healthy probes."""
-    rep = Replica("r0", "http://h:1", _policy(eject_s=0.0))
-    for _ in range(3):
-        rep.record_result(False, transport=True)
+    """An idle fleet still readmits on two consecutive healthy probes —
+    but ONLY for probe-evidence ejects (the /health path produced the
+    evidence, so the /health path may clear it)."""
+    rep = Replica("r0", "http://h:1", _policy(eject_fails=2, eject_s=0.0))
+    rep.observe_health(None, None)
+    rep.observe_health(None, None)               # probe-evidence eject
+    snap = rep.snapshot()
+    assert snap["state"] == EJECTED
+    assert snap["eject_evidence"] == "probe"
     healthy = {"engine": {"alive": True}}
     rep.observe_health(200, healthy)             # -> half_open
     assert rep.snapshot()["state"] == HALF_OPEN
     rep.observe_health(200, healthy)             # second in a row
     assert rep.snapshot()["state"] == HEALTHY
+
+
+def test_probe_evidence_can_never_clear_data_evidence_eject():
+    """The asymmetric-partition flap killer: a replica ejected on DATA
+    evidence (the router's own requests failed) has a live probe path —
+    healthy probes advance it to HALF_OPEN but may NEVER readmit it; only
+    the data-path trial lease can."""
+    rep = Replica("r0", "http://h:1", _policy(eject_s=0.0))
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    snap = rep.snapshot()
+    assert snap["state"] == EJECTED
+    assert snap["eject_evidence"] == "data"
+    assert snap["partition_s"] is not None       # episode open
+    healthy = {"engine": {"alive": True}}
+    for _ in range(5):                           # probes alone: stuck
+        rep.observe_health(200, healthy)
+    assert rep.snapshot()["state"] == HALF_OPEN
+    assert rep.snapshot()["eject_evidence"] == "data"
+    trial = rep.try_acquire()
+    assert trial == "trial"
+    rep.record_result(True, 5.0, lease=trial)    # data-path proof
+    rep.release(trial)
+    snap = rep.snapshot()
+    assert snap["state"] == HEALTHY
+    assert snap["eject_evidence"] is None
+    assert snap["partition_s"] is None           # episode closed
+
+
+def test_flap_damping_doubles_hold_each_heal_cycle(monkeypatch):
+    """Repeated partition/heal flaps: each re-eject finds its hold
+    DOUBLED even though the replica was fully readmitted in between —
+    healing is not reputation laundering. Only a genuinely quiet
+    stretch longer than the forget window resets the ladder.
+    Fake clock: no sleeps, the holds are inspected arithmetically."""
+    from cake_tpu.fleet import registry as regmod
+
+    class Clock:
+        t = 1000.0
+    monkeypatch.setattr(regmod, "now", lambda: Clock.t)
+    rep = Replica("r0", "http://h:1", _policy(eject_s=1.0))
+    healthy = {"engine": {"alive": True}}
+
+    def flap():
+        """One partition/heal episode; returns the eject hold length."""
+        for _ in range(3):
+            rep.record_result(False, transport=True)
+        assert rep.snapshot()["state"] == EJECTED
+        hold = rep.eject_until - Clock.t
+        Clock.t = rep.eject_until + 0.01         # hold expires
+        rep.observe_health(200, healthy)         # -> half_open
+        trial = rep.try_acquire()
+        assert trial == "trial"
+        rep.record_result(True, 5.0, lease=trial)  # data-path readmit
+        rep.release(trial)
+        assert rep.snapshot()["state"] == HEALTHY
+        return hold
+
+    assert flap() == pytest.approx(1.0)          # streak 1: base hold
+    assert flap() == pytest.approx(2.0)          # streak 2: doubled
+    assert flap() == pytest.approx(4.0)          # streak 3: doubled again
+    # quiet longer than the forget window (eject_s * MAX_BACKOFF * 2
+    # = 16s): the reputation finally expires and the ladder restarts
+    Clock.t += 17.0
+    assert flap() == pytest.approx(1.0)
+
+
+def test_partition_episode_events_and_seconds_counter(monkeypatch):
+    """A data-evidence eject opens a partition episode: the suspected /
+    healed event pair is drained for the timeline, and the
+    cake_fleet_partition_seconds_total counter climbs DURING the
+    episode (per probe cycle), not in one jump at heal."""
+    from cake_tpu.fleet import registry as regmod
+    from cake_tpu.obs import FLEET_PARTITION_SECONDS
+
+    class Clock:
+        t = 500.0
+    monkeypatch.setattr(regmod, "now", lambda: Clock.t)
+    reg = ReplicaRegistry(_policy(eject_s=1.0))
+    rep = reg.add("r-partsec", "http://h:1")
+    base = FLEET_PARTITION_SECONDS.value(replica="r-partsec")
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    ((kind, attrs),) = reg.drain_events()
+    assert kind == "replica_partition_suspected"
+    assert attrs["replica"] == "r-partsec" and attrs["reason"] == "fails"
+    assert attrs["hold_s"] == pytest.approx(1.0)
+    # mid-episode probe cycle: the counter has already accrued 2s
+    Clock.t += 2.0
+    rep.observe_health(200, {"engine": {"alive": True}})  # -> half_open
+    assert (FLEET_PARTITION_SECONDS.value(replica="r-partsec") - base
+            == pytest.approx(2.0))
+    Clock.t += 1.0
+    trial = rep.try_acquire()
+    rep.record_result(True, 5.0, lease=trial)             # heal
+    rep.release(trial)
+    ((kind, attrs),) = reg.drain_events()
+    assert kind == "partition_healed"
+    assert attrs["episode_s"] == pytest.approx(3.0)
+    assert (FLEET_PARTITION_SECONDS.value(replica="r-partsec") - base
+            == pytest.approx(3.0))
+    assert reg.drain_events() == []                       # drained clean
 
 
 def test_health_down_and_wedged_eject():
@@ -603,6 +715,49 @@ def test_retry_budget_exhaustion_is_typed_503():
             assert body["shed_by"] == "router"
             assert int(r.headers["Retry-After"]) >= 1
             assert body["attempts"] == 3         # 1 + retries(2)
+        finally:
+            await client.close()
+            for rep in replicas:
+                await rep.stop()
+    asyncio.run(run())
+
+
+def test_first_byte_deadline_bounds_blackholed_replica():
+    """A black-holed replica (TCP connects fine, bytes vanish — the
+    nastiest partition shape) no longer wedges an attempt forever even
+    with the deprecated attempt timeout at its 0.0=forever default: the
+    first-byte deadline converts the hang into a bounded transport
+    failure and the request fails over with zero client-visible errors,
+    on both the JSON and the streamed path."""
+    replicas, registry, mk = _fleet_client(
+        2, first_byte_timeout_s=0.25, retries=3)
+
+    async def run():
+        client, _router = await mk()
+        try:
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("convo A"))
+            assert r.status == 200
+            owner = next(rep for rep in replicas if rep.served)
+            owner.mode = "hang"                  # accepts, never answers
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("convo A"))
+            assert r.status == 200, await r.text()
+            assert loop.time() - t0 < 5.0        # bounded, not forever
+            # streamed request: the headers wait is bounded the same way
+            # (pre-commit — no byte relayed — so it retries from scratch)
+            t0 = loop.time()
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("convo A", stream=True))
+            assert r.status == 200
+            text = (await r.read()).decode()
+            assert "[DONE]" in text
+            assert loop.time() - t0 < 5.0
+            other = next(rep for rep in replicas if rep is not owner)
+            assert len(other.served) >= 2        # both failed over
+            owner.release.set()                  # unpark the wedged handler
         finally:
             await client.close()
             for rep in replicas:

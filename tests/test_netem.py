@@ -1,0 +1,373 @@
+"""Network chaos layer (fleet/netem.py): plan grammar + ChaosProxy.
+
+The grammar tests mirror test_fleet's faults.parse_plan coverage; the
+proxy tests run real asyncio sockets against a local echo upstream —
+in-process, sub-second, tier-1 cheap. The full router-through-proxy
+drill lives in scripts/partition_smoke.py (tier 2)."""
+import asyncio
+
+import pytest
+
+from cake_tpu.fleet.netem import (ChaosProxy, NetemPlan, control_send,
+                                  parse_plan)
+
+# ---------------------------------------------------------------------------
+# plan grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_flags_and_values():
+    p = parse_plan("partition")
+    assert p.partition and p.faulty()
+    assert not (p.partition_in or p.partition_out or p.blackhole)
+    p = parse_plan("partition_out;match=/v1/chat")
+    assert p.partition_out and p.match == "/v1/chat"
+    p = parse_plan("delay_ms=75;jitter_ms=25;heal_after_s=1.5")
+    assert (p.delay_ms, p.jitter_ms, p.heal_after_s) == (75.0, 25.0, 1.5)
+    p = parse_plan("reset_after_bytes=512")
+    assert p.reset_after_bytes == 512
+    p = parse_plan("blackhole;heal_after_s=2")
+    assert p.blackhole and p.heal_after_s == 2.0
+
+
+def test_parse_explicit_flag_values():
+    assert parse_plan("partition=1").partition
+    assert parse_plan("partition=true").partition
+    assert not parse_plan("partition=0").partition
+
+
+def test_zero_plan_is_not_faulty():
+    assert not NetemPlan().faulty()
+    assert NetemPlan().snapshot() == {}
+    # heal_after_s alone does not misbehave either
+    assert not parse_plan("heal_after_s=5").faulty()
+
+
+def test_parse_rejects_unknown_keys_and_missing_values():
+    with pytest.raises(ValueError, match="unknown netem key"):
+        parse_plan("partittion")
+    with pytest.raises(ValueError, match="needs a value"):
+        parse_plan("delay_ms")
+    with pytest.raises(ValueError, match="needs a value"):
+        parse_plan("reset_after_bytes=")
+    with pytest.raises(ValueError):
+        parse_plan("delay_ms=fast")
+
+
+def test_parse_plan_exactly_one_clause():
+    with pytest.raises(ValueError, match="exactly one clause"):
+        parse_plan("partition,blackhole")
+    with pytest.raises(ValueError, match="exactly one clause"):
+        parse_plan("")
+
+
+def test_snapshot_round_trips_the_interesting_fields():
+    p = parse_plan("partition_in;delay_ms=10;match=/x")
+    assert p.snapshot() == {"partition_in": True, "delay_ms": 10.0,
+                            "match": "/x"}
+
+
+# ---------------------------------------------------------------------------
+# proxy data path (real sockets, echo upstream)
+# ---------------------------------------------------------------------------
+
+
+async def _echo_upstream():
+    """Echo server: replies b"echo:" + whatever arrived."""
+    async def handle(reader, writer):
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    return
+                writer.write(b"echo:" + data)
+                await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+    srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+    return srv, srv.sockets[0].getsockname()[1]
+
+
+async def _roundtrip(port: int, payload: bytes,
+                     timeout: float = 2.0) -> bytes:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        writer.write(payload)
+        await writer.drain()
+        return await asyncio.wait_for(reader.read(65536), timeout)
+    finally:
+        writer.close()
+
+
+def test_proxy_relays_clean_without_a_plan():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            assert await _roundtrip(proxy.port, b"hi") == b"echo:hi"
+            st = proxy.status()
+            assert st["accepted"] == 1 and st["plan"] == {}
+            assert st["relayed_in"] > 0 and st["relayed_out"] > 0
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_partition_refuses_new_and_severs_live():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            # live connection mid-conversation
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", proxy.port)
+            writer.write(b"a")
+            await writer.drain()
+            assert await asyncio.wait_for(reader.read(64), 2.0) \
+                == b"echo:a"
+            proxy.apply("partition")
+            # the live connection is severed (EOF or reset)
+            try:
+                tail = await asyncio.wait_for(reader.read(64), 2.0)
+            except (ConnectionError, OSError):
+                tail = b""
+            assert tail == b""
+            writer.close()
+            # new connections die before any byte comes back
+            with pytest.raises((ConnectionError, OSError,
+                                asyncio.TimeoutError)):
+                out = await _roundtrip(proxy.port, b"b", timeout=0.5)
+                assert out == b""           # EOF-shaped refusal
+                raise ConnectionResetError  # normalize for the assert
+            assert proxy.severed >= 1
+            # heal: traffic flows again
+            proxy.heal()
+            assert await _roundtrip(proxy.port, b"c") == b"echo:c"
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_blackhole_accepts_then_never_responds():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            proxy.apply("blackhole")
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", proxy.port)       # accept succeeds
+            writer.write(b"anyone home?")
+            await writer.drain()
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(reader.read(64), 0.3)
+            writer.close()
+            assert proxy.relayed_out == 0      # nothing ever came back
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_partition_out_with_match_is_probe_alive_data_dead():
+    """The asymmetric drill: connections whose first bytes carry the
+    match substring lose the server->client direction; everything else
+    relays clean through the same port."""
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            proxy.apply("partition_out;match=/v1/chat")
+            # probe-shaped traffic: unmatched, flows both ways
+            assert await _roundtrip(proxy.port, b"GET /health") \
+                == b"echo:GET /health"
+            # data-shaped traffic: request reaches upstream, reply dies
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", proxy.port)
+            writer.write(b"POST /v1/chat/completions")
+            await writer.drain()
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(reader.read(64), 0.3)
+            writer.close()
+            assert proxy.relayed_in > 0        # inbound still crossed
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_match_reclassifies_a_kept_alive_connection():
+    """Routers POOL connections: a socket whose first request was a
+    probe can later carry data traffic. The sniff is continuous — the
+    moment matching bytes cross, the connection becomes subject."""
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            proxy.apply("partition_out;match=/v1/chat")
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", proxy.port)
+            writer.write(b"GET /health")          # probe-shaped first
+            await writer.drain()
+            assert await asyncio.wait_for(reader.read(64), 2.0) \
+                == b"echo:GET /health"
+            writer.write(b"POST /v1/chat/completions")  # same socket
+            await writer.drain()
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(reader.read(64), 0.3)
+            writer.close()
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_partition_in_drops_requests_silently():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            proxy.apply("partition_in")
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", proxy.port)
+            writer.write(b"into the void")
+            await writer.drain()
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(reader.read(64), 0.3)
+            writer.close()
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_delay_brownout_paces_but_delivers():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            proxy.apply("delay_ms=120")
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            assert await _roundtrip(proxy.port, b"slow") == b"echo:slow"
+            # two faulted hops (in + out), each delayed >= 120ms
+            assert loop.time() - t0 >= 0.2
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_reset_after_bytes_severs_mid_response():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            proxy.apply("reset_after_bytes=4")
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", proxy.port)
+            writer.write(b"x" * 64)
+            await writer.drain()
+            got = b""
+            try:
+                while True:
+                    piece = await asyncio.wait_for(reader.read(64), 2.0)
+                    if not piece:
+                        break
+                    got += piece
+            except (ConnectionError, OSError):
+                pass                           # reset is the point
+            assert len(got) < 64 + 5           # response truncated
+            writer.close()
+            assert proxy.severed >= 1
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+def test_heal_after_s_auto_heals():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port, control=False)
+        await proxy.start()
+        try:
+            proxy.apply("partition;heal_after_s=0.2")
+            with pytest.raises((ConnectionError, OSError,
+                                asyncio.TimeoutError)):
+                out = await _roundtrip(proxy.port, b"a", timeout=0.4)
+                assert out == b""
+                raise ConnectionResetError
+            deadline = asyncio.get_running_loop().time() + 3.0
+            while True:                         # deadline poll, no sleeps
+                try:
+                    if await _roundtrip(proxy.port, b"b",
+                                        timeout=0.4) == b"echo:b":
+                        break
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    pass
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "auto-heal never landed"
+                await asyncio.sleep(0.05)
+            assert not proxy.plan.faulty()
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# control socket
+# ---------------------------------------------------------------------------
+
+
+def test_control_socket_set_status_heal():
+    async def run():
+        srv, port = await _echo_upstream()
+        proxy = ChaosProxy("127.0.0.1", port)
+        await proxy.start()
+        try:
+            cp = proxy.control_port
+            assert cp is not None
+            out = await control_send("127.0.0.1", cp,
+                                     "SET partition_out;match=/v1/chat")
+            assert out["ok"] and out["plan"]["partition_out"]
+            st = await control_send("127.0.0.1", cp, "STATUS")
+            assert st["ok"] and st["plan"]["match"] == "/v1/chat"
+            out = await control_send("127.0.0.1", cp, "HEAL")
+            assert out["ok"] and out["plan"] == {}
+            assert not proxy.plan.faulty()
+            # errors answer ok=false and keep the proxy alive
+            out = await control_send("127.0.0.1", cp, "SET bogus=1")
+            assert not out["ok"] and "unknown netem key" in out["error"]
+            out = await control_send("127.0.0.1", cp, "FROB")
+            assert not out["ok"]
+            assert await _roundtrip(proxy.port, b"ok") == b"echo:ok"
+        finally:
+            await proxy.close()
+            srv.close()
+            await srv.wait_closed()
+    asyncio.run(run())

@@ -16,6 +16,7 @@ import asyncio
 import json
 import math
 
+import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
@@ -381,6 +382,48 @@ def test_ingest_stale_excluded_and_flagged_as_outlier():
     assert rep.routable()                      # advisory, never membership
     # fleet queue depth sums LIVE replicas only
     assert body["fleet_queue_depth"] == 2
+
+
+def test_ingest_excludes_ejected_replica_from_headroom():
+    """An EJECTED replica leaves the capacity math even while its
+    scrape still answers (the asymmetric-partition shape: probe path
+    alive, data path dead) — otherwise the autoscaler sees phantom
+    headroom the router cannot actually route to, and a heal would
+    double-count the capacity the moment it readmits."""
+    clk = FakeClock()
+    reg, tel = _plane(2, clock=clk, slots=4)
+
+    def text(tok):
+        return prom_text(tokens=tok, slots_busy=2, kv_free=50, kv_used=50)
+
+    tel.ingest({"t0": text(0.0), "t1": text(0.0)}, t=0.0)
+    body = tel.ingest({"t0": text(1000.0), "t1": text(1000.0)}, t=100.0)
+    both = body["headroom_tokens_per_s"]
+    assert both > 0
+    assert body["replicas"]["t1"]["headroom_tokens_per_s"] > 0
+    # t1 ejects on DATA evidence; its scrape keeps answering
+    (rep,) = [r for r in reg.replicas() if r.name == "t1"]
+    for _ in range(3):
+        rep.record_result(False, transport=True)
+    body = tel.ingest({"t0": text(2000.0), "t1": text(2000.0)}, t=200.0)
+    row = body["replicas"]["t1"]
+    assert row["eject_evidence"] == "data"
+    assert row["partition_s"] is not None      # open episode, visible
+    assert row["headroom_tokens_per_s"] == 0.0
+    assert (body["headroom_tokens_per_s"]
+            == body["replicas"]["t0"]["headroom_tokens_per_s"])
+    # heal via the data-path trial: capacity returns exactly once
+    import time as _time
+    _time.sleep(0.06)                          # eject_s=0.05 hold
+    rep.observe_health(200, {"engine": {"alive": True, "slots": 4}})
+    trial = rep.try_acquire()
+    rep.record_result(True, 5.0, lease=trial)
+    rep.release(trial)
+    body = tel.ingest({"t0": text(3000.0), "t1": text(3000.0)}, t=300.0)
+    assert body["replicas"]["t1"]["headroom_tokens_per_s"] > 0
+    assert body["headroom_tokens_per_s"] == pytest.approx(
+        body["replicas"]["t0"]["headroom_tokens_per_s"]
+        + body["replicas"]["t1"]["headroom_tokens_per_s"])
 
 
 def test_ingest_series_and_overhead_exposed():

@@ -1,7 +1,7 @@
 # cake-tpu developer entry points (ref: the reference Makefile's build/test
 # targets; mobile app targets have no analog here — see PARITY.md §2f).
 
-.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos serve-bench-telemetry paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke partition-smoke fleet-soak telemetry-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
+.PHONY: install test lint knobs-doc metrics-doc bench bench-micro obs-smoke trace-smoke serve-smoke qos-smoke serve-bench serve-bench-longtail serve-bench-spec serve-bench-fleet serve-bench-qos serve-bench-telemetry serve-bench-kvshare paged-smoke chaos-smoke serve-chaos-smoke fleet-chaos-smoke partition-smoke fleet-soak kvshare-smoke telemetry-smoke spec-smoke spec-serve-smoke spec-bench native clean docker
 
 install:
 	pip install -e . --no-build-isolation
@@ -114,6 +114,22 @@ partition-smoke: lint
 # zero frozen-gauge contamination across all of it (docs/autoscaling.md)
 fleet-soak: lint
 	JAX_PLATFORMS=cpu python scripts/fleet_soak.py
+
+# fleet-shared KV gate (tier-2): 3 real replicas behind the router with
+# CAKE_KVSHARE=1 — a cordoned warm replica's prefix chain is fetched by
+# a cache-cold peer purely off the router-injected X-Cake-KV-Peers
+# directory (bit-identical greedy body, kv-fetch hit counter advancing,
+# prefix_hit_tokens > 0 on the lander), and a mid-stream drain ships
+# the live slot's swap blob to a peer which resumes the stream
+# byte-identical with zero client-visible errors (docs/kv_sharing.md)
+kvshare-smoke: lint
+	JAX_PLATFORMS=cpu python scripts/kvshare_smoke.py
+
+# fleet-shared KV bench: cold-fetch (directory-driven peer fetch) vs
+# cold-recompute (kvshare off) vs local-warm TTFT on a shared-prefix
+# follow-up. Writes BENCH_KVSHARE_<tag>.json.
+serve-bench-kvshare:
+	JAX_PLATFORMS=cpu python scripts/serve_bench.py --kvshare --tag r20
 
 # fleet telemetry gate: 2 real engine-backed replicas behind the router,
 # a traffic burst -> live rollup (merged fleet TTFT p95 from bucket-wise

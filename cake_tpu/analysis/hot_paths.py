@@ -55,6 +55,11 @@ HOT_PATHS = frozenset({
     "cake_tpu/fleet/routing.py",
     "cake_tpu/fleet/router.py",
     "cake_tpu/fleet/faults.py",
+    # fleet-shared KV tier: run_pending drains the blob mailbox inside
+    # every scheduler iteration, and export/import touch pool arrays
+    # directly (each deliberate device->host pull carries a host-sync
+    # disable comment)
+    "cake_tpu/fleet/kvshare/replica.py",
 })
 
 

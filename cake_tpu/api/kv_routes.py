@@ -1,0 +1,108 @@
+"""Fleet-shared KV tier routes: prefix-blob export/import + stream-blob
+migration (docs/kv_sharing.md).
+
+  GET  /api/v1/kv/prefix/{chain}  export a cached prefix chain as a wire
+                                  blob (404 = not cached here)
+  POST /api/v1/kv/prefix/{chain}  install a fetched prefix blob into the
+                                  local prefix cache (rarely used over
+                                  the wire — fetch-before-recompute pulls
+                                  instead — but it makes warming a
+                                  replica scriptable)
+  GET  /api/v1/kv/stream/{rid}    export a parked (or live — fetching IS
+                                  the migration signal) stream's swap
+                                  blob
+  POST /api/v1/kv/stream/{rid}    stage a migrated stream blob; the
+                                  resumed request (X-Cake-KV-Resume)
+                                  adopts it
+
+All four answer 409 when kvshare is off, and every structural problem is
+a typed KVBlobMismatch -> 422: a peer treats anything but 200 as "fetch
+failed, recompute honestly"."""
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from ..fleet.kvshare import KVBlobMismatch
+from .state import run_blocking
+
+log = logging.getLogger("cake_tpu.api")
+
+_BLOB_CT = "application/x-cake-kv-blob"
+
+
+def _kvshare_of(request):
+    ks = request.app["state"].kvshare
+    if ks is None:
+        raise web.HTTPConflict(
+            text='{"error": "kvshare disabled on this replica '
+                 '(CAKE_KVSHARE off or no paged prefix cache)"}',
+            content_type="application/json")
+    return ks
+
+
+async def kv_prefix_get(request: web.Request) -> web.Response:
+    ks = _kvshare_of(request)
+    chain = request.match_info["chain"]
+    try:
+        blob = await run_blocking(
+            lambda: ks.submit_job("export_prefix", chain,
+                                  ks.fetch_timeout))
+    except TimeoutError:
+        raise web.HTTPServiceUnavailable(
+            text='{"error": "export timed out"}',
+            content_type="application/json")
+    if blob is None:
+        raise web.HTTPNotFound(
+            text='{"error": "chain not cached here"}',
+            content_type="application/json")
+    return web.Response(body=blob, content_type=_BLOB_CT)
+
+
+async def kv_prefix_put(request: web.Request) -> web.Response:
+    ks = _kvshare_of(request)
+    data = await request.read()
+    try:
+        res = await run_blocking(
+            lambda: ks.submit_job("import_prefix", data,
+                                  ks.fetch_timeout))
+    except KVBlobMismatch as e:
+        raise web.HTTPUnprocessableEntity(
+            text='{"error": "%s"}' % str(e).replace('"', "'"),
+            content_type="application/json")
+    except TimeoutError:
+        raise web.HTTPServiceUnavailable(
+            text='{"error": "import timed out"}',
+            content_type="application/json")
+    return web.json_response(res)
+
+
+async def kv_stream_get(request: web.Request) -> web.Response:
+    ks = _kvshare_of(request)
+    rid = request.match_info["rid"]
+    try:
+        blob = await run_blocking(
+            lambda: ks.export_stream(rid, ks.fetch_timeout))
+    except TimeoutError:
+        raise web.HTTPServiceUnavailable(
+            text='{"error": "stream export timed out"}',
+            content_type="application/json")
+    if blob is None:
+        raise web.HTTPNotFound(
+            text='{"error": "no such parked or migratable stream"}',
+            content_type="application/json")
+    return web.Response(body=blob, content_type=_BLOB_CT)
+
+
+async def kv_stream_put(request: web.Request) -> web.Response:
+    ks = _kvshare_of(request)
+    rid = request.match_info["rid"]
+    data = await request.read()
+    try:
+        res = await run_blocking(lambda: ks.store_inbound(rid, data))
+    except KVBlobMismatch as e:
+        raise web.HTTPUnprocessableEntity(
+            text='{"error": "%s"}' % str(e).replace('"', "'"),
+            content_type="application/json")
+    return web.json_response(res)

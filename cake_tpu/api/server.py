@@ -110,6 +110,21 @@ def create_app(state: ApiState, basic_auth: str | None = None) -> web.Applicatio
     app.router.add_get("/api/v1/slo", obs_routes.slo)
     app.router.add_get("/api/v1/flight", obs_routes.flight)
     app.router.add_get("/", ui_routes.index)
+    # fleet-shared KV tier (CAKE_KVSHARE): blob export/import routes +
+    # the per-engine agent. Gated on a paged pool + prefix cache — the
+    # contiguous pool has no block plane to share
+    engine = state.engine
+    if knobs.get("CAKE_KVSHARE") and engine is not None \
+            and getattr(engine, "paged", None) is not None \
+            and getattr(engine, "prefix_cache", None) is not None:
+        from ..fleet.kvshare import KVShareReplica
+        state.kvshare = KVShareReplica(engine)
+        engine.kv_share = state.kvshare
+    from . import kv_routes
+    app.router.add_get("/api/v1/kv/prefix/{chain}", kv_routes.kv_prefix_get)
+    app.router.add_post("/api/v1/kv/prefix/{chain}", kv_routes.kv_prefix_put)
+    app.router.add_get("/api/v1/kv/stream/{rid}", kv_routes.kv_stream_get)
+    app.router.add_post("/api/v1/kv/stream/{rid}", kv_routes.kv_stream_put)
     return app
 
 

@@ -56,6 +56,10 @@ class ApiState:
     # active generations run to completion (up to CAKE_DRAIN_TIMEOUT_S)
     draining: bool = False
     created: int = 0
+    # fleet-shared KV tier agent (fleet/kvshare/KVShareReplica) — set by
+    # create_app when CAKE_KVSHARE is on and the engine runs a paged
+    # pool + prefix cache; None keeps every kv route answering 409
+    kvshare: Any = None
 
     def owned_models(self) -> list[dict]:
         out = []

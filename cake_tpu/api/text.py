@@ -39,6 +39,20 @@ TOP_K_CHOICES = (1, 5, 10, 20, 40, 64, 100, 200)
 # the full length.
 CONTINUATION_CHARS_HEADER = "X-Cake-Continuation-Chars"
 
+# fleet-shared KV tier handshake (fleet/kvshare), mirrored by NAME for
+# the same import-light reason as the continuation header above:
+#   * X-Cake-KV-Peers   router -> replica: compact directory of warm
+#                       peers and their advertised prefix chains
+#   * X-Cake-KV-Resume  router -> replica: adopt the staged stream blob
+#                       for this request id before falling back to a
+#                       plain continuation re-prefill
+#   * X-Cake-KV-Resumed replica -> router: this response replays the
+#                       stream from token 0 out of an adopted blob —
+#                       strip everything the client already received
+KV_DIR_HEADER = "X-Cake-KV-Peers"
+KV_RESUME_HEADER = "X-Cake-KV-Resume"
+KV_RESUMED_HEADER = "X-Cake-KV-Resumed"
+
 
 def _grid(v: float, step: float, lo: float, hi: float) -> float:
     return round(round(max(lo, min(hi, v)) / step) * step, 2)
@@ -194,6 +208,17 @@ def _retry_after(state: ApiState, floor: int = 1) -> int:
     return max(floor, int(knobs.get("CAKE_RESTORE_INTERVAL_S")) + 1)
 
 
+def _stream_migrated(err: BaseException) -> bool:
+    """True when the engine failed this request because its KV state was
+    parked for fleet migration (lazy import: the fleet package is only
+    reached when kvshare is live enough to have raised it)."""
+    try:
+        from ..fleet.kvshare import StreamMigrated
+    except Exception:
+        return False
+    return isinstance(err, StreamMigrated)
+
+
 def _typed_error_response(err: BaseException,
                           state: ApiState | None = None
                           ) -> web.Response | None:
@@ -216,6 +241,12 @@ def _typed_error_response(err: BaseException,
         return web.json_response({"error": str(err)}, status=504)
     if isinstance(err, PoisonedRequest):
         return web.json_response({"error": str(err)}, status=500)
+    if _stream_migrated(err):
+        # this (non-streamed) request's KV was parked for migration:
+        # answer retryable so the router/client re-runs it elsewhere
+        return web.json_response(
+            {"error": str(err)}, status=503,
+            headers={"Retry-After": "1"})
     return None
 
 
@@ -474,32 +505,69 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
     except Exception as e:
         return web.json_response({"error": f"chat template failed: {e}"},
                                  status=400)
-    try:
-        req = state.engine.submit(prompt_ids,
-                                  max_new_tokens=gen_kwargs["max_new_tokens"],
-                                  sampling=gen_kwargs["sampling"],
-                                  request_id=rid, qos=qos, tenant=tenant,
-                                  continuation=continuation)
-    except QueueFull as e:
-        # backpressure is a first-class answer: shed load instead of
-        # queueing unboundedly behind a bounded slot pool. The 429 is
-        # class-aware: Retry-After reflects THIS class's backlog over
-        # its weighted-fair service share
-        from .qos import admission_refusal
-        return admission_refusal(e)
-    except EngineDraining as e:
-        return web.json_response(
-            {"error": str(e)}, status=503,
-            headers={"Retry-After": str(e.retry_after_s)})
-    except (EngineDown, PoisonedRequest) as e:
-        # typed refusals share the terminal-error mapping: 503 +
-        # Retry-After for a down engine (the balancer reroutes, the
-        # restore loop revives), 500 for a quarantined poison prompt
-        return _typed_error_response(e, state)
-    except ValueError as e:
-        return web.json_response({"error": str(e)}, status=400)
-    except RuntimeError as e:               # engine dead (legacy path)
-        return web.json_response({"error": str(e)}, status=503)
+    kvs = state.kvshare
+    resumed_req = None
+    if kvs is not None:
+        resume_rid = request.headers.get(KV_RESUME_HEADER)
+        if resume_rid:
+            # a migrated stream's blob was staged here (POST
+            # /api/v1/kv/stream/<rid>): adopt it through the engine's
+            # swap-resume path so the sampled sequence continues
+            # bit-exactly. None (nothing staged, or the blob does not
+            # fit this pool) falls through to the plain continuation
+            # admission below — migration failures are never
+            # client-visible
+            try:
+                resumed_req = await run_blocking(
+                    lambda: kvs.submit_job(
+                        "adopt",
+                        {"rid": resume_rid,
+                         "sampling": gen_kwargs["sampling"],
+                         "qos": qos, "tenant": tenant},
+                        kvs.fetch_timeout))
+            except Exception:
+                resumed_req = None
+        else:
+            peers = request.headers.get(KV_DIR_HEADER)
+            if peers:
+                # fetch-before-recompute: pull the longest matching
+                # prefix chain a warm peer advertises before prefilling.
+                # Best-effort by contract — any failure inside leaves
+                # the cache unchanged and the admission below computes
+                # honestly
+                try:
+                    await kvs.fetch_before_prefill(rid, prompt_ids, peers)
+                except Exception:
+                    pass
+    if resumed_req is not None:
+        req = resumed_req
+    else:
+        try:
+            req = state.engine.submit(
+                prompt_ids, max_new_tokens=gen_kwargs["max_new_tokens"],
+                sampling=gen_kwargs["sampling"],
+                request_id=rid, qos=qos, tenant=tenant,
+                continuation=continuation)
+        except QueueFull as e:
+            # backpressure is a first-class answer: shed load instead of
+            # queueing unboundedly behind a bounded slot pool. The 429
+            # is class-aware: Retry-After reflects THIS class's backlog
+            # over its weighted-fair service share
+            from .qos import admission_refusal
+            return admission_refusal(e)
+        except EngineDraining as e:
+            return web.json_response(
+                {"error": str(e)}, status=503,
+                headers={"Retry-After": str(e.retry_after_s)})
+        except (EngineDown, PoisonedRequest) as e:
+            # typed refusals share the terminal-error mapping: 503 +
+            # Retry-After for a down engine (the balancer reroutes, the
+            # restore loop revives), 500 for a quarantined poison prompt
+            return _typed_error_response(e, state)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except RuntimeError as e:           # engine dead (legacy path)
+            return web.json_response({"error": str(e)}, status=503)
     if stream:
         # never commit to a 200 SSE while the request can still be
         # refused outright: wait for admission (or a terminal failure)
@@ -519,12 +587,34 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
             if resp is not None:
                 GENERATIONS.inc(kind="text", status="error")
                 return resp
+            if resumed_req is not None:
+                # an adopted stream that died pre-commit (e.g. the pool
+                # can never fit its blob) must answer retryable, not an
+                # in-band error chunk: the router then continues the
+                # stream on the next candidate as a plain continuation
+                GENERATIONS.inc(kind="text", status="error")
+                return web.json_response(
+                    {"error": f"adopted stream failed: "
+                              f"{req.result['error']}"},
+                    status=503, headers={"Retry-After": "1"})
+        resume_text = None
+        if resumed_req is not None:
+            # replay every already-generated token as one leading chunk,
+            # marked by the KV_RESUMED header: per-token emission builds
+            # text via _mk_token(tid), so this concatenation is
+            # byte-identical to what the source replica streamed — the
+            # router strips the client-delivered prefix by POSITION
+            toks = list(req.tokens)
+            model = state.engine.model
+            resume_text = await run_blocking(lambda: "".join(
+                model._mk_token(t).text for t in toks))
         aiter, result = state.engine.stream(req)
         return await _sse_drain(request, state, cid, aiter, result,
                                 req.cancel, stops,
                                 cont_chars=len(str(
                                     messages[-1].get("content") or ""))
-                                if continuation else None)
+                                if continuation else None,
+                                resume_text=resume_text)
     if stops:
         # early termination: watch the token stream from the scheduler
         # thread and cancel at the first completed stop match, so a
@@ -568,7 +658,8 @@ async def _chat_engine(request, state: ApiState, messages, gen_kwargs,
 
 async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
                      cancel, stops: list[str] | None = None,
-                     cont_chars: int | None = None
+                     cont_chars: int | None = None,
+                     resume_text: str | None = None
                      ) -> web.StreamResponse:
     """Drain a token stream into SSE chunks — shared by the engine and
     locked paths. `cancel` is a thunk that aborts the producer; it fires
@@ -590,10 +681,15 @@ async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
     }
     if cont_chars is not None:
         hdrs[CONTINUATION_CHARS_HEADER] = str(cont_chars)
+    if resume_text is not None:
+        # adopted-blob replay: the body repeats the stream from token 0,
+        # so the router must strip by cumulative delivered position, not
+        # by the continuation splice arithmetic
+        hdrs[KV_RESUMED_HEADER] = "1"
     resp = web.StreamResponse(headers=hdrs)
     try:
         return await _sse_drain_inner(request, state, cid, aiter, result,
-                                      cancel, resp, stops)
+                                      cancel, resp, stops, resume_text)
     except BaseException:
         # disconnect/cancellation BEFORE the token loop starts would skip
         # the iterator's finalizer (an async generator that was never
@@ -605,7 +701,8 @@ async def _sse_drain(request, state: ApiState, cid: str, aiter, result: dict,
 
 async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
                            result: dict, cancel, resp: web.StreamResponse,
-                           stops: list[str] | None = None
+                           stops: list[str] | None = None,
+                           resume_text: str | None = None
                            ) -> web.StreamResponse:
     await resp.prepare(request)
     created = int(time.time())
@@ -619,6 +716,12 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
         return f"data: {json.dumps(payload)}\n\n".encode()
 
     await resp.write(chunk({"role": "assistant"}))
+    if resume_text:
+        # migrated-stream replay (see _sse_drain): the stop matcher (if
+        # any) intentionally sees only NEW tokens, same as a plain
+        # continuation leg — its holdback state never spans the
+        # migration boundary
+        await resp.write(chunk({"content": resume_text}))
     finish = "length"
     client_gone = False
     matcher = StopMatcher(stops) if stops else None
@@ -663,6 +766,17 @@ async def _sse_drain_inner(request, state: ApiState, cid: str, aiter,
             if tail:
                 await write_safe(chunk({"content": tail}))
     except Exception as e:
+        if _stream_migrated(e):
+            # the engine parked this stream's KV for migration: sever
+            # the socket WITHOUT a finish chunk or [DONE], so the router
+            # classifies the leg as broken mid-body and runs its resume
+            # plane (a clean close would read as a final answer — and
+            # the client, behind the router, never sees the break)
+            cancel()
+            tr = request.transport
+            if tr is not None:
+                tr.abort()
+            return resp
         # mid-stream generation failure: still close the SSE stream
         # with a final chunk + [DONE] so clients don't hang
         await write_safe(chunk({"content": f"\n[error: {e}]"}))

@@ -1,0 +1,38 @@
+"""Fleet-shared KV tier: prefix-blob export/fetch between replicas, a
+probe-piggybacked peer directory, and live stream blob migration (see
+docs/kv_sharing.md).
+
+Module map:
+
+  * blob.py      — versioned + checksummed wire format (KVBlobMismatch
+                   is the typed reject; fallback is always recompute);
+  * directory.py — the X-Cake-KV-Peers header codec (router builds it
+                   from registry-mirrored inventories per attempt);
+  * replica.py   — the per-engine agent: scheduler-thread mailbox for
+                   export/import/park/adopt, fetch-before-recompute on
+                   admission, and the StreamMigrated severing signal.
+"""
+from .blob import (KVBlobMismatch, MAGIC, VERSION, decode_blob,
+                   encode_blob, pool_signature)
+from .directory import encode_directory, parse_directory
+
+# replica.py imports jax (it manipulates pool arrays); the ROUTER tier
+# imports this package for the directory codec alone and deliberately
+# stays model-free / import-light, so the replica-side names resolve
+# lazily (PEP 562) instead of pulling jax into the router process
+_REPLICA_NAMES = ("KVShareReplica", "StreamMigrated", "KV_DIR_HEADER",
+                  "KV_RESUME_HEADER", "KV_RESUMED_HEADER")
+
+
+def __getattr__(name):
+    if name in _REPLICA_NAMES:
+        from . import replica as _replica
+        return getattr(_replica, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "KVBlobMismatch", "MAGIC", "VERSION", "encode_blob", "decode_blob",
+    "pool_signature", "encode_directory", "parse_directory",
+    "KVShareReplica", "StreamMigrated", "KV_DIR_HEADER",
+    "KV_RESUME_HEADER", "KV_RESUMED_HEADER",
+]

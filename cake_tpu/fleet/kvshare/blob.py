@@ -1,0 +1,126 @@
+"""Fleet-shared KV wire format: versioned, checksummed blobs of paged
+KV state.
+
+One codec carries both transfer kinds of the kvshare tier:
+
+  * kind="prefix" — a prefix-cache chain's pinned blocks (per-layer
+    block arrays + the boundary row snapshot), exported by a warm
+    replica and installed into a cold peer's PagedPrefixCache so the
+    peer's next admission splices instead of re-prefilling;
+  * kind="stream" — a live slot's swap blob (PagedKV.swap_out layout:
+    block arrays + row snapshot + decode carries + the generated-token
+    record), shipped to a new owner on drain/rebalance so a sampled
+    stream resumes bit-exactly (the rng carry rides the blob).
+
+Layout: MAGIC + version byte + blake2b-16 digest of the payload +
+payload, where payload = 4-byte big-endian header length + compact JSON
+header + the concatenated raw array bytes in header-manifest order. The
+header's "arrays" manifest records each array's key/dtype/shape; every
+other header field is kind-specific (pool signature, chain key, token
+counts, budget).
+
+Every failure mode — bad magic, version skew, checksum mismatch, a
+manifest that disagrees with the body, or a pool-shape signature that
+does not match the importing replica — raises the typed KVBlobMismatch.
+Callers treat that as "recompute honestly": a fetched blob can never
+corrupt a pool, only fail to help.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+__all__ = ["KVBlobMismatch", "MAGIC", "VERSION", "encode_blob",
+           "decode_blob", "pool_signature"]
+
+MAGIC = b"CAKEKV"
+VERSION = 1
+_DIGEST = 16
+
+
+class KVBlobMismatch(ValueError):
+    """Typed reject: this blob cannot be installed here. The fallback is
+    ALWAYS honest recompute — never a partial or corrupted install."""
+
+
+def encode_blob(header: dict, arrays: dict) -> bytes:
+    """Serialize `arrays` (name -> np.ndarray, order-significant) under
+    `header` (JSON-safe dict; an "arrays" manifest is added here)."""
+    manifest = []
+    chunks = []
+    for key, arr in arrays.items():
+        src = np.asarray(arr)
+        a = np.ascontiguousarray(src)
+        # record the SOURCE shape: ascontiguousarray promotes 0-d
+        # scalars (the toks/pos decode carries) to (1,), and a carry
+        # must come back with the exact shape the engine swapped out
+        manifest.append({"key": key, "dtype": str(a.dtype),
+                         "shape": list(src.shape)})
+        chunks.append(a.tobytes())
+    head = dict(header)
+    head["arrays"] = manifest
+    hj = json.dumps(head, separators=(",", ":"), sort_keys=True).encode()
+    payload = len(hj).to_bytes(4, "big") + hj + b"".join(chunks)
+    digest = hashlib.blake2b(payload, digest_size=_DIGEST).digest()
+    return MAGIC + bytes([VERSION]) + digest + payload
+
+
+def decode_blob(data: bytes) -> tuple[dict, dict]:
+    """Verify + parse a wire blob; returns (header, arrays). Raises
+    KVBlobMismatch on any structural problem — the checksum covers the
+    whole payload, so a passing decode is byte-exact."""
+    pre = len(MAGIC) + 1 + _DIGEST
+    if not isinstance(data, (bytes, bytearray)) or len(data) < pre + 4:
+        raise KVBlobMismatch("kv blob truncated")
+    data = bytes(data)
+    if data[:len(MAGIC)] != MAGIC:
+        raise KVBlobMismatch("kv blob: bad magic")
+    ver = data[len(MAGIC)]
+    if ver != VERSION:
+        raise KVBlobMismatch(f"kv blob version {ver} != {VERSION}")
+    digest = data[len(MAGIC) + 1:pre]
+    payload = data[pre:]
+    if hashlib.blake2b(payload, digest_size=_DIGEST).digest() != digest:
+        raise KVBlobMismatch("kv blob checksum mismatch")
+    hlen = int.from_bytes(payload[:4], "big")
+    if 4 + hlen > len(payload):
+        raise KVBlobMismatch("kv blob header truncated")
+    try:
+        header = json.loads(payload[4:4 + hlen].decode())
+    except Exception as e:
+        raise KVBlobMismatch(f"kv blob header unreadable: {e}")
+    body = payload[4 + hlen:]
+    arrays = {}
+    pos = 0
+    for m in header.get("arrays") or []:
+        try:
+            dt = np.dtype(m["dtype"])
+            shape = tuple(int(s) for s in m["shape"])
+        except Exception as e:
+            raise KVBlobMismatch(f"kv blob manifest unreadable: {e}")
+        n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if pos + n > len(body):
+            raise KVBlobMismatch("kv blob body truncated")
+        arrays[m["key"]] = np.frombuffer(
+            body[pos:pos + n], dtype=dt).reshape(shape).copy()
+        pos += n
+    if pos != len(body):
+        raise KVBlobMismatch("kv blob: trailing bytes past manifest")
+    return header, arrays
+
+
+def pool_signature(paged) -> list:
+    """JSON-safe shape/dtype signature of a PagedKV pool's per-layer
+    block arrays (batch dim excluded — block COUNT may differ between
+    peers; per-block geometry and dtype must not). Import refuses any
+    blob whose recorded signature differs from the local one."""
+    sig = []
+    for pl in paged.pool:
+        if not pl:
+            sig.append(None)
+        else:
+            sig.append({n: [list(pl[n].shape[1:]), str(pl[n].dtype)]
+                        for n in ("k", "v", "pos")})
+    return sig

@@ -1,0 +1,683 @@
+"""Replica side of the fleet-shared KV tier.
+
+One KVShareReplica rides each serve engine (wired by the API server when
+CAKE_KVSHARE is on; the engine holds it duck-typed as `kv_share` so the
+serve package never imports fleet). It owns three jobs:
+
+  * prefix blob plane — export a prefix-cache chain's pinned blocks as a
+    wire blob (GET /api/v1/kv/prefix/<chain>) and install a fetched blob
+    into the local PagedPrefixCache through the same pin/map machinery a
+    local capture uses, so a fetched chain is indistinguishable from a
+    locally-computed one (greedy outputs stay bit-identical);
+  * fetch-before-recompute — on admission, consult the router-injected
+    peer directory header and fetch the longest matching chain from a
+    warm peer instead of re-prefilling, bounded by
+    CAKE_KVSHARE_FETCH_TIMEOUT_S; every failure mode degrades to honest
+    recompute;
+  * live stream migration — park a draining/migrating slot's swap blob
+    (PagedKV.swap_out: KV bytes + row state + decode carries + the
+    generated-token record) for the router's resume plane to ship to a
+    new owner, which adopts it through the engine's swap-resume path and
+    continues the stream bit-exactly (the rng carry rides the blob).
+
+Threading model: the prefix cache and the paged pool are scheduler-thread
+-only state, so every mutation runs as a mailbox job drained by
+run_pending() at the top of each engine iteration (the engine calls it
+before its idle early-return, and submit_job sets the engine's wake
+event, so an idle engine still serves blobs promptly). API threads block
+on a per-job event with a deadline. The only cross-thread reads outside
+the mailbox are the inventory mirror (an atomically swapped tuple) and
+the parked/inbound stores (dict ops under self._lock).
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import threading
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import knobs
+from ...obs import (FLEET_KV_FETCH_BYTES, FLEET_KV_FETCHES,
+                    FLEET_KV_HIT_RATIO, SERVE_PREFIX_BYTES,
+                    SERVE_SLOTS_BUSY, TIMELINES, now)
+from ...serve.engine import ServeRequest
+from ...serve.paged import PreemptedSlot
+from ...serve.prefix_cache import _PagedEntry
+from .blob import KVBlobMismatch, decode_blob, encode_blob, pool_signature
+
+__all__ = ["KVShareReplica", "StreamMigrated", "KV_DIR_HEADER",
+           "KV_RESUME_HEADER", "KV_RESUMED_HEADER"]
+
+log = logging.getLogger("cake.fleet.kvshare")
+
+# router -> replica: the peer directory (compact JSON of warm peers and
+# their advertised chain keys), injected per attempt like the QoS header
+KV_DIR_HEADER = "X-Cake-KV-Peers"
+# router -> replica: adopt the posted stream blob for this request id
+# before treating the body as a plain continuation
+KV_RESUME_HEADER = "X-Cake-KV-Resume"
+# replica -> router: this response replays the stream from token 0 out
+# of an adopted blob — strip everything the client already saw
+KV_RESUMED_HEADER = "X-Cake-KV-Resumed"
+
+# parked stream blobs nobody fetched are dropped after this many
+# seconds (host RAM; the client's own retry has long moved on)
+_PARKED_TTL_S = 60.0
+
+
+class StreamMigrated(RuntimeError):
+    """This live stream's KV state was parked for migration: the slot is
+    gone and the blob is waiting for the router's resume plane. The SSE
+    handler severs the socket mid-body (NO clean finish) so the router
+    classifies the leg as broken and runs its resume machinery."""
+
+    def __init__(self, rid: str):
+        super().__init__(
+            f"stream {rid} migrated: swap blob parked for the fleet "
+            "resume plane")
+        self.rid = rid
+
+
+def _chain_of(ids: np.ndarray, block: int) -> list[bytes]:
+    """Unit keys of a token record that is an exact multiple of the unit
+    size — one key per STORED unit. (PrefixCache.chain_keys caps at
+    (n-1)//block because an admission must keep one live suffix token;
+    an exported entry's record covers exactly its units, so the export
+    and import sides hash the full record with this instead.)"""
+    h = hashlib.blake2b(digest_size=16)
+    keys = []
+    for b in range(len(ids) // block):
+        h.update(ids[b * block:(b + 1) * block].tobytes())
+        keys.append(h.digest())
+    return keys
+
+
+class KVShareReplica:
+    """Per-replica kvshare agent (see module docstring)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.fetch_timeout = float(knobs.get("CAKE_KVSHARE_FETCH_TIMEOUT_S"))
+        self.inventory_cap = int(knobs.get("CAKE_KVSHARE_INVENTORY"))
+        # mailbox: (kind, payload, box) appended by API threads, drained
+        # on the scheduler thread; deque append/popleft are atomic
+        self._jobs: deque = deque()
+        self._lock = threading.Lock()
+        self._parked: dict = {}     # guarded-by: self._lock
+        self._inbound: dict = {}    # guarded-by: self._lock
+        # inventory mirror: hex chain keys this replica can export,
+        # newest-first. Rebuilt on the scheduler thread whenever the
+        # prefix cache's membership version moves, swapped atomically so
+        # API threads read it lock-free
+        self._inventory: tuple = ()
+        self._pc_version = -1
+        self._drain_swept = False
+        # lifetime fetch accounting behind the hit-ratio gauge
+        self._fetches = 0
+        self._fetch_hits = 0
+
+    # -- scheduler-thread side ---------------------------------------------
+
+    def run_pending(self) -> None:
+        """Drain the mailbox + housekeeping. Called at the top of every
+        engine scheduler iteration (and on wake): everything in here runs
+        on the scheduler thread, where the prefix cache and paged pool
+        are safe to touch."""
+        eng = self.engine
+        try:
+            self._sweep_drain()
+            self._sweep_parked_ttl()
+        except Exception:
+            log.exception("kvshare housekeeping failed")
+        while True:
+            try:
+                kind, payload, box = self._jobs.popleft()
+            except IndexError:
+                break
+            try:
+                box["result"] = self._execute(kind, payload)
+            except BaseException as e:   # the submitter re-raises it
+                box["error"] = e
+            box["event"].set()
+        pc = eng.prefix_cache
+        if pc is not None and pc.version != self._pc_version:
+            self._pc_version = pc.version
+            cap = max(self.inventory_cap, 0)
+            keys = list(pc._blocks)[-cap:] if cap else []
+            self._inventory = tuple(k.hex() for k in reversed(keys))
+
+    def submit_job(self, kind: str, payload, timeout: float):
+        """API-thread entry: enqueue a scheduler job and block on its
+        completion (the engine's wake event lands the _run loop in
+        run_pending even when idle). Raises TimeoutError past the
+        deadline and re-raises whatever the job raised."""
+        box = {"event": threading.Event()}
+        self._jobs.append((kind, payload, box))
+        self.engine._wake.set()
+        if not box["event"].wait(timeout):
+            raise TimeoutError(f"kvshare {kind} job timed out after "
+                               f"{timeout:.1f}s")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _execute(self, kind: str, payload):
+        if kind == "export_prefix":
+            return self._export_prefix(payload)
+        if kind == "import_prefix":
+            return self._import_prefix(payload)
+        if kind == "export_stream":
+            return self._export_stream(payload)
+        if kind == "adopt":
+            return self._adopt(payload)
+        raise ValueError(f"unknown kvshare job kind {kind!r}")
+
+    # -- drain parking -------------------------------------------------------
+
+    def _sweep_drain(self) -> None:
+        """On drain, park every live STREAMED decode once: the router
+        resumes each one on a peer from the shipped blob instead of the
+        continuation re-prefill. Mid-prefill slots and subscriber-less
+        (blocking) requests finish normally under the old drain path; a
+        slot whose first token is sampled but unfetched is skipped too —
+        parking it would lose that token."""
+        eng = self.engine
+        if not eng._draining.is_set():
+            self._drain_swept = False
+            return
+        if self._drain_swept or eng.paged is None:
+            return
+        self._drain_swept = True
+        prefilling = {p.slot for p in eng._prefills}
+        for i in eng.pool.busy():
+            req = eng._reqs[i]
+            if req is None or i in prefilling or not req.tokens \
+                    or req._first_pending or req.cancelled.is_set() \
+                    or req.done.is_set():
+                continue
+            with req._sub_lock:
+                live = req._token_cb is not None
+            if not live:
+                continue
+            self._park_slot(i, req)
+
+    def _sweep_parked_ttl(self) -> None:
+        cutoff = now() - _PARKED_TTL_S
+        with self._lock:
+            stale = [rid for rid, p in self._parked.items()
+                     if p["t"] < cutoff]
+            for rid in stale:
+                del self._parked[rid]
+        for rid in stale:
+            log.warning("kvshare: dropped unclaimed parked stream %s", rid)
+
+    def _park_slot(self, slot: int, req: ServeRequest) -> dict:
+        """Swap a live decode out of its slot and park the blob for the
+        resume plane — the migration-flavored _preempt_slot: same
+        committed-frontier trim + swap_out, but the request FAILS typed
+        (StreamMigrated) instead of joining the resume queue, because its
+        next owner is another replica."""
+        eng = self.engine
+        wp = len(req.prompt_ids) + max(len(req.tokens) - 1, 0)
+        eng.paged.trim_to(slot, wp)
+        blob = eng.paged.swap_out(
+            slot, (eng._toks, eng._pos, eng._rngs, eng._recents))
+        parked = {"blob": blob, "gen_ids": list(req.tokens),
+                  "prompt_ids": list(req.prompt_ids),
+                  "budget": req.budget, "wp": wp, "t": now()}
+        with self._lock:
+            self._parked[req.id] = parked
+        TIMELINES.event(req.id, "preempt", mode="swap", tokens=wp)
+        eng.pool.free(slot)
+        eng._reqs[slot] = None
+        req.slot = None
+        eng._act = eng._act.at[slot].set(False)
+        eng._toks = eng._toks.at[slot].set(0)
+        eng._pos = eng._pos.at[slot].set(0)
+        SERVE_SLOTS_BUSY.set(eng.pool.busy_count)
+        log.info("kvshare: parked stream %s (%d prompt + %d generated "
+                 "tokens) for migration", req.id, len(req.prompt_ids),
+                 len(req.tokens))
+        eng._fail(req, StreamMigrated(req.id))
+        return parked
+
+    # -- prefix export/import (scheduler thread) -----------------------------
+
+    def _export_prefix(self, chain_hex: str) -> bytes | None:
+        """Wire blob of the longest CONTIGUOUS cached chain head ending
+        at (or before) the requested chain key; None = not exportable
+        here. Serves the prefix GET route."""
+        eng = self.engine
+        pc, paged = eng.prefix_cache, eng.paged
+        if pc is None or paged is None:
+            return None
+        try:
+            want = bytes.fromhex(chain_hex)
+        except ValueError:
+            return None
+        tip = pc._blocks.get(want)
+        if tip is None:
+            return None
+        ids = np.asarray(tip.tokens, np.int32)
+        keys = _chain_of(ids, pc.block)
+        entries = []
+        for k in keys:                  # stop at the first gap: the blob
+            e = pc._blocks.get(k)       # must stay a contiguous head
+            if e is None:
+                break
+            entries.append(e)
+        if not entries:
+            return None
+        all_pids = [pid for e in entries for pid in e.pids]
+        arrays = {"tokens": np.asarray(entries[-1].tokens, np.int32)}
+        pid_idx = jnp.asarray(all_pids, jnp.int32)
+        for li, pl in enumerate(paged.pool):
+            if not pl:
+                continue
+            for name in ("k", "v", "pos"):
+                # lint: disable=host-sync — the export IS the planned copy to
+                # host; this runs on the explicit blob-request path, not per
+                # decode iteration
+                arrays[f"layers/{li}/{name}"] = np.asarray(pl[name][pid_idx])
+        has_snap = entries[0].snap is not None
+        if has_snap:
+            for u, e in enumerate(entries):
+                leaves = jax.tree_util.tree_leaves(e.snap)
+                for j, leaf in enumerate(leaves):
+                    # lint: disable=host-sync — boundary row snapshots (a few
+                    # KB) ride the same export blob
+                    arrays[f"snap/{u}/{j}"] = np.asarray(leaf)
+        header = {
+            "kind": "prefix",
+            "chain": keys[len(entries) - 1].hex(),
+            "units": len(entries),
+            "unit_tokens": pc.block,
+            "block_tokens": paged.bt,
+            "bpu": pc.bpu,
+            "pool": pool_signature(paged),
+            "has_snap": has_snap,
+        }
+        return encode_blob(header, arrays)
+
+    def _import_prefix(self, data: bytes) -> dict:
+        """Install a fetched prefix blob into the local PagedPrefixCache:
+        fresh physical blocks, cache-pin ownership, per-unit boundary
+        snapshots — after this, match()/splice() treat the chain exactly
+        like a local capture. Installs the longest contiguous head that
+        fits (capacity/pool pressure can shorten it — still valid).
+        Raises KVBlobMismatch when the blob cannot apply here at all."""
+        eng = self.engine
+        pc, paged = eng.prefix_cache, eng.paged
+        if pc is None or paged is None:
+            raise KVBlobMismatch("replica has no paged prefix cache")
+        header, arrays = decode_blob(data)
+        if header.get("kind") != "prefix":
+            raise KVBlobMismatch("not a prefix blob")
+        if header.get("pool") != pool_signature(paged):
+            raise KVBlobMismatch("pool shape signature mismatch")
+        if header.get("unit_tokens") != pc.block \
+                or header.get("block_tokens") != paged.bt \
+                or header.get("bpu") != pc.bpu:
+            raise KVBlobMismatch("prefix geometry mismatch")
+        units = int(header.get("units") or 0)
+        tokens = arrays.get("tokens")
+        if units < 1 or tokens is None \
+                or len(tokens) != units * pc.block:
+            raise KVBlobMismatch("prefix blob token record inconsistent")
+        ids = np.asarray(tokens, np.int32)
+        keys = _chain_of(ids, pc.block)     # never trust the sender's keys
+        rows = {}
+        for li, pl in enumerate(paged.pool):
+            if not pl:
+                continue
+            for name in ("k", "v", "pos"):
+                a = arrays.get(f"layers/{li}/{name}")
+                if a is None or a.shape[0] != units * pc.bpu:
+                    raise KVBlobMismatch(
+                        f"prefix blob layer {li}/{name} rows missing or "
+                        "short")
+                rows[(li, name)] = a
+        snaps = self._decode_snaps(header, arrays, units)
+        installed = 0
+        for u in range(units):
+            key = keys[u]
+            if key in pc._blocks:           # dedupe, refresh recency
+                pc._blocks.move_to_end(key)
+                installed = u + 1
+                continue
+            snap = snaps[u] if snaps is not None else None
+            snap_nbytes = sum(a.nbytes for a in
+                              jax.tree_util.tree_leaves(snap)) \
+                if snap is not None else 0
+            nbytes = pc.bpu * paged.block_bytes + snap_nbytes
+            if nbytes > pc.capacity:
+                break
+            while pc.bytes + nbytes > pc.capacity and pc._blocks:
+                pc._evict_lru()
+            if not paged.ensure_free(pc.bpu):
+                break                       # partial contiguous head: valid
+            pids = []
+            for _ in range(pc.bpu):
+                pid = paged._alloc_one()
+                assert pid is not None      # guarded by ensure_free above
+                pids.append(pid)
+            dst = jnp.asarray(pids, jnp.int32)
+            sl = slice(u * pc.bpu, (u + 1) * pc.bpu)
+            for (li, name), arr in rows.items():
+                pl = paged.pool[li]
+                pl[name] = pl[name].at[dst].set(jnp.asarray(arr[sl]))
+            # cache-pin ownership: alloc() granted ref=1; convert it to a
+            # pure pin (ref == mappings + cache_pins stays balanced)
+            for pid in pids:
+                paged.alloc.ref(pid, cache_pin=True)
+                paged.alloc.deref(pid)
+            pc._blocks[key] = _PagedEntry(
+                tokens=ids[:(u + 1) * pc.block], pids=pids, snap=snap,
+                nbytes=nbytes)
+            pc.bytes += nbytes
+            pc.version += 1
+            pc.pinned += len(pids)
+            installed = u + 1
+        paged._publish()
+        SERVE_PREFIX_BYTES.set(pc.bytes)
+        if installed == 0:
+            raise KVBlobMismatch("no room to install any prefix unit")
+        log.info("kvshare: installed %d/%d prefix units (%d tokens)",
+                 installed, units, installed * pc.block)
+        return {"installed_units": installed,
+                "tokens": installed * pc.block}
+
+    def _decode_snaps(self, header: dict, arrays: dict, units: int):
+        """Rebuild per-unit boundary row snapshots against the LOCAL row
+        treedef (the blob carries leaves only: treedefs don't serialize,
+        and shape-checking against a locally-derived reference is the
+        honest compatibility gate)."""
+        eng = self.engine
+        paged = eng.paged
+        if not header.get("has_snap"):
+            if paged.has_rows:
+                raise KVBlobMismatch(
+                    "prefix blob has no row snapshots but this pool "
+                    "keeps per-slot rows")
+            return None
+        if not paged.has_rows:
+            raise KVBlobMismatch(
+                "prefix blob carries row snapshots but this pool is "
+                "rowless")
+        ref = eng.model.row_snapshot(paged.rows, 0)
+        leaves, treedef = jax.tree_util.tree_flatten(ref)
+        snaps = []
+        for u in range(units):
+            got = []
+            for j, leaf in enumerate(leaves):
+                a = arrays.get(f"snap/{u}/{j}")
+                if a is None or tuple(a.shape) != tuple(leaf.shape) \
+                        or str(a.dtype) != str(leaf.dtype):
+                    raise KVBlobMismatch(
+                        f"row snapshot {u}/{j} missing or shaped wrong")
+                got.append(jnp.asarray(a))
+            if f"snap/{u}/{len(leaves)}" in arrays:
+                raise KVBlobMismatch("row snapshot has extra leaves")
+            snaps.append(jax.tree_util.tree_unflatten(treedef, got))
+        return snaps
+
+    # -- stream export/adopt (scheduler thread) ------------------------------
+
+    def export_stream(self, rid: str, timeout: float) -> bytes | None:
+        """API-thread entry for the stream GET route. An ALREADY-parked
+        blob encodes directly (host memory + static pool shapes — no
+        engine state; this keeps drain-parked blobs fetchable even while
+        the scheduler is busy tearing down). A live stream goes through
+        the mailbox so the park runs on the scheduler thread."""
+        with self._lock:
+            parked = self._parked.get(rid)
+        if parked is not None:
+            return self._encode_stream(rid, parked)
+        return self.submit_job("export_stream", rid, timeout)
+
+    def _export_stream(self, rid: str) -> bytes | None:
+        """Wire blob of a parked stream; a LIVE stream is parked on the
+        spot (the resume plane's fetch IS the migration signal — covers
+        planned rebalance and post-commit failover where the source
+        still answers). None = unknown stream."""
+        with self._lock:
+            parked = self._parked.get(rid)
+        if parked is None:
+            parked = self._park_live(rid)
+        if parked is None:
+            return None
+        return self._encode_stream(rid, parked)
+
+    def _park_live(self, rid: str) -> dict | None:
+        eng = self.engine
+        if eng.paged is None:
+            return None
+        prefilling = {p.slot for p in eng._prefills}
+        for i in eng.pool.busy():
+            req = eng._reqs[i]
+            if req is None or req.id != rid:
+                continue
+            if i in prefilling or not req.tokens or req._first_pending \
+                    or req.cancelled.is_set() or req.done.is_set():
+                return None     # not migratable in this state
+            return self._park_slot(i, req)
+        return None
+
+    def _encode_stream(self, rid: str, parked: dict) -> bytes:
+        eng = self.engine
+        paged = eng.paged
+        blob = parked["blob"]
+        arrays = {
+            "idx": np.asarray(blob["idx"], np.int32),
+            "gen_ids": np.asarray(parked["gen_ids"], np.int32),
+            "prompt_ids": np.asarray(parked["prompt_ids"], np.int32),
+        }
+        for li, saved in enumerate(blob["layers"]):
+            if not saved:
+                continue
+            for name in ("k", "v", "pos"):
+                arrays[f"layers/{li}/{name}"] = saved[name]
+        has_rows = blob["rows"] is not None
+        if has_rows:
+            for j, leaf in enumerate(
+                    jax.tree_util.tree_leaves(blob["rows"])):
+                arrays[f"rows/{j}"] = np.asarray(leaf)
+        for ci, c in enumerate(blob["carries"]):
+            arrays[f"carries/{ci}"] = np.asarray(c)
+        header = {
+            "kind": "stream", "rid": rid, "budget": parked["budget"],
+            "wp": parked["wp"], "block_tokens": paged.bt,
+            "pool": pool_signature(paged), "has_rows": has_rows,
+        }
+        return encode_blob(header, arrays)
+
+    def store_inbound(self, rid: str, data: bytes) -> dict:
+        """Decode + stage a stream blob shipped by the router (any
+        thread: decode touches no engine state). The adopt job installs
+        it when the resumed request arrives."""
+        header, arrays = decode_blob(data)
+        if header.get("kind") != "stream":
+            raise KVBlobMismatch("not a stream blob")
+        with self._lock:
+            self._inbound[rid] = (header, arrays, now())
+        return {"rid": rid, "gen_tokens": int(arrays["gen_ids"].shape[0])}
+
+    def _adopt(self, payload: dict):
+        """Adopt a staged stream blob: rebuild the swap-blob dict against
+        the local pool and enter the engine through the swap-resume path
+        (_resume_preempted swap_in's it and the decode carries continue
+        the sampled sequence bit-exactly). Returns the live ServeRequest,
+        or None = cannot adopt (caller falls back to the plain
+        continuation re-prefill)."""
+        eng = self.engine
+        paged = eng.paged
+        rid = payload["rid"]
+        with self._lock:
+            staged = self._inbound.pop(rid, None)
+        if staged is None or paged is None:
+            return None
+        header, arrays, _ = staged
+        if header.get("pool") != pool_signature(paged) \
+                or header.get("block_tokens") != paged.bt:
+            log.warning("kvshare: staged blob for %s does not match this "
+                        "pool; falling back to continuation", rid)
+            return None
+        idx = [int(i) for i in arrays["idx"]]
+        if not idx or max(idx) >= paged.max_blocks:
+            return None
+        layers = []
+        for li, pl in enumerate(paged.pool):
+            if not pl:
+                layers.append({})
+                continue
+            d = {}
+            for name in ("k", "v", "pos"):
+                a = arrays.get(f"layers/{li}/{name}")
+                if a is None or a.shape[0] != len(idx):
+                    return None
+                d[name] = a
+            layers.append(d)
+        rows = None
+        if header.get("has_rows"):
+            if not paged.has_rows:
+                return None
+            ref = eng.model.row_snapshot(paged.rows, 0)
+            leaves, treedef = jax.tree_util.tree_flatten(ref)
+            got = []
+            for j, leaf in enumerate(leaves):
+                a = arrays.get(f"rows/{j}")
+                if a is None or tuple(a.shape) != tuple(leaf.shape):
+                    return None
+                got.append(a)
+            rows = jax.tree_util.tree_unflatten(treedef, got)
+        elif paged.has_rows:
+            return None
+        try:
+            carries = [arrays[f"carries/{i}"] for i in range(4)]
+        except KeyError:
+            return None
+        gen_ids = [int(t) for t in arrays["gen_ids"]]
+        prompt_ids = [int(t) for t in arrays["prompt_ids"]]
+        if not gen_ids or not prompt_ids:
+            return None
+        blob = {"idx": idx, "layers": layers, "rows": rows,
+                "carries": carries}
+        req = ServeRequest(prompt_ids, max(len(gen_ids) + 1, 2),
+                           payload.get("sampling"), request_id=rid,
+                           qos=payload.get("qos", "interactive"),
+                           tenant=payload.get("tenant"),
+                           continuation=True)
+        req._engine = eng
+        req.tokens = list(gen_ids)
+        req.budget = max(int(header.get("budget") or 0), 0)
+        req.t_first = now()
+        req.stats["ttft_s"] = 0.0
+        req.stats["kv_migrated"] = True
+        wp = int(header.get("wp") or 0)
+        eng._preempted.append(PreemptedSlot(req, "swap", wp, blob))
+        eng._wake.set()
+        log.info("kvshare: adopted migrated stream %s (%d generated "
+                 "tokens, budget %d)", rid, len(gen_ids), req.budget)
+        return req
+
+    # -- fetch-before-recompute (API thread, async) --------------------------
+
+    async def fetch_before_prefill(self, rid: str, prompt_ids: list,
+                                   peers_header: str) -> None:
+        """Consult the router-injected peer directory and try ONE fetch
+        of the longest chain a warm peer advertises beyond what the local
+        cache already holds. Best-effort by construction: every failure
+        (no match, HTTP error, timeout, geometry mismatch) returns with
+        the cache unchanged and the admission recomputes honestly."""
+        eng = self.engine
+        pc = eng.prefix_cache
+        if pc is None or eng.paged is None or not peers_header:
+            return
+        from .directory import parse_directory
+        peers = parse_directory(peers_header)
+        if not peers:
+            return
+        keys = pc.chain_keys(prompt_ids)
+        if not keys:
+            return
+        hexkeys = [k.hex() for k in keys]
+        local = 0
+        for i in range(len(keys) - 1, -1, -1):
+            if keys[i] in pc._blocks:   # racy read, advisory only: a
+                local = i + 1           # stale answer costs one redundant
+                break                   # fetch or one missed one
+        best = None
+        for i in range(len(hexkeys) - 1, local - 1, -1):
+            for url, advertised in peers:
+                if hexkeys[i] in advertised:
+                    best = (i + 1, url, hexkeys[i])
+                    break
+            if best is not None:
+                break
+        if best is None:
+            if local < len(keys):
+                self._account_fetch("miss", rid, None)
+            return
+        units, url, chain_hex = best
+        import aiohttp
+        t0 = now()
+        deadline = max(self.fetch_timeout, 0.1)
+        try:
+            timeout = aiohttp.ClientTimeout(total=deadline)
+            async with aiohttp.ClientSession(timeout=timeout) as sess:
+                async with sess.get(
+                        url.rstrip("/") + "/api/v1/kv/prefix/"
+                        + chain_hex) as r:
+                    if r.status != 200:
+                        self._account_fetch("miss", rid, url)
+                        return
+                    data = await r.read()
+        except asyncio.TimeoutError:
+            self._account_fetch("timeout", rid, url)
+            return
+        except Exception:
+            self._account_fetch("error", rid, url)
+            return
+        remaining = max(deadline - (now() - t0), 0.2)
+        loop = asyncio.get_running_loop()
+        try:
+            res = await loop.run_in_executor(
+                None, lambda: self.submit_job("import_prefix", data,
+                                              remaining))
+        except KVBlobMismatch:
+            self._account_fetch("mismatch", rid, url)
+            return
+        except Exception:
+            self._account_fetch("error", rid, url)
+            return
+        self._account_fetch("hit", rid, url, tokens=res["tokens"])
+        FLEET_KV_FETCH_BYTES.inc(len(data))
+
+    def _account_fetch(self, outcome: str, rid: str, peer: str | None,
+                       **attrs) -> None:
+        FLEET_KV_FETCHES.inc(outcome=outcome)
+        self._fetches += 1
+        if outcome == "hit":
+            self._fetch_hits += 1
+        FLEET_KV_HIT_RATIO.set(self._fetch_hits / self._fetches)
+        ev = {"outcome": outcome}
+        if peer:
+            ev["peer"] = peer
+        ev.update(attrs)
+        TIMELINES.event(rid, "kv_fetch", **ev)
+
+    # -- views ---------------------------------------------------------------
+
+    def health_view(self) -> dict:
+        """The kvshare block /health carries — the registry mirrors
+        `chains` into the peer directory on every probe scrape."""
+        with self._lock:
+            parked = len(self._parked)
+            inbound = len(self._inbound)
+        return {"chains": list(self._inventory), "parked": parked,
+                "inbound": inbound}

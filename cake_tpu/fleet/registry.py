@@ -182,6 +182,11 @@ class Replica:
         # once per rollup cycle; /fleet surfaces it without ejecting)
         self.outlier = False            # guarded-by: self._lock
         self.outlier_reason = None      # guarded-by: self._lock
+        # kvshare inventory: hex prefix-chain keys this replica's last
+        # healthy /health advertised (kvshare.chains). Retracted the
+        # instant a probe goes stale/sick — a peer directory must never
+        # point a fetch at a replica whose cache state is unknown
+        self.kv_chains = ()             # guarded-by: self._lock
 
     # -- capacity -----------------------------------------------------------
 
@@ -334,6 +339,7 @@ class Replica:
                 FLEET_REPLICA_QUEUE_DEPTH.remove(replica=self.name)
                 FLEET_REPLICA_OCCUPANCY.remove(replica=self.name)
                 FLEET_REPLICA_STALE.set(1, replica=self.name)
+                self.kv_chains = ()     # retract: inventory is stale too
                 if self.state == HALF_OPEN:
                     return self._eject("health")
                 if (self.state == HEALTHY
@@ -367,10 +373,15 @@ class Replica:
                                           replica=self.name)
             FLEET_REPLICA_OCCUPANCY.set(self.occupancy, replica=self.name)
             FLEET_REPLICA_STALE.set(0, replica=self.name)
+            kvshare = engine.get("kvshare") or {}
+            chains = kvshare.get("chains") or []
+            self.kv_chains = tuple(
+                c for c in chains if isinstance(c, str))
             sick = bool(engine.get("down") or engine.get("wedged")
                         or engine.get("alive") is False)
             self.last_probe_ok = not sick
             if sick:
+                self.kv_chains = ()     # retract with the sick verdict
                 self.probe_ok_streak = 0
                 if self.state in (HEALTHY, HALF_OPEN):
                     return self._eject("health")
@@ -559,6 +570,19 @@ class Replica:
         with self._lock:
             return (not self.draining and not self.cordoned
                     and self.state in (HEALTHY, HALF_OPEN))
+
+    def kv_inventory(self) -> tuple:
+        """Hex chain keys from the last HEALTHY probe (empty once
+        retracted). The router builds X-Cake-KV-Peers from this.
+        Empty while EJECTED — probe retraction can lag a data-evidence
+        eject by one probe interval, and a directory must never point a
+        fetch at a replica the router itself refuses to route to.
+        DRAINING/CORDONED replicas keep advertising on purpose: their
+        cache is exactly what peers should siphon before they go."""
+        with self._lock:
+            if self.state == EJECTED:
+                return ()
+            return self.kv_chains
 
     def snapshot(self) -> dict:
         with self._lock:

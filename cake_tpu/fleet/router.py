@@ -51,10 +51,12 @@ import uuid
 from aiohttp import web
 
 from .. import knobs
-from ..obs import (FLEET_HEDGES, FLEET_PROXIED, FLEET_RETRIES, FLEET_SHEDS,
-                   FLEET_STREAM_RESUMES, TRACE_HEADER, TimelineStore, now)
+from ..obs import (FLEET_HEDGES, FLEET_KV_MIGRATIONS, FLEET_PROXIED,
+                   FLEET_RETRIES, FLEET_SHEDS, FLEET_STREAM_RESUMES,
+                   TRACE_HEADER, TimelineStore, now)
 from . import faults
 from .autoscale import Autoscaler, DecisionLog, ScalePolicy
+from .kvshare.directory import encode_directory
 from .lifecycle import ReplicaLifecycle
 from .registry import ReplicaRegistry, discover_replicas
 from .routing import affinity_key, conversation_head, rank_replicas
@@ -86,6 +88,17 @@ _QOS_CLASSES = ("interactive", "standard", "batch")
 # accounting, not content matching — a suffix-match heuristic cannot
 # tell boundary re-emission from genuinely repeating tokens.
 CONTINUATION_CHARS_HEADER = "X-Cake-Continuation-Chars"
+
+# fleet-shared KV tier handshake, mirrored from fleet/kvshare/replica.py
+# by NAME ONLY (replica.py imports jax; the router tier stays
+# import-light): the router injects the warm-peer directory into every
+# forwarded attempt, marks the one resumed leg whose target received a
+# migrated stream blob, and the replica flags a blob-adopted resume on
+# its response so the relay strips the re-emitted text by CUMULATIVE
+# position instead of the continuation-chars formula.
+KV_DIR_HEADER = "X-Cake-KV-Peers"
+KV_RESUME_HEADER = "X-Cake-KV-Resume"
+KV_RESUMED_HEADER = "X-Cake-KV-Resumed"
 
 
 def _transport_errors():
@@ -206,7 +219,8 @@ class FleetRouter:
                  discover_s: float | None = None,
                  stream_resumes: int | None = None,
                  resume_buffer_kb: int | None = None,
-                 autoscale: bool | None = None):
+                 autoscale: bool | None = None,
+                 kvshare: bool | None = None):
         self.registry = registry
         self.retries = retries if retries is not None \
             else knobs.get("CAKE_FLEET_RETRIES")
@@ -245,6 +259,11 @@ class FleetRouter:
         self.resume_buffer_kb = resume_buffer_kb \
             if resume_buffer_kb is not None \
             else knobs.get("CAKE_FLEET_RESUME_BUFFER_KB")
+        # fleet-shared KV tier: inject warm-peer directories and ship
+        # stream blobs on post-commit breaks (docs/kv_sharing.md)
+        self.kvshare = kvshare if kvshare is not None \
+            else knobs.get("CAKE_KVSHARE")
+        self.kv_fetch_timeout_s = knobs.get("CAKE_KVSHARE_FETCH_TIMEOUT_S")
         self.session = None                 # aiohttp.ClientSession
         self.inflight = 0                   # event-loop-confined
         self.draining = False
@@ -495,10 +514,14 @@ class FleetRouter:
                 connect=self.connect_timeout_s or None,
                 sock_read=self.first_byte_timeout_s or None)
             t0 = now()
+            hdrs = self._trace_headers(rid, fwd)
+            peers = self._kv_peers(rep)
+            if peers:
+                hdrs[KV_DIR_HEADER] = peers
             async with self.session.post(
                     rep.base_url + "/v1/chat/completions",
                     json=body, timeout=tmo,
-                    headers=self._trace_headers(rid, fwd)) as r:
+                    headers=hdrs) as r:
                 ttfb_ms = (now() - t0) * 1e3
                 data = await r.read()
                 if r.status in (500, 502, 503):
@@ -553,6 +576,25 @@ class FleetRouter:
         if rid:
             out[TRACE_HEADER] = rid
         return out
+
+    def _kv_peers(self, target) -> str | None:
+        """X-Cake-KV-Peers value for one outbound attempt: every OTHER
+        replica's registry-mirrored chain inventory. Draining/cordoned
+        peers advertise on purpose — a replica on its way out is exactly
+        the one whose cache peers should siphon — while ejected/stale/
+        sick inventories come back empty (kv_inventory + probe
+        retraction). None — header not injected — when kvshare is off
+        or no peer has anything to advertise."""
+        if not self.kvshare:
+            return None
+        peers = []
+        for rep in self.registry.replicas():
+            if rep.name == target.name:
+                continue
+            chains = rep.kv_inventory()
+            if chains:
+                peers.append((rep.base_url, chains))
+        return encode_directory(peers)
 
     @staticmethod
     def _fwd_headers(request: web.Request) -> dict:
@@ -814,9 +856,29 @@ class FleetRouter:
             # placement left over.
             rbs = {"attempts": 0, "budget": 1 + self.retries,
                    "cap_skipped": False}
+            order = self._order(splice["messages"])
+            # fleet-shared KV tier: before the continuation splice, try
+            # to ship the broken owner's parked swap blob (drain parks
+            # it; post-commit failover where the source still answers
+            # exports the live slot — fetching IS the migration signal)
+            # to the first viable survivor. Success marks that leg with
+            # X-Cake-KV-Resume and orders the target first; every
+            # failure mode falls through to the splice continuation,
+            # which is the same request body either way.
+            kv_resume = None
+            if self.kvshare and rid:
+                target = next((r for r in order
+                               if r.name not in failed and r.routable()),
+                              None)
+                if target is not None and await self._migrate_stream(
+                        broken, target, rid):
+                    kv_resume = (target.name, rid)
+                    order = [target] + [r for r in order
+                                        if r.name != target.name]
             kind, val = await self._stream_seq(
-                request, splice, self._order(splice["messages"]), rid,
-                fwd, st, rbs, resumed=True, skip=failed)
+                request, splice, order, rid,
+                fwd, st, rbs, resumed=True, skip=failed,
+                kv_resume=kv_resume)
             if kind == "none":
                 FLEET_STREAM_RESUMES.inc(outcome="error")
                 return await self._stream_broken_terminal(
@@ -846,19 +908,25 @@ class FleetRouter:
     async def _stream_seq(self, request, body, order: list,
                           rid: str | None, fwd: dict | None,
                           st: _StreamRelay, bs: dict,
-                          resumed: bool = False, skip=()):
+                          resumed: bool = False, skip=(),
+                          kv_resume: tuple | None = None):
         """Sequential streamed placement over `order` under bs's shared
         attempt budget: rotate candidates until one commits (relays a
         byte to the client). Pre-commit failures stay invisible.
         Returns ("final", resp) | ("broken", replica) | ("none", None);
-        `skip` names replicas that already broke this stream."""
+        `skip` names replicas that already broke this stream;
+        `kv_resume` = (replica_name, rid) marks the ONE candidate that
+        holds a migrated stream blob — only its leg carries the
+        X-Cake-KV-Resume header, so a rotation past it degrades to the
+        plain continuation splice."""
         for i, rep in enumerate(order):
             if bs["attempts"] >= bs["budget"]:
                 break
             if rep.name in skip or not rep.routable():
                 continue
             kind, val = await self._stream_leg(request, rep, body, rid,
-                                               fwd, st, resumed)
+                                               fwd, st, resumed,
+                                               kv_resume)
             if kind == "skip":
                 bs["cap_skipped"] = True
                 continue
@@ -878,7 +946,8 @@ class FleetRouter:
         return ("none", None)
 
     async def _stream_leg(self, request, rep, body, rid, fwd,
-                          st: _StreamRelay, resumed: bool = False):
+                          st: _StreamRelay, resumed: bool = False,
+                          kv_resume: tuple | None = None):
         """One streamed attempt holding its own routing-slot lease (so
         a hedge winner can cancel the loser without leaking it)."""
         lease = rep.try_acquire()
@@ -886,7 +955,8 @@ class FleetRouter:
             return ("skip", None)
         try:
             return await self._relay_stream(request, rep, body, lease,
-                                            rid, fwd, st, resumed)
+                                            rid, fwd, st, resumed,
+                                            kv_resume)
         finally:
             rep.release(lease)
 
@@ -972,6 +1042,63 @@ class FleetRouter:
                                       bs)
 
     # -- resume plumbing -----------------------------------------------------
+
+    async def _migrate_stream(self, broken, target, rid: str) -> bool:
+        """Ship a broken stream's swap blob from its (possibly still
+        answering) owner to `target`. Two bounded hops under the fetch
+        timeout: GET the blob off the source — the source parks the
+        slot on this fetch if it is still live — then POST it to the
+        target, which stages it for the X-Cake-KV-Resume adoption.
+        False (metrics say why) means the resume plane falls back to
+        the continuation splice; a migration can never make a break
+        worse, only cheaper."""
+        import aiohttp
+        tmo = aiohttp.ClientTimeout(total=self.kv_fetch_timeout_s or None)
+        url = "/api/v1/kv/stream/" + rid
+        try:
+            async with self.session.get(broken.base_url + url,
+                                        timeout=tmo) as r:
+                if r.status != 200:
+                    # 404 = never parked / already swept; 409 = kvshare
+                    # off on the source; 503 = export timed out. All
+                    # the same to the resume plane: no blob to ship.
+                    FLEET_KV_MIGRATIONS.inc(outcome="source_miss")
+                    self.timelines.event(
+                        rid, "kv_migrate", outcome="source_miss",
+                        **{"from": broken.name, "to": target.name})
+                    return False
+                blob = await r.read()
+        except _transport_errors():
+            # the break that got us here usually took the whole replica
+            # down — an unreachable source is the EXPECTED shape, not
+            # an error worth a second failure record against it
+            FLEET_KV_MIGRATIONS.inc(outcome="source_miss")
+            self.timelines.event(
+                rid, "kv_migrate", outcome="source_miss",
+                **{"from": broken.name, "to": target.name})
+            return False
+        try:
+            async with self.session.post(target.base_url + url,
+                                         data=blob, timeout=tmo) as r:
+                if r.status != 200:
+                    FLEET_KV_MIGRATIONS.inc(outcome="ship_error")
+                    self.timelines.event(
+                        rid, "kv_migrate", outcome="ship_error",
+                        **{"from": broken.name, "to": target.name,
+                           "status": r.status})
+                    return False
+        except _transport_errors():
+            FLEET_KV_MIGRATIONS.inc(outcome="ship_error")
+            self.timelines.event(
+                rid, "kv_migrate", outcome="ship_error",
+                **{"from": broken.name, "to": target.name})
+            return False
+        FLEET_KV_MIGRATIONS.inc(outcome="shipped")
+        self.timelines.event(
+            rid, "kv_migrate", outcome="shipped",
+            **{"from": broken.name, "to": target.name,
+               "bytes": len(blob)})
+        return True
 
     @staticmethod
     def _splice_body(body: dict, st: _StreamRelay) -> dict:
@@ -1089,7 +1216,8 @@ class FleetRouter:
                             lease: str = "slot", rid: str | None = None,
                             fwd: dict | None = None,
                             st: _StreamRelay | None = None,
-                            resumed: bool = False):
+                            resumed: bool = False,
+                            kv_resume: tuple | None = None):
         """One streamed attempt relayed onto the client socket held by
         `st`. Returns:
           ("final", resp)  — terminal: clean EOF, a relayed refusal, or
@@ -1131,10 +1259,20 @@ class FleetRouter:
             # the stream-resume plane owns mid-body breaks.
             tmo = aiohttp.ClientTimeout(
                 total=None, connect=self.connect_timeout_s or None)
+            hdrs = self._trace_headers(rid, fwd)
+            peers = self._kv_peers(rep)
+            if peers:
+                hdrs[KV_DIR_HEADER] = peers
+            if kv_resume is not None and kv_resume[0] == rep.name:
+                # this candidate staged the migrated stream blob: ask
+                # it to adopt instead of splice-prefilling (the body is
+                # still the splice, so a failed adoption inside the
+                # replica falls through to the same continuation)
+                hdrs[KV_RESUME_HEADER] = kv_resume[1]
             hdrs_aw = self.session.post(
                 rep.base_url + "/v1/chat/completions",
                 json=body, timeout=tmo,
-                headers=self._trace_headers(rid, fwd))
+                headers=hdrs)
             async with await _deadline(
                     hdrs_aw, self.first_byte_timeout_s) as r:
                 if r.status != 200:
@@ -1162,17 +1300,32 @@ class FleetRouter:
                         content_type=r.content_type
                         or "application/json"))
                 if resumed:
-                    # deterministic overlap: the replica says how much
-                    # of the partial its continuation consumed (ours
-                    # consume all of it); the difference is re-emitted
-                    # text the client already has. No header = assume
-                    # exact continuation, strip nothing.
-                    hdr = r.headers.get(CONTINUATION_CHARS_HEADER)
-                    if hdr is not None:
-                        try:
-                            strip_left = max(splice_chars - int(hdr), 0)
-                        except ValueError:
-                            strip_left = 0
+                    if r.headers.get(KV_RESUMED_HEADER):
+                        # blob-adopted resume: the replica replays the
+                        # FULL generated text from token 0 (the swap
+                        # blob's token record), so the re-emitted
+                        # prefix is everything the client has received
+                        # across ALL previous legs — cumulative
+                        # position, not the splice-consumption formula
+                        # (the adoption never consumed the splice).
+                        # Text past the cumulative mark is generated-
+                        # but-never-relayed tail the break ate: it
+                        # relays as new content, which is exactly right.
+                        strip_left = st.content_chars
+                    else:
+                        # deterministic overlap: the replica says how
+                        # much of the partial its continuation consumed
+                        # (ours consume all of it); the difference is
+                        # re-emitted text the client already has. No
+                        # header = assume exact continuation, strip
+                        # nothing.
+                        hdr = r.headers.get(CONTINUATION_CHARS_HEADER)
+                        if hdr is not None:
+                            try:
+                                strip_left = max(
+                                    splice_chars - int(hdr), 0)
+                            except ValueError:
+                                strip_left = 0
                 buf = b""
                 async for piece in r.content.iter_any():
                     if not piece:

@@ -263,6 +263,23 @@ _knob("CAKE_FLEET_RESUME_BUFFER_KB", int, 256, "fleet",
 _knob("CAKE_FLEET_FAULT_PLAN", str, None, "fleet",
       'deterministic router fault injection (tests/drills only), e.g. '
       '"replica=r1;refuse_after_ops=3" — see fleet/faults.py')
+_knob("CAKE_KVSHARE", bool, False, "fleet",
+      "fleet-shared KV tier (fleet/kvshare/): replicas export/import "
+      "prefix-cache chains as checksummed blobs, the router injects a "
+      "peer directory so cache-cold replicas fetch a warm peer's prefix "
+      "instead of re-prefilling, and broken/drained streams migrate "
+      "their live swap blob to the new owner (bit-exact resume, rng "
+      "included); off keeps all KV strictly replica-local")
+_knob("CAKE_KVSHARE_FETCH_TIMEOUT_S", float, 2.0, "fleet",
+      "deadline on ONE cross-replica KV blob fetch (prefix fetch-"
+      "before-recompute, and the router's stream-blob GET/POST legs); "
+      "an overrun falls back to honest recompute / continuation-mode "
+      "re-prefill — never a client-visible error")
+_knob("CAKE_KVSHARE_INVENTORY", int, 32, "fleet",
+      "hot chain keys each replica advertises through /health into the "
+      "router's peer directory (most-recently-used first); bounds the "
+      "directory header the router injects per request, so it must stay "
+      "well under the ~8 KB header limit")
 
 # -- telemetry (fleet rollups, SLO objectives) ----------------------------
 _knob("CAKE_SLO_TTFT_MS", float, 2000.0, "telemetry",

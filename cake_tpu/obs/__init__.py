@@ -334,6 +334,34 @@ FLEET_STREAM_RESUMES = REGISTRY.counter(
     labelnames=("outcome",))        # ok | broken | error | exhausted |
                                     # overflow
 
+# -- fleet-shared KV tier (fleet/kvshare/) -----------------------------------
+# Cross-replica prefix-blob fetches and live stream-blob migrations; hit
+# ratio is recomputed from the fetch counter each time it moves.
+
+FLEET_KV_FETCHES = REGISTRY.counter(
+    "cake_fleet_kv_fetches_total",
+    "Cross-replica prefix-blob fetch attempts by a cache-cold replica "
+    "before recomputing a prefill (fetch-before-recompute)",
+    labelnames=("outcome",))        # hit | miss | timeout | error |
+                                    # mismatch
+
+FLEET_KV_FETCH_BYTES = REGISTRY.counter(
+    "cake_fleet_kv_fetch_bytes_total",
+    "Wire bytes of successfully fetched + installed prefix blobs")
+
+FLEET_KV_MIGRATIONS = REGISTRY.counter(
+    "cake_fleet_kv_migrations_total",
+    "Live stream-blob migrations attempted by the router's resume plane "
+    "(drain/rebalance/failover): shipped = blob installed at the new "
+    "owner, source_miss / ship_error = fell back to continuation-mode "
+    "re-prefill",
+    labelnames=("outcome",))        # shipped | source_miss | ship_error
+
+FLEET_KV_HIT_RATIO = REGISTRY.gauge(
+    "cake_fleet_kv_hit_ratio",
+    "Fraction of cross-replica prefix fetch attempts that installed a "
+    "peer's blob (hit / all outcomes), over this process's lifetime")
+
 # -- fleet telemetry plane (rollups the autoscaler will consume) -------------
 # Computed once per probe cycle by fleet/telemetry.py from the in-process
 # time-series rings — these are the decision-grade reductions (burn rate,
@@ -455,6 +483,8 @@ __all__ = [
     "FLEET_EJECTS", "FLEET_READMITS", "FLEET_PARTITION_SECONDS",
     "FLEET_RETRIES", "FLEET_HEDGES",
     "FLEET_PROXIED", "FLEET_STREAM_RESUMES",
+    "FLEET_KV_FETCHES", "FLEET_KV_FETCH_BYTES", "FLEET_KV_MIGRATIONS",
+    "FLEET_KV_HIT_RATIO",
     "FLEET_SLO_BURN_RATE", "FLEET_HEADROOM_TOKENS",
     "FLEET_REPLICA_OUTLIER", "FLEET_REPLICA_STALE",
     "FLEET_SCALE_ACTIONS", "FLEET_SCALE_PENDING_SPAWNS",

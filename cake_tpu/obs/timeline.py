@@ -57,6 +57,14 @@ EVENT_KINDS: dict[str, str] = {
     # replica API tier
     "received": "request reached the replica API handler (chat, image, "
                 "or audio)",
+    "kv_fetch": "fleet-shared KV tier: this replica tried to fetch a "
+                "matching prefix blob from a warm peer before "
+                "recomputing the prefill (`outcome` = hit | miss | "
+                "timeout | error | mismatch, `tokens` installed on a "
+                "hit, `peer`)",
+    "kv_migrate": "fleet-shared KV tier: a live stream's swap blob "
+                  "moved through the router's resume plane (`outcome` "
+                  "= shipped | source_miss | ship_error, `from`, `to`)",
     # admission plane + serve engine tier
     "enqueue": "request/job entered the admission queue (`depth` behind "
                "it, `qos` class, `tenant`/`workload` when set)",

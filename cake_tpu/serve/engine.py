@@ -321,6 +321,12 @@ class ServeEngine:
         self.preempt_mode = preempt_mode
         self.paged: PagedKV | None = None
         self._preempted: list[PreemptedSlot] = []
+        # fleet-shared KV tier hook (fleet/kvshare/KVShareReplica), set
+        # by the API server when CAKE_KVSHARE is on. Duck-typed on
+        # purpose: serve never imports fleet. When set, _step drains its
+        # scheduler-thread mailbox (blob export/import, stream parking)
+        # before doing anything else, and health() carries its inventory
+        self.kv_share = None
         self.pool = SlotPool(slots)
         self.queue = AdmissionQueue(max_queue)
         # per-request queue deadline (CAKE_QUEUE_DEADLINE_S, 0 disables):
@@ -607,6 +613,15 @@ class ServeEngine:
                 **paged.occupancy(live),
                 "preempted_slots": len(self._preempted),
             }
+            if pc is not None:
+                # the peer directory and `cake top` both want the cache
+                # size next to pool occupancy, not only in prefix_cache
+                h["kv_pool"]["prefix_entries"] = len(pc._blocks)
+                h["kv_pool"]["prefix_pinned_blocks"] = getattr(
+                    pc, "pinned", 0)
+        ks = self.kv_share
+        if ks is not None:
+            h["kvshare"] = ks.health_view()
         if self.spec_drafter is not None:
             h["spec"] = {
                 "drafter": self.spec_drafter.name,
@@ -794,6 +809,12 @@ class ServeEngine:
                     "rebuilt empty, admission reopened")
 
     def _step(self) -> bool:
+        # kvshare mailbox FIRST — before the idle early-return below, so
+        # an idle engine still serves blob export/import jobs (submit
+        # sets _wake, which lands the _run loop here)
+        ks = self.kv_share
+        if ks is not None:
+            ks.run_pending()
         busy = self.pool.busy()
         queued = self.queue.depth() > 0
         if not (busy or queued or self._preempted):
@@ -1431,6 +1452,12 @@ class ServeEngine:
                 self._act = self._act.at[slot].set(True)
                 self._reqs[slot] = req
                 req.slot = slot
+                # a kvshare-adopted stream enters HERE without ever
+                # passing _start_admission — its API handler is waiting
+                # on the admitted event (no-op for normal preempts,
+                # whose admission already set it)
+                if not req.admitted.is_set():
+                    req.admitted.set()
                 TIMELINES.event(req.id, "resume", mode="swap", slot=slot)
             else:
                 need = self.paged.blocks_for(entry.tokens_at_preempt + 1)

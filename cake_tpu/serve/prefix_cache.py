@@ -79,6 +79,10 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # membership version: bumped on every insert/evict (NOT on LRU
+        # touches) so the kvshare inventory mirror refreshes only when
+        # the key set actually changed
+        self.version = 0
 
     @classmethod
     def build(cls, model, ctx: int, block: int,
@@ -166,9 +170,11 @@ class PrefixCache:
             _, old = self._blocks.popitem(last=False)
             self.bytes -= old.nbytes
             self.evictions += 1
+            self.version += 1
             SERVE_PREFIX_EVICTIONS.inc()
         self._blocks[key] = blk
         self.bytes += blk.nbytes
+        self.version += 1
         SERVE_PREFIX_BYTES.set(self.bytes)
 
     # -- introspection ------------------------------------------------------
@@ -215,6 +221,8 @@ class PagedPrefixCache(PrefixCache):
         super().__init__(model, unit, capacity_bytes)
         self.paged = paged
         self.bpu = unit // paged.bt           # physical blocks per unit
+        self.pinned = 0     # physical blocks currently cache-pinned (a
+                            # single int so /health reads it race-free)
 
     @classmethod
     def build_paged(cls, model, paged, unit: int,
@@ -279,6 +287,8 @@ class PagedPrefixCache(PrefixCache):
             tokens=np.asarray(prompt_ids[:end], np.int32),
             pids=list(pids), snap=snap, nbytes=nbytes)
         self.bytes += nbytes
+        self.version += 1
+        self.pinned += len(pids)
         self.paged._publish()
         SERVE_PREFIX_BYTES.set(self.bytes)
 
@@ -291,9 +301,11 @@ class PagedPrefixCache(PrefixCache):
         _, old = self._blocks.popitem(last=False)
         self.bytes -= old.nbytes
         self.evictions += 1
+        self.version += 1
         SERVE_PREFIX_EVICTIONS.inc()
         freed = sum(1 for pid in old.pids
                     if self.paged.alloc.deref(pid, cache_pin=True))
+        self.pinned -= len(old.pids)
         SERVE_PREFIX_BYTES.set(self.bytes)
         self.paged._publish()
         return freed

@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""Fleet-shared KV smoke: 3 real serve replicas behind the fleet router
+with the kvshare tier enabled end-to-end (CAKE_KVSHARE=1).
+
+Asserts, in order:
+  1. CROSS-REPLICA PREFIX FETCH (ISSUE 20 hard gate): replica r0 is
+     warmed directly, then cordoned; the SAME follow-up routed through
+     the router lands on a cache-cold peer which — driven purely by the
+     router-injected X-Cake-KV-Peers directory — fetches r0's prefix
+     blob and splices instead of re-prefilling. Gated on ALL of:
+     bit-identical greedy body vs the honest direct-to-r0 reference,
+     cake_fleet_kv_fetches_total{outcome="hit"} advancing, AND the
+     landing replica's /api/v1/stats reporting prefix_hit_tokens > 0
+     for that exact request id (a fetch that produced no spliced tokens
+     is a miss wearing a hit's label);
+  2. the directory is registry-mirrored, not config: r0's inventory
+     appears in the router's registry only after a probe scrape of the
+     warmed replica's /health kvshare block;
+  3. LIVE STREAM BLOB MIGRATION: the stream's owner begins draining
+     MID-STREAM — the drain sweep parks the slot as a swap blob, the
+     router ships it to a peer, and the client receives the complete
+     greedy body BYTE-IDENTICAL to an unbroken run with ZERO
+     client-visible error events,
+     cake_fleet_kv_migrations_total{outcome="shipped"} > 0, and the
+     router timeline chaining stream_broken -> kv_migrate(shipped) ->
+     stream_resume -> done.
+
+Every phase polls WITH A DEADLINE (fixed sleeps flake on this
+container's slow CPU). Exits non-zero on any missing signal. Run via
+`make kvshare-smoke`.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the kvshare tier is knob-gated at BOTH ends — create_app only wires
+# KVShareReplica and FleetRouter only injects directories when the knob
+# is on — so flip it before any cake_tpu import
+os.environ["CAKE_KVSHARE"] = "1"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import aiohttp                                             # noqa: E402
+import jax.numpy as jnp                                    # noqa: E402
+from aiohttp import web                                    # noqa: E402
+from aiohttp.test_utils import TestClient, TestServer      # noqa: E402
+
+from cake_tpu.api import ApiState, create_app              # noqa: E402
+from cake_tpu.fleet import (FleetRouter, MembershipPolicy,  # noqa: E402
+                            ReplicaRegistry, create_router_app)
+from cake_tpu.models import TextModel, tiny_config         # noqa: E402
+from cake_tpu.serve import ServeEngine                     # noqa: E402
+from cake_tpu.serve import faults as serve_faults          # noqa: E402
+
+CTX = 128
+N_REPLICAS = 3
+MAX_NEW = 8
+STREAM_MAX_NEW = 24
+SYSTEM = ("fleet kv smoke shared system prompt with enough words to "
+          "span several sixteen token share units so a cold peer has "
+          "a real prefix chain to fetch from the warm one instead of "
+          "prefilling it all over again from scratch")
+
+
+class SmokeTok:
+    """Word-hash for prose, ROUND-TRIP for generated ids (decode emits
+    " t<id>" words, encode parses them back) — same property the fleet
+    chaos smoke rests on, here so a migrated stream's continuation
+    splice re-encodes to exactly `prompt ids + generated ids`."""
+
+    def encode(self, text):
+        out = []
+        for w in text.split():
+            if w[:1] == "t" and w[1:].isdigit():
+                out.append(int(w[1:]))
+            else:
+                out.append(3 + (sum(w.encode()) % 200))
+        return out[:64] or [3]
+
+    def decode(self, ids):
+        return "".join(f" t{i}" for i in ids)
+
+
+class ReplicaProc:
+    """One in-process serve replica with a PAGED pool + prefix cache —
+    the substrate the kvshare tier exports from and imports into."""
+
+    def __init__(self, name: str, model):
+        self.name = name
+        self.engine = ServeEngine(model, slots=2, max_queue=16,
+                                  ctx_len=CTX, prefill_chunk=16,
+                                  kv_blocks=32, kv_block_tokens=8,
+                                  prefix_cache_mb=8)
+        self.state = ApiState(model=model, tokenizer=SmokeTok(),
+                              model_id=f"tiny-{name}")
+        self.state.engine = self.engine
+        self.runner = None
+        self.port = None
+
+    async def start(self) -> str:
+        self.runner = web.AppRunner(create_app(self.state))
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", self.port or 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.engine.close()
+
+
+def _msgs(user: str) -> list:
+    return [{"role": "system", "content": SYSTEM},
+            {"role": "user", "content": user}]
+
+
+async def main_async() -> dict:
+    model = TextModel(tiny_config("llama"), dtype=jnp.float32,
+                      max_cache_len=CTX)
+    model.tokenizer = SmokeTok()    # streamed chunks decode per-token
+    out: dict = {}
+    replicas = [ReplicaProc(f"r{i}", model) for i in range(N_REPLICAS)]
+    registry = ReplicaRegistry(MembershipPolicy(
+        eject_fails=2, err_window=16, err_rate=0.5,
+        degraded_ttft_ms=0.0, eject_s=0.3))
+    router = FleetRouter(registry, retries=2, backoff_s=0.01,
+                         probe_s=0.15, hedge_ms=0.0, stream_resumes=1)
+    assert router.kvshare, "CAKE_KVSHARE knob did not reach the router"
+    client = None
+    session = aiohttp.ClientSession()   # direct-to-replica control path
+    try:
+        for rep in replicas:
+            registry.add(rep.name, await rep.start())
+        for rep in replicas:
+            assert rep.state.kvshare is not None, \
+                f"{rep.name}: create_app did not wire KVShareReplica"
+        client = TestClient(TestServer(create_router_app(router)))
+        await client.start_server()
+
+        def reg(name: str):
+            return next(r for r in registry.replicas() if r.name == name)
+
+        async def direct_chat(rep: ReplicaProc, user: str):
+            async with session.post(
+                    rep.base_url + "/v1/chat/completions",
+                    json={"messages": _msgs(user), "max_tokens": MAX_NEW,
+                          "temperature": 0.0}) as r:
+                body = await r.json()
+                assert r.status == 200, body
+                return body["choices"][0]["message"]["content"]
+
+        async def metric(pattern: str) -> int:
+            mtext = await (await client.get("/metrics")).text()
+            m = re.search(pattern, mtext, re.M)
+            return int(m.group(1)) if m else 0
+
+        async def poll(pred, deadline_s: float, what: str):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                # nudge idle engines: the inventory rebuild runs inside
+                # the scheduler step, which only spins when woken
+                for rp in replicas:
+                    rp.engine._wake.set()
+                await asyncio.sleep(0.05)
+            raise AssertionError(f"timed out waiting for {what}")
+
+        # -- phase 1: warm r0 directly, mirror its inventory --------------
+        warm_src = replicas[0]
+        await direct_chat(warm_src, "warmup turn for the shared prefix")
+        ref = await direct_chat(warm_src, "now the real follow up question")
+        # the directory is fed by the router's probe scrape of /health,
+        # not by any side channel — wait for the mirror to fill
+        await poll(lambda: len(reg(warm_src.name).kv_inventory()) >= 1,
+                   10.0, "registry-mirrored kv inventory for r0")
+        out["inventory_mirrored"] = len(reg(warm_src.name).kv_inventory())
+
+        # -- phase 2: cordoned warm source, cold peer fetches --------------
+        # cordon r0 so the routed follow-up MUST land on a cache-cold
+        # peer; a cordoned replica keeps advertising its inventory (it
+        # is exactly the cache peers should siphon before it goes)
+        reg(warm_src.name).cordon()
+        hits0 = await metric(
+            r'^cake_fleet_kv_fetches_total{outcome="hit"}\s+(\d+)')
+        r = await client.post("/v1/chat/completions", json={
+            "messages": _msgs("now the real follow up question"),
+            "max_tokens": MAX_NEW, "temperature": 0.0})
+        body = await r.json()
+        assert r.status == 200, body
+        rid = r.headers.get("X-Cake-Request-Id")
+        got = body["choices"][0]["message"]["content"]
+        assert got == ref, \
+            f"cross-replica fetched body diverged:\n  ref: {ref!r}\n" \
+            f"  got: {got!r}"
+        out["fetched_body_identical"] = True
+        tl = router.timelines.get(rid)
+        lander = next(e["replica"] for e in tl["events"]
+                      if e["kind"] == "attempt"
+                      and e.get("outcome") == "final")
+        assert lander != warm_src.name, \
+            f"follow-up landed on the cordoned warm source {lander}"
+        out["cold_lander"] = lander
+        hits1 = await metric(
+            r'^cake_fleet_kv_fetches_total{outcome="hit"}\s+(\d+)')
+        assert hits1 > hits0, \
+            f"kv fetch hit counter did not advance ({hits0} -> {hits1})"
+        out["kv_fetch_hits"] = hits1
+        # the hit must be LOAD-BEARING: the landing replica's own stats
+        # for this request id report spliced prefix tokens
+        lander_proc = next(rp for rp in replicas if rp.name == lander)
+        async with session.get(lander_proc.base_url + "/api/v1/stats") as r:
+            st = (await r.json())["stats"]
+        assert st.get("request_id") == rid, st
+        assert st.get("prefix_hit_tokens", 0) > 0, \
+            f"fetch hit produced no spliced prefix tokens: {st}"
+        out["prefix_hit_tokens"] = st["prefix_hit_tokens"]
+        # and it is visible in the peer's /health kv_pool block
+        async with session.get(lander_proc.base_url + "/health") as r:
+            h = await r.json()
+        kv_pool = h["engine"]["kv_pool"]
+        assert kv_pool["prefix_entries"] >= 1, kv_pool
+        assert kv_pool["prefix_pinned_blocks"] >= 1, kv_pool
+        out["lander_prefix_entries"] = kv_pool["prefix_entries"]
+
+        # -- phase 2b: failed fetch degrades to honest recompute -----------
+        # a directory naming a dead peer (advertising the RIGHT chains,
+        # so the fetch is genuinely attempted) must cost nothing the
+        # client can see: 200, bit-identical body, zero spliced tokens
+        from cake_tpu.fleet.kvshare import KV_DIR_HEADER, encode_directory
+        chains = list(reg(warm_src.name).kv_inventory())
+        bogus = encode_directory([("http://127.0.0.1:9", chains)])
+        ferr0 = await metric(
+            r'^cake_fleet_kv_fetches_total{outcome="error"}\s+(\d+)')
+        other = next(rp for rp in replicas
+                     if rp.name not in (warm_src.name, lander))
+        async with session.post(
+                other.base_url + "/v1/chat/completions",
+                json={"messages": _msgs("now the real follow up question"),
+                      "max_tokens": MAX_NEW, "temperature": 0.0},
+                headers={KV_DIR_HEADER: bogus}) as r:
+            body = await r.json()
+            assert r.status == 200, body
+        assert body["choices"][0]["message"]["content"] == ref, \
+            "recompute after failed fetch diverged"
+        async with session.get(other.base_url + "/api/v1/stats") as r:
+            st = (await r.json())["stats"]
+        assert st.get("prefix_hit_tokens", 0) == 0, \
+            f"failed fetch claimed spliced tokens: {st}"
+        ferr1 = await metric(
+            r'^cake_fleet_kv_fetches_total{outcome="error"}\s+(\d+)')
+        assert ferr1 > ferr0, \
+            f"dead-peer fetch not accounted ({ferr0} -> {ferr1})"
+        out["failed_fetch_degrades"] = True
+
+        # -- phase 3: live stream blob migration on drain ------------------
+        def smsg(convo: int) -> list:
+            return _msgs(f"stream conversation {convo} tell me a long story")
+
+        async def stream_once(convo: int, drain_after: int | None = None,
+                              victim: ReplicaProc | None = None):
+            """One streamed request through the router; optionally begin
+            draining `victim` once `drain_after` content chunks have
+            arrived. Returns (content, error_events, request_id)."""
+            content, errors = "", []
+            drained = False
+            buf = b""
+            async with client.post("/v1/chat/completions", json={
+                    "messages": smsg(convo), "max_tokens": STREAM_MAX_NEW,
+                    "temperature": 0.0, "stream": True}) as r:
+                assert r.status == 200, await r.text()
+                rid = r.headers.get("X-Cake-Request-Id")
+                ntoks = 0
+                async for piece in r.content.iter_any():
+                    buf += piece
+                    while b"\n\n" in buf:
+                        ev, buf = buf.split(b"\n\n", 1)
+                        if not ev.startswith(b"data: "):
+                            continue
+                        pl = ev[6:].strip()
+                        if pl == b"[DONE]":
+                            continue
+                        obj = json.loads(pl)
+                        if "error" in obj:
+                            errors.append(obj["error"])
+                            continue
+                        delta = obj["choices"][0]["delta"]
+                        if delta.get("content"):
+                            content += delta["content"]
+                            ntoks += 1
+                            if (drain_after is not None and not drained
+                                    and ntoks >= drain_after):
+                                drained = True
+                                victim.engine.begin_drain()
+            return content, errors, rid
+
+        def commit_replica(rid: str) -> str:
+            tl = router.timelines.get(rid)
+            return next(e["replica"] for e in tl["events"]
+                        if e["kind"] == "commit")
+
+        serve_faults.install("delay_ms=40")     # stretch decode so the
+        try:                                    # drain lands mid-stream
+            convo = base = rid0 = None
+            for c in range(40, 48):     # find a convo that decodes long
+                base, errs, rid0 = await stream_once(c)
+                assert not errs, errs
+                if base.count(" t") >= 10:
+                    convo = c
+                    break
+            assert convo is not None, "no convo produced >= 10 tokens"
+            owner = next(rp for rp in replicas
+                         if rp.name == commit_replica(rid0))
+            healed, errs, rid = await stream_once(convo, drain_after=5,
+                                                  victim=owner)
+            assert not errs, f"client saw error events: {errs}"
+            assert healed == base, \
+                f"migrated stream diverged:\n  base:   {base!r}\n" \
+                f"  healed: {healed!r}"
+            out["stream_drained"] = owner.name
+            out["stream_body_identical"] = True
+            events = router.timelines.get(rid)["events"]
+            kinds = [e["kind"] for e in events]
+            for k in ("stream_broken", "stream_resume", "kv_migrate",
+                      "resume_spliced", "done"):
+                assert k in kinds, (k, kinds)
+            # the resume decision is logged first, THEN the blob ships,
+            # THEN the resumed leg splices on the new owner
+            assert kinds.index("stream_broken") \
+                < kinds.index("stream_resume") < kinds.index("kv_migrate") \
+                < kinds.index("resume_spliced") < kinds.index("done"), kinds
+            mig = next(e for e in events if e["kind"] == "kv_migrate")
+            assert mig["outcome"] == "shipped", mig
+            assert mig["from"] == owner.name, mig
+            out["migration_timeline_chain"] = True
+            shipped = await metric(
+                r'^cake_fleet_kv_migrations_total{outcome="shipped"}'
+                r'\s+(\d+)')
+            assert shipped >= 1, \
+                'cake_fleet_kv_migrations_total{outcome="shipped"} missing'
+            out["kv_migrations_shipped"] = shipped
+        finally:
+            serve_faults.clear()
+        return out
+    finally:
+        await session.close()
+        if client is not None:
+            await client.close()
+        for rep in replicas:
+            if rep.runner is not None:
+                await rep.runner.cleanup()
+            rep.close()
+
+
+def main() -> int:
+    out = asyncio.new_event_loop().run_until_complete(main_async())
+    print("kvshare-smoke OK:")
+    for k, v in out.items():
+        print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
